@@ -1,0 +1,113 @@
+"""multiverso-tpu: a TPU-native distributed ML framework.
+
+A from-scratch re-design of the Multiverso parameter-server framework
+(reference: ``dongruiqing/multiverso``) for TPU: parameter tables are
+HBM-resident sharded ``jax.Array``s, worker<->server Push/Pull lowers to XLA
+collectives over ICI, server-side updaters run as jitted device steps, and
+pod topology comes from JAX slice metadata over DCN.
+
+Top-level functions mirror the reference public API
+(``include/multiverso/multiverso.h:9-62``): ``init`` / ``shutdown`` /
+``barrier`` / ``rank`` / ``size`` / ``num_workers`` / ``num_servers`` /
+``worker_id`` / ``server_id`` / ``aggregate``, plus ``create_table``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from . import config
+from .config import (define_bool, define_float, define_int, define_string,
+                     get_flag, parse_cmd_flags, set_flag)
+from .dashboard import Dashboard, Monitor, Timer, monitor
+from .log import Log, LogLevel, check, check_notnull
+from .runtime import Session
+from .topology import SERVER_AXIS, SEQ_AXIS, WORKER_AXIS, make_mesh, sharding_for
+
+__version__ = "0.1.0"
+
+
+def init(argv: Optional[Sequence[str]] = None, sync: Optional[bool] = None,
+         updater: Optional[str] = None, **flags: Any) -> List[str]:
+    """Initialise the process (``MV_Init``, ``src/multiverso.cpp:10``)."""
+    if sync is not None:
+        set_flag("sync", bool(sync))
+    if updater is not None:
+        set_flag("updater_type", updater)
+    for key, value in flags.items():
+        set_flag(key, value)
+    return Session.get().start(argv)
+
+
+def shutdown(finalize: bool = True) -> None:
+    """``MV_ShutDown`` (``src/multiverso.cpp:14``)."""
+    Session.get().stop(finalize)
+
+
+def barrier() -> None:
+    """``MV_Barrier`` (``src/multiverso.cpp:19``)."""
+    Session.get().barrier()
+
+
+def rank() -> int:
+    return Session.get().rank
+
+
+def size() -> int:
+    return Session.get().size
+
+
+def num_workers() -> int:
+    return Session.get().num_workers
+
+
+def num_servers() -> int:
+    return Session.get().num_servers
+
+
+def worker_id() -> int:
+    return Session.get().worker_id
+
+
+def server_id() -> int:
+    return Session.get().server_id
+
+
+def is_worker() -> bool:
+    return Session.get().is_worker()
+
+
+def is_server() -> bool:
+    return Session.get().is_server()
+
+
+def aggregate(data):
+    """``MV_Aggregate`` allreduce of a host buffer (``src/multiverso.cpp:47``)."""
+    return Session.get().aggregate(data)
+
+
+def session() -> Session:
+    return Session.get()
+
+
+def create_table(kind: str, *args: Any, **kwargs: Any):
+    """``MV_CreateTable`` factory (``include/multiverso/multiverso.h:31-37``).
+
+    ``kind`` is one of ``array`` / ``matrix`` / ``kv`` / ``sparse`` / ``ftrl``.
+    """
+    from . import tables
+
+    factory = {
+        "array": tables.ArrayTable,
+        "matrix": tables.MatrixTable,
+        "kv": tables.KVTable,
+        "sparse": tables.SparseTable,
+        "ftrl": tables.FTRLTable,
+    }
+    try:
+        cls = factory[kind]
+    except KeyError:
+        Log.fatal(f"unknown table kind {kind!r}; expected one of {sorted(factory)}")
+    table = cls(*args, **kwargs)
+    barrier()  # MV_CreateTable barriers after creation (multiverso.h:35)
+    return table

@@ -1,0 +1,204 @@
+"""Typed flag/config registry.
+
+TPU-native equivalent of the reference flag system
+(``include/multiverso/util/configure.h:67-110``,
+``src/util/configure.cpp:9-44`` in the Multiverso reference): a process-global
+typed registry populated by ``define_*`` declarations, a command-line parser
+consuming ``-key=value`` tokens (compacting argv in place), and programmatic
+``set_flag`` (the reference's ``SetCMDFlag``).
+
+Unlike the reference there is one registry keyed by name (not one singleton per
+type); a flag's declared type is enforced on assignment with the same
+string -> int -> bool -> float coercion ladder the reference applies when
+parsing CLI text.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+
+class FlagError(KeyError):
+    """Unknown flag or type mismatch."""
+
+
+def _parse_bool(text: str) -> bool:
+    t = text.strip().lower()
+    if t in ("true", "1", "yes", "on"):
+        return True
+    if t in ("false", "0", "no", "off"):
+        return False
+    raise ValueError(f"not a bool: {text!r}")
+
+
+_COERCERS: Dict[type, Callable[[str], Any]] = {
+    int: int,
+    float: float,
+    bool: _parse_bool,
+    str: str,
+}
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: type
+    value: Any
+    description: str
+
+
+class FlagRegister:
+    """Process-global flag registry (one instance per process)."""
+
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.RLock()
+
+    # -- declaration ------------------------------------------------------
+    def define(self, name: str, type_: type, default: Any, description: str = "") -> None:
+        if type_ not in _COERCERS:
+            raise TypeError(f"unsupported flag type {type_!r}")
+        with self._lock:
+            if name in self._flags:
+                # Re-definition with identical type keeps the current value
+                # (module reloads in tests); type conflict is an error.
+                if self._flags[name].type is not type_:
+                    raise FlagError(f"flag {name!r} redefined with different type")
+                return
+            self._flags[name] = _Flag(name, type_, type_(default), description)
+
+    # -- access -----------------------------------------------------------
+    def get(self, name: str) -> Any:
+        with self._lock:
+            try:
+                return self._flags[name].value
+            except KeyError:
+                raise FlagError(f"unknown flag {name!r}") from None
+
+    def set(self, name: str, value: Any) -> None:
+        """Programmatic set; accepts the declared type or coercible text."""
+        with self._lock:
+            try:
+                flag = self._flags[name]
+            except KeyError:
+                raise FlagError(f"unknown flag {name!r}") from None
+            if isinstance(value, str) and flag.type is not str:
+                try:
+                    value = _COERCERS[flag.type](value)
+                except ValueError as exc:
+                    raise FlagError(
+                        f"flag {name!r}: cannot coerce {value!r} to {flag.type.__name__}"
+                    ) from exc
+            if flag.type is float and isinstance(value, int):
+                value = float(value)
+            if not isinstance(value, flag.type) or (
+                flag.type is not bool and isinstance(value, bool)
+            ):
+                raise FlagError(
+                    f"flag {name!r} expects {flag.type.__name__}, got {type(value).__name__}"
+                )
+            flag.value = value
+
+    def known(self, name: str) -> bool:
+        with self._lock:
+            return name in self._flags
+
+    def items(self) -> Dict[str, Any]:
+        with self._lock:
+            return {k: f.value for k, f in self._flags.items()}
+
+    def describe(self) -> str:
+        with self._lock:
+            lines = [
+                f"-{f.name}={f.value!r}  ({f.type.__name__}) {f.description}"
+                for f in sorted(self._flags.values(), key=lambda f: f.name)
+            ]
+        return "\n".join(lines)
+
+    # -- CLI --------------------------------------------------------------
+    def parse_cmd_flags(self, argv: Optional[List[str]] = None) -> List[str]:
+        """Consume ``-key=value`` / ``--key=value`` tokens from argv.
+
+        Returns the remaining (unconsumed) argv, mirroring the reference's
+        in-place argv compaction (``src/util/configure.cpp:9-44``). Unknown
+        keys are left in argv untouched (apps layer their own config on top).
+        """
+        if argv is None:
+            return []
+        rest: List[str] = []
+        for token in argv:
+            body = None
+            if token.startswith("--"):
+                body = token[2:]
+            elif token.startswith("-"):
+                body = token[1:]
+            if body and "=" in body:
+                key, _, text = body.partition("=")
+                if self.known(key):
+                    flag_type = self._flags[key].type
+                    try:
+                        self.set(key, _COERCERS[flag_type](text) if flag_type is not str else text)
+                        continue
+                    except (ValueError, FlagError):
+                        pass  # fall through: keep token for the app
+            rest.append(token)
+        return rest
+
+    def reset(self) -> None:
+        """Drop all flags (test helper)."""
+        with self._lock:
+            self._flags.clear()
+
+
+_REGISTRY = FlagRegister()
+
+
+# -- module-level API (mirrors MV_DEFINE_* / MV_GetCMDFlag / MV_SetCMDFlag) --
+
+def define_int(name: str, default: int, description: str = "") -> None:
+    _REGISTRY.define(name, int, default, description)
+
+
+def define_float(name: str, default: float, description: str = "") -> None:
+    _REGISTRY.define(name, float, default, description)
+
+
+def define_bool(name: str, default: bool, description: str = "") -> None:
+    _REGISTRY.define(name, bool, default, description)
+
+
+def define_string(name: str, default: str, description: str = "") -> None:
+    _REGISTRY.define(name, str, default, description)
+
+
+def get_flag(name: str) -> Any:
+    return _REGISTRY.get(name)
+
+
+def set_flag(name: str, value: Any) -> None:
+    _REGISTRY.set(name, value)
+
+
+def parse_cmd_flags(argv: Optional[List[str]] = None) -> List[str]:
+    return _REGISTRY.parse_cmd_flags(argv)
+
+
+def registry() -> FlagRegister:
+    return _REGISTRY
+
+
+# -- core framework flags (reference: src/zoo.cpp:23-24, src/server.cpp:20-21,
+# src/updater/updater.cpp:11-12, src/util/allocator.cpp:10,152) --------------
+
+define_string("ps_role", "default", "process role: none|worker|server|default")
+define_bool("ma", False, "model-averaging mode (no parameter tables; aggregate only)")
+define_bool("sync", False, "synchronous (BSP) parameter-server semantics")
+define_float("backup_worker_ratio", 0.0, "reserved: fraction of backup workers")
+define_string("updater_type", "default", "server-side updater: default|sgd|adagrad|momentum_sgd")
+define_int("omp_threads", 4, "host-side worker threads for async apply loops")
+define_string("mesh_shape", "", "override logical mesh, e.g. '4,2' for (worker,server)")
+define_int("sync_frequency", 1, "rounds between parameter synchronisations")
+define_string("log_file", "", "optional log sink file")
+define_string("log_level", "info", "debug|info|error|fatal")

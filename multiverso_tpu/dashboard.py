@@ -1,0 +1,158 @@
+"""Named timing monitors + process-global dashboard.
+
+TPU-native equivalent of the reference observability layer
+(``include/multiverso/dashboard.h:16-73``, ``src/dashboard.cpp:14-45`` in the
+Multiverso reference): named ``Monitor`` timers (count / total ms / average)
+registered into a process-global ``Dashboard``, a ``monitor(name)`` context
+manager replacing the ``MONITOR_BEGIN/END`` macros, ``Dashboard.watch`` by
+name and ``Dashboard.display`` at shutdown.
+
+On TPU the interesting spans are host-side walls around dispatched programs;
+``monitor(..., block=True)`` additionally calls
+``jax.block_until_ready`` on a result so the span covers device execution,
+not just async dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+
+class Timer:
+    """Wall-clock start/elapse timer (reference ``util/timer.h:8-24``)."""
+
+    def __init__(self) -> None:
+        self.start()
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def elapse_ms(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e3
+
+
+class Monitor:
+    """Accumulating named timer (reference ``dashboard.h:26-57``).
+
+    Start timestamps are thread-local so concurrent spans on the same
+    monitor name don't clobber each other's begin().
+    """
+
+    def __init__(self, name: str, register: bool = True) -> None:
+        self.name = name
+        self.count = 0
+        self.total_ms = 0.0
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        if register:
+            Dashboard.add_monitor(self)
+
+    def begin(self) -> None:
+        self._local.t0 = time.perf_counter()
+
+    def end(self) -> None:
+        t0 = getattr(self._local, "t0", None)
+        if t0 is None:
+            return
+        elapsed = (time.perf_counter() - t0) * 1e3
+        self._local.t0 = None
+        with self._lock:
+            self.count += 1
+            self.total_ms += elapsed
+
+    def average_ms(self) -> float:
+        with self._lock:
+            return self.total_ms / self.count if self.count else 0.0
+
+    def info_string(self) -> str:
+        with self._lock:
+            avg = self.total_ms / self.count if self.count else 0.0
+            return (
+                f"[{self.name}] count = {self.count} total = {self.total_ms:.3f} ms "
+                f"avg = {avg:.3f} ms"
+            )
+
+
+class Dashboard:
+    """Process-global monitor registry (reference ``dashboard.h:16-24``)."""
+
+    _monitors: Dict[str, Monitor] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def add_monitor(cls, mon: Monitor) -> None:
+        with cls._lock:
+            cls._monitors[mon.name] = mon
+
+    @classmethod
+    def get_or_create(cls, name: str) -> Monitor:
+        with cls._lock:
+            mon = cls._monitors.get(name)
+            if mon is None:
+                mon = Monitor(name, register=False)
+                cls._monitors[name] = mon
+            return mon
+
+    @classmethod
+    def watch(cls, name: str) -> str:
+        with cls._lock:
+            mon = cls._monitors.get(name)
+        return mon.info_string() if mon else f"[{name}] not monitored"
+
+    @classmethod
+    def stats(cls, name: str) -> Optional[Dict[str, float]]:
+        with cls._lock:
+            mon = cls._monitors.get(name)
+        if mon is None:
+            return None
+        return {"count": mon.count, "total_ms": mon.total_ms, "avg_ms": mon.average_ms()}
+
+    @classmethod
+    def display(cls, emit=None) -> str:
+        with cls._lock:
+            monitors = list(cls._monitors.values())
+        lines = ["--------------Dashboard--------------"]
+        lines += [m.info_string() for m in monitors]
+        text = "\n".join(lines)
+        if emit is None:
+            from .log import Log
+            emit = Log.info
+        emit("%s", text)
+        return text
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._monitors.clear()
+
+
+@contextmanager
+def monitor(name: str, block_on: Any = None) -> Iterator[Monitor]:
+    """Span context manager replacing MONITOR_BEGIN/END.
+
+    If ``block_on`` is supplied (a jax.Array / pytree produced inside the
+    span), it is blocked on before the span closes so device time is counted.
+    """
+    mon = Dashboard.get_or_create(name)
+    mon.begin()
+    try:
+        yield mon
+    finally:
+        if block_on is not None:
+            import jax
+            jax.block_until_ready(block_on)
+        mon.end()
+
+
+def monitored_block_until_ready(name: str, value: Any) -> Any:
+    """Time a block_until_ready on ``value`` under monitor ``name``."""
+    import jax
+
+    mon = Dashboard.get_or_create(name)
+    mon.begin()
+    jax.block_until_ready(value)
+    mon.end()
+    return value

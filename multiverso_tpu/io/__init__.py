@@ -1,0 +1,12 @@
+"""IO: URI streams, text reading, table/session checkpointing."""
+
+from .stream import TextReader, URI, open_stream, read_array, register_scheme, write_array
+
+__all__ = [
+    "TextReader",
+    "URI",
+    "open_stream",
+    "read_array",
+    "register_scheme",
+    "write_array",
+]

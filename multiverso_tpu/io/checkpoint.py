@@ -1,0 +1,76 @@
+"""Session-level checkpoint/resume.
+
+The reference leaves checkpointing to each table's ``Serializable``
+Store/Load (``include/multiverso/table_interface.h:59-66`` in the Multiverso
+reference) with no automatic driver (the intended ``MV_LoadTable`` driver
+survives only as comments, ``Test/main.cpp:293-331``). Here the driver
+exists: ``save``/``restore`` walk the session's table registry and write one
+binary record per table plus a JSON manifest. Rank 0 writes; every process
+restores (single-controller JAX reloads give every process the same state).
+
+For large-model checkpointing with per-shard parallel IO, use orbax directly
+on the tables' ``.array`` views; this module is the framework-native
+lightweight path matching reference semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..log import Log
+from ..runtime import Session
+from .stream import open_stream
+
+_MANIFEST = "manifest.json"
+
+
+def save(directory: str, session: Optional[Session] = None) -> None:
+    """Store every registered table under ``directory``."""
+    sess = session or Session.get()
+    if not sess.started:
+        Log.fatal("save() requires an initialised session")
+    sess.barrier()
+    if sess.rank == 0:
+        os.makedirs(directory, exist_ok=True)
+        manifest = {"version": 1, "tables": []}
+        for table in sess.tables:
+            path = os.path.join(directory, f"table_{table.table_id}.bin")
+            with open_stream(path, "wb") as stream:
+                table.store(stream)
+            manifest["tables"].append({
+                "id": table.table_id,
+                "type": type(table).__name__,
+                "name": getattr(table, "name", ""),
+                "file": os.path.basename(path),
+            })
+        with open(os.path.join(directory, _MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=2)
+        Log.info("checkpoint saved: %d table(s) -> %s", len(sess.tables), directory)
+    sess.barrier()
+
+
+def restore(directory: str, session: Optional[Session] = None) -> None:
+    """Load every registered table from ``directory`` (ids must match the
+    creation order, as in the reference's table-id registry)."""
+    sess = session or Session.get()
+    if not sess.started:
+        Log.fatal("restore() requires an initialised session")
+    manifest_path = os.path.join(directory, _MANIFEST)
+    if not os.path.exists(manifest_path):
+        Log.fatal(f"no checkpoint manifest at {manifest_path}")
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    by_id = {entry["id"]: entry for entry in manifest["tables"]}
+    for table in sess.tables:
+        entry = by_id.get(table.table_id)
+        if entry is None:
+            Log.fatal(f"checkpoint missing table id {table.table_id}")
+        if entry["type"] != type(table).__name__:
+            Log.fatal(
+                f"checkpoint table {table.table_id} is {entry['type']}, "
+                f"session has {type(table).__name__}")
+        with open_stream(os.path.join(directory, entry["file"]), "rb") as stream:
+            table.load(stream)
+    Log.info("checkpoint restored: %d table(s) <- %s", len(sess.tables), directory)

@@ -1,0 +1,130 @@
+"""URI-dispatched binary streams + buffered text reading.
+
+TPU-native equivalent of the reference IO layer
+(``include/multiverso/io/io.h:24-130``, ``src/io/local_stream.cpp``,
+``src/io/hdfs_stream.cpp`` in the Multiverso reference): ``file://`` URIs map
+to local streams; other schemes (``hdfs://`` behind libhdfs in the reference)
+raise a clear error unless a handler is registered — cloud storage on TPU VMs
+is typically fuse-mounted or handled by tensorstore/orbax (see
+``io/checkpoint.py``), so the extension point is a scheme registry.
+
+``write_array``/``read_array`` define the framework's table serialisation
+record: little-endian header (dtype tag, ndim, dims) + raw buffer — the
+binary Store/Load contract (``table_interface.h:59-66``).
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+import struct
+from typing import BinaryIO, Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..log import Log
+
+
+class URI:
+    """Scheme/host/path split (``io.h:24-56``)."""
+
+    def __init__(self, uri: str) -> None:
+        self.uri = uri
+        if "://" in uri:
+            self.scheme, rest = uri.split("://", 1)
+            if "/" in rest:
+                self.host, path = rest.split("/", 1)
+                self.path = "/" + path
+            else:
+                self.host, self.path = rest, "/"
+        else:
+            self.scheme, self.host, self.path = "file", "", uri
+
+
+_OPENERS: Dict[str, Callable[[URI, str], BinaryIO]] = {}
+
+
+def register_scheme(scheme: str, opener: Callable[[URI, str], BinaryIO]) -> None:
+    _OPENERS[scheme] = opener
+
+
+def _open_local(uri: URI, mode: str) -> BinaryIO:
+    if "w" in mode or "a" in mode:
+        parent = os.path.dirname(uri.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+    return open(uri.path, mode)
+
+
+register_scheme("file", _open_local)
+
+
+def open_stream(uri: str, mode: str = "rb") -> BinaryIO:
+    """``StreamFactory::GetStream`` (``src/io/io.cpp:8-21``)."""
+    parsed = URI(uri)
+    opener = _OPENERS.get(parsed.scheme)
+    if opener is None:
+        Log.fatal(f"no stream handler for scheme {parsed.scheme!r} ({uri})")
+    if "b" not in mode:
+        mode += "b"
+    return opener(parsed, mode)
+
+
+class TextReader:
+    """Buffered line reader (``io.h:114-130``)."""
+
+    def __init__(self, uri: str, buf_size: int = 1 << 20) -> None:
+        self._stream = open_stream(uri, "rb")
+        self._reader = _io.BufferedReader(self._stream, buffer_size=buf_size)
+
+    def get_line(self) -> Optional[str]:
+        line = self._reader.readline()
+        if not line:
+            return None
+        return line.decode("utf-8", errors="replace").rstrip("\r\n")
+
+    def __iter__(self) -> Iterator[str]:
+        while True:
+            line = self.get_line()
+            if line is None:
+                return
+            yield line
+
+    def close(self) -> None:
+        self._reader.close()
+
+    def __enter__(self) -> "TextReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- binary array records ---------------------------------------------------
+
+_MAGIC = b"MVTA"
+
+
+def write_array(stream: BinaryIO, array: np.ndarray) -> None:
+    array = np.ascontiguousarray(array)
+    dtype_tag = array.dtype.str.encode("ascii")
+    stream.write(_MAGIC)
+    stream.write(struct.pack("<B", len(dtype_tag)))
+    stream.write(dtype_tag)
+    stream.write(struct.pack("<B", array.ndim))
+    for dim in array.shape:
+        stream.write(struct.pack("<q", dim))
+    stream.write(array.tobytes())
+
+
+def read_array(stream: BinaryIO) -> np.ndarray:
+    magic = stream.read(4)
+    if magic != _MAGIC:
+        Log.fatal(f"bad table record magic {magic!r}")
+    (tag_len,) = struct.unpack("<B", stream.read(1))
+    dtype = np.dtype(stream.read(tag_len).decode("ascii"))
+    (ndim,) = struct.unpack("<B", stream.read(1))
+    shape = tuple(struct.unpack("<q", stream.read(8))[0] for _ in range(ndim))
+    count = int(np.prod(shape)) if shape else 1
+    buf = stream.read(count * dtype.itemsize)
+    return np.frombuffer(buf, dtype=dtype).reshape(shape).copy()

@@ -1,0 +1,12 @@
+"""Compute ops: embedding gather/scatter, ring attention, pallas kernels."""
+
+from .embedding import embedding_lookup, scatter_add_rows, segment_mean_rows
+from .ring_attention import reference_attention, ring_attention
+
+__all__ = [
+    "embedding_lookup",
+    "scatter_add_rows",
+    "segment_mean_rows",
+    "reference_attention",
+    "ring_attention",
+]

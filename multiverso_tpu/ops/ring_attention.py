@@ -1,0 +1,121 @@
+"""Ring attention: sequence-parallel exact attention over the ``seq`` axis.
+
+The reference predates transformers and has no sequence dimension (survey
+§5.7); long-context support is a first-class requirement of this framework,
+so it is built on the same substrate as everything else: sharded arrays +
+ICI collectives. Q/K/V are sharded along sequence over the ``seq`` mesh
+axis; each step computes one block of scores flash-style (running max /
+normaliser accumulation, so the full [seq, seq] score matrix never
+materialises) while K/V blocks rotate around the ring via ``ppermute`` —
+compute overlaps the neighbour exchange, the classic ring-attention
+schedule (Liu et al., 2023).
+
+Differentiable end-to-end (autodiff through the scan + ppermute), causal or
+full; exact (not windowed) attention.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..topology import SEQ_AXIS
+
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:  # pragma: no cover — jax < 0.8
+    from jax.experimental.shard_map import shard_map
+
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_pos, k_pos, causal, scale, m, l, acc):
+    """One flash-attention block accumulation step.
+
+    q: [sq, h, d]; k/v: [sk, h, d]; positions: [sq], [sk].
+    m/l: [h, sq] running max / normaliser; acc: [sq, h, d].
+    """
+    scores = jnp.einsum("qhd,khd->hqk", q, k) * scale  # [h, sq, sk]
+    if causal:
+        mask = (k_pos[None, :] <= q_pos[:, None])[None, :, :]
+        scores = jnp.where(mask, scores, _NEG_INF)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # guard fully-masked rows (m_new == -inf) against NaNs
+    m_safe = jnp.where(m_new <= _NEG_INF, 0.0, m_new)
+    correction = jnp.exp(m - m_safe) * (m > _NEG_INF)
+    p = jnp.exp(scores - m_safe[:, :, None]) * (scores > _NEG_INF)
+    l_new = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("hqk,khd->qhd", p, v)
+    acc_new = acc * correction.transpose(1, 0)[:, :, None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    axis: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention with sequence sharded over ``axis``.
+
+    Shapes: q/k/v ``[seq, heads, dim]`` (batch handled via vmap by callers),
+    sharded ``P(axis, None, None)``. Returns same shape/sharding as ``q``.
+    """
+    n_blocks = int(mesh.shape[axis])
+    seq = q.shape[0]
+    if seq % n_blocks != 0:
+        raise ValueError(f"seq {seq} must divide over {n_blocks} ring steps")
+    block = seq // n_blocks
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    spec = P(axis, None, None)
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+             check_vma=False)
+    def _ring(q_blk, k_blk, v_blk):
+        my_idx = jax.lax.axis_index(axis)
+        h = q_blk.shape[1]
+        q_pos = my_idx * block + jnp.arange(block)
+        m0 = jnp.full((h, block), _NEG_INF, q_blk.dtype)
+        l0 = jnp.zeros((h, block), q_blk.dtype)
+        acc0 = jnp.zeros_like(q_blk)
+
+        def body(step, carry):
+            m, l, acc, k_cur, v_cur = carry
+            # after `step` rotations, we hold the block that started at
+            # ring position (my_idx - step) mod n
+            src = jnp.mod(my_idx - step, n_blocks)
+            k_pos = src * block + jnp.arange(block)
+            m, l, acc = _block_attn(q_blk, k_cur, v_cur, q_pos, k_pos,
+                                    causal, scale, m, l, acc)
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return m, l, acc, k_nxt, v_nxt
+
+        m, l, acc, _, _ = jax.lax.fori_loop(
+            0, n_blocks, body, (m0, l0, acc0, k_blk, v_blk))
+        denom = jnp.maximum(l, 1e-20).transpose(1, 0)[:, :, None]
+        return acc / denom
+
+    return _ring(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Unsharded O(seq^2) attention — the correctness oracle for tests."""
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("qhd,khd->hqk", q, k) * scale
+    if causal:
+        seq = q.shape[0]
+        mask = jnp.tril(jnp.ones((seq, seq), bool))[None, :, :]
+        scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", probs, v)

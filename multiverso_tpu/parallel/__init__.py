@@ -1,0 +1,21 @@
+"""Parallelism: ICI collectives, BSP train steps, host-side overlap.
+
+Replaces the reference's net/allreduce-engine layer and sync-server machinery
+with XLA-native forms — see per-module docstrings for the mapping.
+"""
+
+from .async_buffer import ASyncBuffer, PipelinedGetter
+from .collectives import (all_gather, allreduce, allreduce_replicated,
+                          reduce_scatter, ring_shift)
+from .sync_step import make_sync_step
+
+__all__ = [
+    "ASyncBuffer",
+    "PipelinedGetter",
+    "all_gather",
+    "allreduce",
+    "allreduce_replicated",
+    "reduce_scatter",
+    "ring_shift",
+    "make_sync_step",
+]

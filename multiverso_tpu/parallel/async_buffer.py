@@ -1,0 +1,117 @@
+"""Double-buffered prefetching: compute/communication overlap on the host.
+
+TPU-native equivalent of the reference ``ASyncBuffer``
+(``include/multiverso/util/async_buffer.h:11-116`` in the Multiverso
+reference) and the LogReg ``GetPipelineTable`` pattern
+(``Applications/LogisticRegression/src/model/ps_model.cpp:236``): a
+background thread fills the non-ready buffer while the consumer works on the
+ready one; ``get()`` waits, swaps, and re-triggers the prefetch.
+
+On TPU the analogous overlap for *device* work comes free from JAX's async
+dispatch; this class covers genuinely host-blocking producers (data loading,
+host Gets of remote state) exactly like the reference's.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ASyncBuffer(Generic[T]):
+    """Two buffers + one background filler thread."""
+
+    def __init__(self, buffer0: T, buffer1: T,
+                 fill_fn: Callable[[T], None]) -> None:
+        self._buffers = [buffer0, buffer1]
+        self._fill_fn = fill_fn
+        self._ready: "queue.Queue[int]" = queue.Queue(maxsize=2)
+        self._todo: "queue.Queue[Optional[int]]" = queue.Queue(maxsize=2)
+        self._thread = threading.Thread(target=self._main, daemon=True)
+        self._consumer_idx: Optional[int] = None
+        self._stopped = False
+        self._thread.start()
+        self._todo.put(0)  # prefetch into buffer 0 immediately
+
+    def _main(self) -> None:
+        while True:
+            idx = self._todo.get()
+            if idx is None:
+                return
+            self._fill_fn(self._buffers[idx])
+            self._ready.put(idx)
+
+    def get(self) -> T:
+        """Wait for the prefetched buffer, hand it out, prefetch the other.
+
+        Acquiring buffer ``i`` releases the previously-held buffer, which
+        (two buffers) is always ``1 - i`` — so ``1 - i`` becomes the next
+        fill target.
+        """
+        if self._stopped:
+            raise RuntimeError("ASyncBuffer is stopped; call restart() first")
+        idx = self._ready.get()
+        self._consumer_idx = idx
+        self._todo.put(1 - idx)
+        return self._buffers[idx]
+
+    def join(self) -> None:
+        """Stop the filler thread (reference ``Join``); restartable."""
+        if self._stopped:
+            return
+        self._todo.put(None)
+        self._thread.join()
+        self._stopped = True
+
+    def restart(self) -> None:
+        if not self._stopped:
+            return
+        self._stopped = False
+        self._thread = threading.Thread(target=self._main, daemon=True)
+        self._thread.start()
+        if self._ready.empty() and self._todo.empty():
+            # nothing prefetched and nothing scheduled: prime the non-held buffer
+            idx = self._consumer_idx
+            self._todo.put(1 - idx if idx is not None else 0)
+
+
+class PipelinedGetter:
+    """Double-buffered table Gets keyed by a per-window keyset.
+
+    Mirrors LogReg ``PSModel::GetPipelineTable``
+    (``ps_model.cpp:236``): while the consumer trains on window *i*'s
+    parameters, the next window's keyset is already being fetched.
+    ``get(next_keys)`` returns the previously-prefetched values and starts
+    the fetch for ``next_keys``.
+    """
+
+    def __init__(self, fetch_fn: Callable[[object], object]) -> None:
+        self._fetch_fn = fetch_fn
+        self._pending: Optional["queue.Queue"] = None
+        self._thread: Optional[threading.Thread] = None
+        self._result_q: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def prime(self, keys) -> None:
+        """Start the first fetch (blocking fetches happen in background)."""
+        self._start(keys)
+
+    def _start(self, keys) -> None:
+        def run():
+            self._result_q.put(self._fetch_fn(keys))
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def get(self, next_keys=None):
+        """Wait on the in-flight fetch; optionally start the next one."""
+        if self._thread is None:
+            raise RuntimeError("call prime(keys) before get()")
+        result = self._result_q.get()
+        self._thread.join()
+        self._thread = None
+        if next_keys is not None:
+            self._start(next_keys)
+        return result

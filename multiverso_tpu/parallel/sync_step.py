@@ -1,0 +1,77 @@
+"""Jitted BSP training step: the TPU-native form of sync parameter serving.
+
+The reference's sync mode is its most intricate machinery — per-worker vector
+clocks gating message order so every worker's i-th Get sees identical
+parameters (``SyncServer``, ``src/server.cpp:69-222`` in the Multiverso
+reference). BSP is XLA's *native* execution model, so all of that collapses
+into one jitted SPMD step: the batch arrives sharded over the ``worker`` mesh
+axis, the loss reduction makes XLA insert a ``psum`` of gradients over ICI,
+and the updater folds the summed delta into the ``server``-sharded table —
+every worker's next Get trivially sees identical parameters because there is
+exactly one parameter buffer.
+
+``make_sync_step`` is the minimal-harness version operating on one table;
+real models thread pytrees through their own jitted steps and only need the
+tables' ``.array``/``set_array`` accessors plus shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tables.base import TableBase, _option_scalars
+from ..topology import WORKER_AXIS
+from ..updaters import AddOption
+
+
+def make_sync_step(
+    table: TableBase,
+    loss_fn: Callable[[jax.Array, Any], jax.Array],
+    batch_sharded: bool = True,
+) -> Callable[[Any, Optional[AddOption]], jax.Array]:
+    """Build ``step(batch, option) -> loss`` folding grads into ``table``.
+
+    ``loss_fn(params, batch)`` returns a scalar mean loss. The returned step:
+
+    * shards ``batch`` over the ``worker`` axis (data parallelism; XLA turns
+      the mean-loss gradient into a psum over ICI),
+    * computes ``delta = lr * grad`` and applies the table's updater (so
+    ``sgd`` performs descent, ``default`` accumulates ``+lr*grad``),
+    * updates the table's HBM-resident state in place (donated buffers).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = table.mesh
+    batch_spec = (NamedSharding(mesh, P(WORKER_AXIS))
+                  if batch_sharded else NamedSharding(mesh, P()))
+    updater = table.updater
+
+    def _step(data, ustate, batch, lr, momentum, rho, lam, wid):
+        loss, grads = jax.value_and_grad(loss_fn)(data, batch)
+        option = AddOption(worker_id=wid, learning_rate=lr,
+                           momentum=momentum, rho=rho, lam=lam)
+        delta = lr * grads
+        data, ustate = updater.apply(data, ustate, delta, option)
+        return data, ustate, loss
+
+    jitted = jax.jit(
+        _step,
+        donate_argnums=(0, 1),
+        out_shardings=(table.sharding, table._ustate_sharding, None),
+    )
+
+    def step(batch, option: Optional[AddOption] = None) -> jax.Array:
+        option = option or AddOption()
+        batch = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, batch_spec), batch)
+        with table._lock:
+            table._data, table._ustate, loss = jitted(
+                table._data, table._ustate, batch,
+                *_option_scalars(option, table.dtype))
+        return loss
+
+    return step

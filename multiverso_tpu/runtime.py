@@ -1,0 +1,164 @@
+"""Process-wide session: the reference's ``Zoo`` re-expressed for TPU.
+
+The reference Zoo (``include/multiverso/zoo.h:19``, ``src/zoo.cpp`` in the
+Multiverso reference) is a singleton that starts actor threads, registers the
+node with rank 0, owns the table registry, and provides barrier/rank/size
+queries. On TPU there are no actor threads to start — the data plane is SPMD
+programs over a mesh — so the Session reduces to: flag parsing, topology
+discovery, the table registry, the train-mode switches (sync / async / ma),
+and lifecycle (init / barrier / shutdown with a dashboard dump,
+``src/zoo.cpp:96-101``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import config, topology
+from .dashboard import Dashboard
+from .log import Log, LogLevel
+
+_ROLE_NONE, _ROLE_WORKER, _ROLE_SERVER, _ROLE_ALL = 0, 1, 2, 3
+_ROLES = {"none": _ROLE_NONE, "worker": _ROLE_WORKER,
+          "server": _ROLE_SERVER, "default": _ROLE_ALL, "all": _ROLE_ALL}
+
+
+class Session:
+    """Singleton runtime state (``Zoo::Get()`` analogue)."""
+
+    _instance: Optional["Session"] = None
+    _lock = threading.RLock()
+
+    def __init__(self) -> None:
+        self.topo: Optional[topology.Topology] = None
+        self.tables: List[Any] = []
+        self.role: int = _ROLE_ALL
+        self.started = False
+
+    # -- singleton --------------------------------------------------------
+    @classmethod
+    def get(cls) -> "Session":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = Session()
+            return cls._instance
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self, argv: Optional[Sequence[str]] = None) -> List[str]:
+        """``MV_Init`` (``src/multiverso.cpp:10`` → ``Zoo::Start``)."""
+        with self._lock:
+            rest = config.parse_cmd_flags(list(argv) if argv else None)
+            Log.reset_log_level_by_name(config.get_flag("log_level"))
+            log_file = config.get_flag("log_file")
+            if log_file:
+                Log.reset_log_file(log_file)
+            if self.started:
+                return rest
+            self.role = _ROLES.get(config.get_flag("ps_role"), _ROLE_ALL)
+            self.topo = topology.discover()
+            self.started = True
+            topology.barrier("mv_init")
+            Log.info(
+                "multiverso-tpu initialised: rank %d/%d, mesh %s, mode %s",
+                self.rank, self.size, dict(self.topo.mesh.shape),
+                "ma" if config.get_flag("ma")
+                else ("sync" if config.get_flag("sync") else "async"),
+            )
+            return rest
+
+    def stop(self, finalize: bool = True) -> None:
+        """``MV_ShutDown`` → ``Zoo::Stop`` (``src/zoo.cpp:96-101``)."""
+        with self._lock:
+            if not self.started:
+                return
+            topology.barrier("mv_shutdown")
+            for table in self.tables:
+                flush = getattr(table, "flush", None)
+                if flush is not None:
+                    flush()
+            self.tables.clear()
+            Dashboard.display()
+            self.started = False
+            self.topo = None
+
+    def barrier(self) -> None:
+        self._require_started()
+        topology.barrier()
+
+    # -- registry ---------------------------------------------------------
+    def register_table(self, table: Any) -> int:
+        """Assign the next table id (``Zoo::RegisterTable``, ``src/zoo.cpp:172``)."""
+        with self._lock:
+            self._require_started()
+            table_id = len(self.tables)
+            self.tables.append(table)
+            return table_id
+
+    def table(self, table_id: int) -> Any:
+        return self.tables[table_id]
+
+    # -- queries (``multiverso.h:18-29``) ---------------------------------
+    def _require_started(self) -> None:
+        if not self.started or self.topo is None:
+            Log.fatal("multiverso-tpu session not initialised; call init() first")
+
+    @property
+    def mesh(self):
+        self._require_started()
+        return self.topo.mesh
+
+    @property
+    def rank(self) -> int:
+        self._require_started()
+        return self.topo.rank
+
+    @property
+    def size(self) -> int:
+        self._require_started()
+        return self.topo.size
+
+    @property
+    def num_workers(self) -> int:
+        self._require_started()
+        return self.topo.size  # one logical PS worker per process
+
+    @property
+    def num_servers(self) -> int:
+        self._require_started()
+        return self.topo.num_servers
+
+    @property
+    def worker_id(self) -> int:
+        self._require_started()
+        return self.topo.rank if self.role & _ROLE_WORKER else -1
+
+    @property
+    def server_id(self) -> int:
+        self._require_started()
+        return self.topo.rank if self.role & _ROLE_SERVER else -1
+
+    def is_worker(self) -> bool:
+        return bool(self.role & _ROLE_WORKER)
+
+    def is_server(self) -> bool:
+        return bool(self.role & _ROLE_SERVER)
+
+    # -- model averaging ---------------------------------------------------
+    def aggregate(self, data: np.ndarray) -> np.ndarray:
+        """``MV_Aggregate`` (``src/multiverso.cpp:47-50``): in-place sum of a
+        host buffer across all processes. Rides DCN through the JAX
+        coordination service instead of ``MPI_Allreduce``; the per-device
+        collective form lives in ``parallel.collectives``.
+        """
+        self._require_started()
+        if self.size == 1:
+            return data
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(np.asarray(data))
+        summed = np.sum(gathered, axis=0).astype(data.dtype)
+        np.copyto(data, summed)
+        return data

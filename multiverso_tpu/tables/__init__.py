@@ -1,0 +1,22 @@
+"""Parameter tables: HBM-resident sharded state with Get/Add semantics.
+
+Re-designs the reference table layer (``include/multiverso/table`` /
+``src/table`` in the Multiverso reference) for TPU — see the per-module
+docstrings for the mapping.
+"""
+
+from .base import AsyncHandle, TableBase
+from .array_table import ArrayTable
+from .matrix_table import MatrixTable
+from .kv_table import KVTable
+from .sparse_table import FTRLTable, SparseTable
+
+__all__ = [
+    "AsyncHandle",
+    "TableBase",
+    "ArrayTable",
+    "MatrixTable",
+    "KVTable",
+    "SparseTable",
+    "FTRLTable",
+]

@@ -1,0 +1,42 @@
+"""Padded gather/scatter helpers for row-keyed table access.
+
+XLA traces fixed shapes, but table users ask for arbitrary row sets (the
+reference's per-row Get/Add bucketing, ``src/table/matrix_table.cpp:288-316``).
+We bucket request sizes to powers of two and pad with sentinel row 0 plus a
+zero mask, so each bucket compiles exactly once and padded lanes are no-ops.
+This is the static-shape answer to the reference's dynamic per-row message
+loops (survey §7 "hard part (b)").
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+_MIN_BUCKET = 8
+
+
+def bucket_size(n: int) -> int:
+    """Smallest power-of-two >= n (floor at ``_MIN_BUCKET``)."""
+    size = _MIN_BUCKET
+    while size < n:
+        size <<= 1
+    return size
+
+
+def pad_ids(ids: np.ndarray, n_valid: int, size: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad ``ids`` to ``size``; returns (padded int32 ids, float mask)."""
+    padded = np.zeros((size,), dtype=np.int32)
+    padded[:n_valid] = ids[:n_valid]
+    mask = np.zeros((size,), dtype=np.float32)
+    mask[:n_valid] = 1.0
+    return padded, mask
+
+
+def pad_values(values: np.ndarray, n_valid: int, size: int) -> np.ndarray:
+    """Pad a [n, ...] value block with zero rows to [size, ...]."""
+    out_shape = (size,) + tuple(values.shape[1:])
+    padded = np.zeros(out_shape, dtype=values.dtype)
+    padded[:n_valid] = values[:n_valid]
+    return padded

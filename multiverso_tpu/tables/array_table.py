@@ -1,0 +1,34 @@
+"""1-D dense parameter vector.
+
+TPU-native equivalent of the reference ArrayTable
+(``include/multiverso/table/array_table.h``, ``src/table/array_table.cpp`` in
+the Multiverso reference): there, a ``vector<T>`` contiguous-range sharded
+across server processes, with whole-table Get/Add fanned out per server. Here
+the whole table is a single sharded ``jax.Array`` (``P("server")``); the
+per-server slicing, reply reassembly and memcpy bookkeeping
+(``array_table.cpp:69-96``) all disappear into the sharding layout — XLA
+splits the Add and gathers the Get.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base import TableBase
+
+
+class ArrayTable(TableBase):
+    """``ArrayWorker``/``ArrayServer`` pair collapsed into one object."""
+
+    def __init__(self, size: int, dtype: Any = jnp.float32,
+                 updater: Optional[str] = None, name: Optional[str] = None,
+                 init_value: Optional[np.ndarray] = None) -> None:
+        super().__init__((int(size),), dtype=dtype, updater=updater,
+                         name=name, init_value=init_value)
+
+    def get_into(self, out: np.ndarray) -> None:
+        """Reference signature ``Get(T* data, size_t size)``."""
+        np.copyto(out, self.get())

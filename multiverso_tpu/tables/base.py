@@ -1,0 +1,306 @@
+"""Parameter-table base: HBM-resident sharded state + jitted update dispatch.
+
+TPU-native re-design of the reference table layer
+(``include/multiverso/table_interface.h:24-85``, ``src/table.cpp`` in the
+Multiverso reference). The reference splits every table into a WorkerTable
+(request fan-out across server processes, per-request ``Waiter`` latches) and
+a ServerTable (shard storage + updater application). Here both halves
+collapse into one object:
+
+* storage — one ``jax.Array`` laid out with ``NamedSharding`` over the
+  ``server`` mesh axis: each shard is HBM-resident on its server devices
+  (the reference's contiguous range-sharding, ``src/table/array_table.cpp:11-22``).
+* ``Add`` — a jitted updater step dispatched on the sharded state with donated
+  buffers (in-place HBM update; replaces worker->server Request_Add messages,
+  the OpenMP server loop and the Reply_Add round-trip).
+* ``Get`` — a device->host transfer (XLA all-gathers the shards), or the
+  zero-copy ``.array`` view for device-side consumers.
+* async — JAX's asynchronous dispatch *is* the worker actor: ``add_async``
+  returns immediately with the update enqueued on the device stream, and an
+  ``AsyncHandle`` plays the role of the reference's ``Waiter``
+  (``include/multiverso/util/waiter.h:9-35``).
+
+Sync (BSP) multi-process semantics: with ``-sync=true`` and >1 process, every
+process's delta is summed before application (the SyncServer contract that
+each round folds all workers' deltas, ``src/server.cpp:69-222``), via a
+host-side allreduce on the compat path; jitted training steps should instead
+use ``parallel.sync_step`` where the sum is an ICI ``psum``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import config
+from ..dashboard import Dashboard
+from ..log import Log
+from ..runtime import Session
+from ..topology import SERVER_AXIS
+from ..updaters import AddOption, GetOption, Updater, get_updater
+
+
+class AsyncHandle:
+    """Future for an async table op (the reference's per-request ``Waiter``)."""
+
+    def __init__(self, values: Any = None, callback=None) -> None:
+        self._values = values
+        self._callback = callback
+        self._done = False
+
+    def wait(self) -> Any:
+        if not self._done:
+            if self._values is not None:
+                jax.block_until_ready(self._values)
+            result = self._callback() if self._callback is not None else self._values
+            self._values = result
+            self._done = True
+        return self._values
+
+
+def _option_scalars(option: AddOption, dtype) -> Tuple[jax.Array, ...]:
+    """AddOption -> traced scalars so hyperparameter changes don't recompile."""
+    return (
+        jnp.asarray(option.learning_rate, dtype=dtype),
+        jnp.asarray(option.momentum, dtype=dtype),
+        jnp.asarray(option.rho, dtype=dtype),
+        jnp.asarray(option.lam, dtype=dtype),
+        jnp.asarray(option.worker_id, dtype=jnp.int32),
+    )
+
+
+class TableBase:
+    """Shared machinery for Array/Matrix/sparse tables."""
+
+    def __init__(
+        self,
+        shape: Sequence[int],
+        dtype: Any = jnp.float32,
+        updater: Optional[str] = None,
+        name: Optional[str] = None,
+        init_value: Optional[np.ndarray] = None,
+        num_sim_workers: Optional[int] = None,
+    ) -> None:
+        sess = Session.get()
+        if not sess.started:
+            Log.fatal("create tables after multiverso_tpu.init()")
+        self._sess = sess
+        self.mesh = sess.mesh
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = jnp.dtype(dtype)
+        self.table_id = sess.register_table(self)
+        self.name = name or f"{type(self).__name__}:{self.table_id}"
+        self.updater: Updater = get_updater(updater, dtype=self.dtype)
+        # Per-worker updater state (AdaGrad) is sized by this; worker_id in
+        # AddOption must stay below it (checked host-side — XLA would
+        # silently clamp/drop an OOB index inside jit).
+        self.num_worker_slots = int(num_sim_workers or sess.num_workers)
+        self._lock = threading.RLock()
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data_spec = self._data_pspec()
+        self.sharding = NamedSharding(self.mesh, data_spec)
+        if init_value is not None:
+            init_host = np.asarray(init_value, dtype=self.dtype).reshape(self.shape)
+            self._data = jax.device_put(init_host, self.sharding)
+        else:
+            self._data = jax.jit(
+                lambda: jnp.zeros(self.shape, self.dtype), out_shardings=self.sharding
+            )()
+
+        ustate = self.updater.init_state(self.shape, self.dtype, self.num_worker_slots)
+        if isinstance(ustate, tuple) and len(ustate) == 0:
+            self._ustate = ()
+            self._ustate_sharding = ()
+        else:
+            extra = ustate.ndim - len(self.shape)
+            spec = P(*((None,) * extra), *data_spec)
+            self._ustate_sharding = NamedSharding(self.mesh, spec)
+            self._ustate = jax.device_put(ustate, self._ustate_sharding)
+
+        self._apply_fn = self._build_apply()
+
+    # -- sharding layout ---------------------------------------------------
+    def _data_pspec(self):
+        """Leading dim sharded over the server axis; override for layouts."""
+        from jax.sharding import PartitionSpec as P
+
+        num_servers = self._sess.num_servers
+        if self.shape and self.shape[0] % num_servers == 0:
+            return P(SERVER_AXIS, *(None,) * (len(self.shape) - 1))
+        # Uneven leading dim: keep it unsharded rather than fight XLA padding.
+        return P(*(None,) * len(self.shape))
+
+    # -- jitted update step ------------------------------------------------
+    def _build_apply(self):
+        updater = self.updater
+
+        def step(data, ustate, delta, lr, momentum, rho, lam, worker_id):
+            option = AddOption(worker_id=worker_id, learning_rate=lr,
+                               momentum=momentum, rho=rho, lam=lam)
+            return updater.apply(data, ustate, delta, option)
+
+        return jax.jit(
+            step,
+            donate_argnums=(0, 1),
+            out_shardings=(self.sharding, self._ustate_sharding),
+        )
+
+    # -- shared keyed (row/key) machinery ---------------------------------
+    def _build_keyed_apply(self, rowwise: bool):
+        """Jitted scatter-apply for keyed adds, shared by Matrix/Sparse/FTRL.
+
+        ``rowwise=True``: values are [k, cols] blocks (mask broadcast over
+        cols); ``False``: values are [k] scalars. Stateless updaters
+        (declared via ``Updater.stateless``) take a direct ``at[ids].add``
+        scatter; stateful ones materialise a dense delta so their ``apply``
+        semantics (per-worker accumulators etc.) are preserved.
+        """
+        updater = self.updater
+        sign = updater.sign
+
+        def expand_mask(mask, vals):
+            m = mask[:, None] if rowwise else mask
+            return m.astype(vals.dtype)
+
+        if updater.stateless:
+            def step(data, ustate, ids, vals, mask, lr, momentum, rho, lam, wid):
+                contrib = sign * vals * expand_mask(mask, vals)
+                return data.at[ids].add(contrib.astype(data.dtype)), ustate
+        else:
+            def step(data, ustate, ids, vals, mask, lr, momentum, rho, lam, wid):
+                contrib = vals * expand_mask(mask, vals)
+                dense = jnp.zeros(data.shape, data.dtype).at[ids].add(
+                    contrib.astype(data.dtype))
+                option = AddOption(worker_id=wid, learning_rate=lr,
+                                   momentum=momentum, rho=rho, lam=lam)
+                return updater.apply(data, ustate, dense, option)
+
+        return jax.jit(step, donate_argnums=(0, 1),
+                       out_shardings=(self.sharding, self._ustate_sharding))
+
+    def _build_keyed_gather(self):
+        return jax.jit(lambda data, ids: data[ids])
+
+    def _default_option(self, option: Optional[AddOption]) -> AddOption:
+        option = option or AddOption(worker_id=max(self._sess.worker_id, 0))
+        if not (0 <= option.worker_id < self.num_worker_slots):
+            Log.fatal(
+                f"AddOption.worker_id {option.worker_id} out of range for "
+                f"{self.num_worker_slots} worker slot(s) on table {self.name!r}; "
+                f"pass num_sim_workers= at table creation to widen")
+        return option
+
+    def _aggregate_keyed(self, ids: np.ndarray, vals: np.ndarray):
+        """Sync (BSP) mode, >1 process: union every process's (ids, vals) so
+        each replica folds all workers' keyed deltas (the SyncServer
+        contract). Scatter-add handles the duplicate ids."""
+        if not (config.get_flag("sync") and self._sess.size > 1):
+            return ids, vals
+        from jax.experimental import multihost_utils
+
+        counts = multihost_utils.process_allgather(
+            np.array([ids.shape[0]], np.int64))
+        max_n = int(counts.max())
+        pad_i = np.zeros((max_n,), ids.dtype)
+        pad_v = np.zeros((max_n,) + vals.shape[1:], vals.dtype)
+        pad_i[: ids.shape[0]] = ids
+        pad_v[: ids.shape[0]] = vals
+        all_i = multihost_utils.process_allgather(pad_i)
+        all_v = multihost_utils.process_allgather(pad_v)
+        out_i = np.concatenate(
+            [all_i[r, : int(counts[r, 0])] for r in range(all_i.shape[0])])
+        out_v = np.concatenate(
+            [all_v[r, : int(counts[r, 0])] for r in range(all_v.shape[0])])
+        return out_i, out_v
+
+    # -- delta staging -----------------------------------------------------
+    def _stage_delta(self, delta: Any) -> jax.Array:
+        host = np.asarray(delta, dtype=self.dtype).reshape(self.shape)
+        if config.get_flag("sync") and self._sess.size > 1:
+            host = host.copy()
+            self._sess.aggregate(host)
+        return jax.device_put(host, self.sharding)
+
+    # -- public ops --------------------------------------------------------
+    def _add_handle(self) -> AsyncHandle:
+        """Waiter for a dispatched add. Later adds may donate the buffer this
+        add produced, so the handle blocks on the *current* state instead of
+        capturing a buffer — device-stream ordering guarantees this add has
+        landed by then (the per-request Waiter contract)."""
+        return AsyncHandle(callback=self.flush)
+
+    def add_async(self, delta: Any, option: Optional[AddOption] = None) -> AsyncHandle:
+        """Fold a delta into the table; returns immediately (``AddAsync``)."""
+        option = self._default_option(option)
+        staged = self._stage_delta(delta)
+        with self._lock:
+            mon = Dashboard.get_or_create(f"TABLE_ADD[{self.name}]")
+            mon.begin()
+            self._data, self._ustate = self._apply_fn(
+                self._data, self._ustate, staged,
+                *_option_scalars(option, self.dtype),
+            )
+            mon.end()
+            return self._add_handle()
+
+    def add(self, delta: Any, option: Optional[AddOption] = None) -> None:
+        """Blocking Add (``WorkerTable::Add``, ``src/table.cpp:34``)."""
+        self.add_async(delta, option).wait()
+
+    def get_async(self, option: Optional[GetOption] = None) -> AsyncHandle:
+        with self._lock:
+            # Snapshot via an async device copy: later adds donate `_data`,
+            # so the handle must own a buffer nothing else will consume.
+            snap = jnp.copy(self._data)
+        return AsyncHandle(snap, callback=lambda: np.asarray(snap))
+
+    def get(self, option: Optional[GetOption] = None) -> np.ndarray:
+        """Blocking whole-table Get -> host ndarray (``WorkerTable::Get``)."""
+        return self.get_async(option).wait()
+
+    # -- device-side view --------------------------------------------------
+    @property
+    def array(self) -> jax.Array:
+        """Zero-copy sharded device view (the idiomatic TPU read path)."""
+        with self._lock:
+            return self._data
+
+    def set_array(self, value: jax.Array) -> None:
+        """Install updated device state (used by jitted train loops that
+        thread the table state through ``parallel.sync_step``)."""
+        if value.shape != self.shape:
+            Log.fatal(f"set_array shape {value.shape} != table shape {self.shape}")
+        with self._lock:
+            self._data = jax.device_put(value, self.sharding)
+
+    def flush(self) -> None:
+        """Block until all dispatched updates have landed."""
+        with self._lock:
+            if self._data is not None:
+                jax.block_until_ready(self._data)
+
+    # -- checkpoint (``Serializable``, ``table_interface.h:59-66``) --------
+    def store(self, stream) -> None:
+        from ..io.stream import write_array
+
+        write_array(stream, self.get())
+
+    def load(self, stream) -> None:
+        from ..io.stream import read_array
+
+        host = read_array(stream)
+        if tuple(host.shape) != self.shape:
+            Log.fatal(
+                f"checkpoint shape {host.shape} != table shape {self.shape}")
+        with self._lock:
+            self._data = jax.device_put(host.astype(self.dtype), self.sharding)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 0

@@ -1,0 +1,128 @@
+"""Distributed key-value table (control-plane object).
+
+TPU-native equivalent of the reference KVTable
+(``include/multiverso/table/kv_table.h`` in the Multiverso reference): a
+distributed ``unordered_map<Key, Val>`` with ``Add`` = server-side ``+=`` and
+a worker-local result cache (``raw()``). Parameter-sized state belongs in HBM
+(see ArrayTable/MatrixTable); a KV map of scalar counters is host control
+plane, so this stays a host dict — sharding by ``key % num_servers``
+(``kv_table.h:36-43``) is replaced by one authoritative dict per process plus
+an explicit cross-process merge (``sync()``) over the coordination service.
+The reference's Store/Load stubs (``kv_table.h:100-118``) are implemented.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ..log import Log
+from ..runtime import Session
+
+
+class KVTable:
+    """Host-side accumulating KV map (``KVWorkerTable``/``KVServerTable``)."""
+
+    def __init__(self, key_dtype=np.int64, value_dtype=np.float64,
+                 name: Optional[str] = None) -> None:
+        sess = Session.get()
+        if not sess.started:
+            Log.fatal("create tables after multiverso_tpu.init()")
+        self._sess = sess
+        self.table_id = sess.register_table(self)
+        self.name = name or f"KVTable:{self.table_id}"
+        self.key_dtype = np.dtype(key_dtype)
+        self.value_dtype = np.dtype(value_dtype)
+        self._store: Dict[Any, Any] = {}
+        self._cache: Dict[Any, Any] = {}
+        self._pending: Dict[Any, Any] = {}  # adds not yet merged cross-process
+        self._lock = threading.RLock()
+
+    # -- worker API (kv_table.h:24-70) ------------------------------------
+    def add(self, keys: Iterable, values: Iterable) -> None:
+        """Server-side ``+=`` per key (``KVServerTable::ProcessAdd``)."""
+        with self._lock:
+            for k, v in zip(keys, values):
+                k = self.key_dtype.type(k).item()
+                v = self.value_dtype.type(v).item()
+                self._store[k] = self._store.get(k, 0) + v
+                self._pending[k] = self._pending.get(k, 0) + v
+
+    def get(self, keys: Iterable) -> List:
+        """Pull values into the local cache and return them in key order."""
+        with self._lock:
+            out = [self._store.get(self.key_dtype.type(k).item(), 0) for k in keys]
+            for k, v in zip(keys, out):
+                self._cache[self.key_dtype.type(k).item()] = v
+            return out
+
+    def raw(self) -> Dict[Any, Any]:
+        """Worker-local cache of previously-got entries (``kv_table.h:30``)."""
+        with self._lock:
+            return dict(self._cache)
+
+    # -- cross-process merge ----------------------------------------------
+    def sync(self) -> None:
+        """Merge every process's pending adds (replaces hash-sharded servers).
+
+        All processes must call this collectively (it is a barrier-like op).
+        """
+        with self._lock:
+            pending = dict(self._pending)
+            self._pending.clear()
+        if self._sess.size == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        keys = np.array(sorted(pending), dtype=np.int64)
+        vals = np.array([pending[k] for k in sorted(pending)], dtype=np.float64)
+        # Fixed-size exchange: gather (keys, vals) of every process; the
+        # per-rank count bounds the valid prefix (no key-value sentinels, so
+        # negative keys are legal).
+        all_counts = multihost_utils.process_allgather(
+            np.array([keys.size], np.int64))
+        max_n = max(int(all_counts.max()), 1)
+        pad_k = np.zeros((max_n,), np.int64)
+        pad_v = np.zeros((max_n,), np.float64)
+        pad_k[: keys.size] = keys
+        pad_v[: keys.size] = vals
+        all_k = multihost_utils.process_allgather(pad_k)
+        all_v = multihost_utils.process_allgather(pad_v)
+        my_rank = self._sess.rank
+        with self._lock:
+            for rank in range(all_k.shape[0]):
+                if rank == my_rank:
+                    continue
+                count = int(all_counts[rank, 0])
+                for k, v in zip(all_k[rank, :count], all_v[rank, :count]):
+                    k = int(k)
+                    self._store[k] = self._store.get(k, 0) + v
+
+    # -- checkpoint --------------------------------------------------------
+    def store(self, stream) -> None:
+        from ..io.stream import write_array
+
+        with self._lock:
+            keys = np.array(sorted(self._store), dtype=np.int64)
+            vals = np.array([self._store[k] for k in sorted(self._store)],
+                            dtype=np.float64)
+        write_array(stream, keys)
+        write_array(stream, vals)
+
+    def load(self, stream) -> None:
+        from ..io.stream import read_array
+
+        keys = read_array(stream)
+        vals = read_array(stream)
+        with self._lock:
+            self._store = {int(k): self.value_dtype.type(v).item()
+                           for k, v in zip(keys, vals)}
+
+    def flush(self) -> None:
+        pass
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store)

@@ -1,0 +1,120 @@
+"""Key-addressed sparse parameter vector + FTRL variant.
+
+TPU-native equivalent of the LogisticRegression app's custom PS tables
+(``Applications/LogisticRegression/src/util/sparse_table.h`` and
+``util/ftrl_sparse_table.h`` in the Multiverso reference; promoted here from
+app code to a framework table). The reference hash-shards a sparse vector
+over hopscotch-hash blocks per server and Gets by keyset. On TPU the feature
+dimension is static, so the natural layout is a *dense sharded vector in HBM*
+with keyed gather/scatter — "sparse" describes the access pattern (only
+touched keys move), not the storage. A hopscotch hash in HBM would serialise
+onto scalar probes; a dense vector rides the VPU.
+
+``FTRLTable`` stores the FTRL state pair ``(z, n)`` per key as a [size, 2]
+table (reference ``FTRLEntry{z, n, sqrtn}`` — ``sqrtn`` is a derived cache we
+recompute on the fly) and accumulates ``FTRLGradient{delta_z, delta_n}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..updaters import AddOption, GetOption
+from . import _rowops
+from .base import AsyncHandle, TableBase, _option_scalars
+
+
+class SparseTable(TableBase):
+    """Keyed sparse vector (``SparseWorkerTable``/``SparseServerTable``)."""
+
+    def __init__(self, size: int, dtype: Any = jnp.float32,
+                 updater: Optional[str] = None, name: Optional[str] = None,
+                 init_value: Optional[np.ndarray] = None) -> None:
+        super().__init__((int(size),), dtype=dtype, updater=updater,
+                         name=name, init_value=init_value)
+        self._key_gather = self._build_keyed_gather()
+        self._key_apply = self._build_keyed_apply(rowwise=False)
+
+    # -- keyed API (sparse_table.h:44-116) ---------------------------------
+    def get_keys(self, keys: Any, option: Optional[GetOption] = None) -> np.ndarray:
+        """``GetAsync(keys, data)``: gather values for a keyset."""
+        ids = np.asarray(keys, dtype=np.int32).ravel()
+        n = ids.shape[0]
+        size = _rowops.bucket_size(n)
+        padded, _ = _rowops.pad_ids(ids, n, size)
+        with self._lock:
+            out = self._key_gather(self._data, jnp.asarray(padded))
+        return np.asarray(out)[:n]
+
+    def add_keys_async(self, keys: Any, values: Any,
+                       option: Optional[AddOption] = None) -> AsyncHandle:
+        option = self._default_option(option)
+        ids = np.asarray(keys, dtype=np.int32).ravel()
+        vals = np.asarray(values, dtype=self.dtype).ravel()
+        ids, vals = self._aggregate_keyed(ids, vals)
+        n = ids.shape[0]
+        size = _rowops.bucket_size(n)
+        padded_ids, mask = _rowops.pad_ids(ids, n, size)
+        padded_vals = _rowops.pad_values(vals, n, size)
+        with self._lock:
+            self._data, self._ustate = self._key_apply(
+                self._data, self._ustate,
+                jnp.asarray(padded_ids), jnp.asarray(padded_vals),
+                jnp.asarray(mask), *_option_scalars(option, self.dtype),
+            )
+            return self._add_handle()
+
+    def add_keys(self, keys: Any, values: Any,
+                 option: Optional[AddOption] = None) -> None:
+        self.add_keys_async(keys, values, option).wait()
+
+
+class FTRLTable(TableBase):
+    """FTRL state table: per-key ``(z, n)`` (``ftrl_sparse_table.h:12-90``)."""
+
+    Z, N = 0, 1  # column layout
+
+    def __init__(self, size: int, dtype: Any = jnp.float32,
+                 name: Optional[str] = None) -> None:
+        # FTRL accumulation is always ``+=`` server-side (the closed-form
+        # weight reconstruction happens worker-side); force default updater.
+        super().__init__((int(size), 2), dtype=dtype, updater="default",
+                         name=name)
+        self._key_gather = self._build_keyed_gather()
+        self._key_apply = jax.jit(
+            lambda data, ids, vals, mask: data.at[ids].add(
+                (vals * mask[:, None].astype(vals.dtype)).astype(data.dtype)),
+            donate_argnums=(0,), out_shardings=self.sharding)
+
+    def get_keys(self, keys: Any) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (z, n) arrays for the keyset."""
+        ids = np.asarray(keys, dtype=np.int32).ravel()
+        n = ids.shape[0]
+        size = _rowops.bucket_size(n)
+        padded, _ = _rowops.pad_ids(ids, n, size)
+        with self._lock:
+            out = self._key_gather(self._data, jnp.asarray(padded))
+        zn = np.asarray(out)[:n]
+        return zn[:, self.Z], zn[:, self.N]
+
+    def add_keys(self, keys: Any, delta_z: Any, delta_n: Any) -> None:
+        """Accumulate ``FTRLGradient{delta_z, delta_n}`` per key."""
+        ids = np.asarray(keys, dtype=np.int32).ravel()
+        vals = np.stack([
+            np.asarray(delta_z, dtype=self.dtype).ravel(),
+            np.asarray(delta_n, dtype=self.dtype).ravel(),
+        ], axis=1)
+        ids, vals = self._aggregate_keyed(ids, vals)
+        n = ids.shape[0]
+        size = _rowops.bucket_size(n)
+        padded_ids, mask = _rowops.pad_ids(ids, n, size)
+        padded_vals = _rowops.pad_values(vals, n, size)
+        with self._lock:
+            self._data = self._key_apply(
+                self._data, jnp.asarray(padded_ids), jnp.asarray(padded_vals),
+                jnp.asarray(mask))
+        jax.block_until_ready(self._data)

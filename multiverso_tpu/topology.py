@@ -1,0 +1,191 @@
+"""Device-mesh topology discovery and process-group control plane.
+
+TPU-native replacement for the reference's Zoo/Controller node registration
+(``src/zoo.cpp:37-138``, ``src/controller.cpp:38-80`` in the Multiverso
+reference). There, every process sends a ``Control_Register`` message to rank
+0, which assigns dense worker/server ids and broadcasts the node table over
+MPI/ZMQ. Here the same facts — world size, this process's rank, which devices
+exist and how they are arranged — come from the JAX runtime: multi-host
+process groups via ``jax.distributed`` over DCN, device topology from
+``jax.devices()``, and the data plane is an SPMD ``jax.sharding.Mesh``.
+
+The logical mesh has two axes:
+
+* ``worker`` — the data-parallel axis. Gradients/deltas are summed across it
+  (the reference's "N workers each Add a delta" contract).
+* ``server`` — the table-shard axis. Parameter tables are laid out with
+  ``NamedSharding(mesh, P("server"))`` so each shard is HBM-resident on its
+  "server" devices (the reference's range-sharding of tables across server
+  nodes, ``src/table/array_table.cpp:11-22``).
+
+A third optional axis ``seq`` supports sequence/context parallelism for
+long-context workloads (ring attention in ``ops/ring_attention.py``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import config
+from .log import Log
+
+WORKER_AXIS = "worker"
+SERVER_AXIS = "server"
+SEQ_AXIS = "seq"
+
+
+@dataclass
+class Topology:
+    """Immutable snapshot of the process group + device mesh."""
+
+    mesh: "jax.sharding.Mesh"
+    process_index: int
+    process_count: int
+    devices: List["jax.Device"] = field(default_factory=list)
+
+    @property
+    def num_workers(self) -> int:
+        return int(self.mesh.shape[WORKER_AXIS])
+
+    @property
+    def num_servers(self) -> int:
+        return int(self.mesh.shape[SERVER_AXIS])
+
+    @property
+    def rank(self) -> int:
+        return self.process_index
+
+    @property
+    def size(self) -> int:
+        return self.process_count
+
+
+def _parse_mesh_shape(text: str) -> Optional[Tuple[int, ...]]:
+    text = text.strip()
+    if not text:
+        return None
+    return tuple(int(p) for p in text.split(",") if p.strip())
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = (WORKER_AXIS, SERVER_AXIS),
+    devices: Optional[Sequence] = None,
+) -> "jax.sharding.Mesh":
+    """Build a logical mesh over the (global) device set.
+
+    ``shape`` defaults to putting every device on the ``server`` axis
+    (pure table sharding, one logical worker per process group) — the
+    analogue of the reference default role ``ALL`` where each node both
+    computes and serves shards (``src/zoo.cpp:23,31``).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if shape is None:
+        shape = (1,) * (len(axis_names) - 1) + (n,)
+    shape = tuple(int(s) for s in shape)
+    if len(shape) != len(axis_names):
+        raise ValueError(f"mesh shape {shape} does not match axes {tuple(axis_names)}")
+    needed = int(np.prod(shape))
+    if needed > n:
+        raise ValueError(f"mesh shape {shape} needs {needed} devices, have {n}")
+    grid = np.asarray(devices[:needed], dtype=object).reshape(shape)
+    return Mesh(grid, axis_names=tuple(axis_names))
+
+
+def _maybe_init_distributed() -> None:
+    """Initialise the multi-host process group if the env asks for it.
+
+    Replaces MPI_Init + rank-0 registration: coordination rides DCN via the
+    JAX coordination service. Single-process runs skip this entirely.
+    """
+    # Read the env BEFORE touching any jax API: probing jax.process_count()
+    # would itself initialise the local backend, after which
+    # jax.distributed.initialize() raises.
+    coord = os.environ.get("MV_COORDINATOR_ADDRESS") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    nproc = os.environ.get("MV_NUM_PROCESSES")
+    if not (coord and nproc):
+        return
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nproc),
+            process_id=int(os.environ.get("MV_PROCESS_ID", "0")),
+        )
+    except RuntimeError as exc:
+        # Already initialised (by the launcher or a prior init()) is fine.
+        Log.debug("jax.distributed.initialize skipped: %s", exc)
+    Log.info(
+        "process group: rank %d/%d via %s",
+        jax.process_index(), jax.process_count(), coord,
+    )
+
+
+def discover(mesh_shape: Optional[Sequence[int]] = None) -> Topology:
+    """Discover the topology; the ``mesh_shape`` flag/argument overrides.
+
+    Default layout: ``worker`` axis = number of processes (each host is one
+    data-parallel worker, mirroring one-node-one-worker in the reference),
+    ``server`` axis = devices per process (tables sharded across local chips).
+    """
+    import jax
+
+    _maybe_init_distributed()
+    if mesh_shape is None:
+        mesh_shape = _parse_mesh_shape(config.get_flag("mesh_shape"))
+
+    devices = jax.devices()
+    n = len(devices)
+    if mesh_shape is None:
+        workers = jax.process_count()
+        if n % workers != 0:
+            workers = 1
+        mesh_shape = (workers, n // workers)
+
+    mesh = make_mesh(mesh_shape, devices=devices)
+    topo = Topology(
+        mesh=mesh,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        devices=devices,
+    )
+    Log.debug(
+        "topology: %d device(s), mesh %s, process %d/%d",
+        n, dict(mesh.shape), topo.process_index, topo.process_count,
+    )
+    return topo
+
+
+def barrier(name: str = "mv_barrier") -> None:
+    """Global process barrier.
+
+    Replaces the reference's rank-0 BarrierController round-trip
+    (``src/controller.cpp:16-31``): the JAX coordination service provides the
+    same rendezvous over DCN; a single-process group is a no-op.
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def sharding_for(mesh, *axes: Optional[str]):
+    """NamedSharding helper: ``sharding_for(mesh, SERVER_AXIS)`` etc."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(*axes))

@@ -1,0 +1,151 @@
+"""Server-side updaters as jitted device steps.
+
+TPU-native equivalent of the reference updater layer
+(``include/multiverso/updater/updater.h:113-132``, ``src/updater/updater.cpp``
+in the Multiverso reference). There, updaters are pluggable C++ loops
+(OpenMP-parallel over the shard) that fold a worker's delta into server
+storage. Here each updater is a pure function ``(data, state, delta, option)
+-> (data, state)`` jitted by the table layer and executed on the shard's
+device — the shard never leaves HBM, and XLA vectorises what OpenMP looped.
+
+Updater semantics (mirroring the reference formulas):
+
+* ``default`` — ``data += delta`` (``src/updater/updater.cpp:15-22``);
+  integer tables always use this (``updater.cpp:33-36``).
+* ``sgd`` — ``data -= delta``; the caller pre-scales by the learning rate
+  (``include/multiverso/updater/sgd_updater.h:9-27``).
+* ``adagrad`` — per-worker accumulators ``G[w] += delta**2``;
+  ``data -= rho / sqrt(G[w] + eps) * delta / lr``
+  (``include/multiverso/updater/adagrad_updater.h:22-40``; the reference's
+  accumulate-by-subtraction and copy-instead-of-reference bugs noted in the
+  survey are fixed here, keeping the intended formula).
+* ``momentum_sgd`` — ``s = m*s + (1-m)*delta; data -= s``
+  (``include/multiverso/updater/momentum_updater.h:17-24``).
+
+``AddOption`` / ``GetOption`` mirror ``updater.h:10-110`` with the same
+defaults (lr=.01, momentum=0, rho=.1, lambda=.1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from . import config
+from .log import Log
+
+_ADAGRAD_EPS = 1e-6
+
+
+@dataclass
+class AddOption:
+    """Per-Add hyperparameters (``updater.h:10-70``)."""
+
+    worker_id: int = 0
+    learning_rate: float = 0.01
+    momentum: float = 0.0
+    rho: float = 0.1
+    lam: float = 0.1
+
+
+@dataclass
+class GetOption:
+    """Per-Get options (``updater.h:72-110``)."""
+
+    worker_id: int = 0
+
+
+class Updater:
+    """Base updater: stateless accumulate (the ``default`` type).
+
+    ``stateless`` + ``sign`` let the table layer use a direct scatter
+    fast-path for row/key adds: when ``stateless`` is True the update is
+    ``data += sign * delta`` and needs no dense materialisation. Custom
+    subclasses default to ``stateless = False`` so their ``apply`` always
+    runs.
+    """
+
+    name = "default"
+    stateless = True
+    sign = 1.0
+
+    def init_state(self, shape: Tuple[int, ...], dtype, num_workers: int) -> Any:
+        return ()
+
+    def apply(self, data: jax.Array, state: Any, delta: jax.Array,
+              option: AddOption) -> Tuple[jax.Array, Any]:
+        return data + delta.astype(data.dtype), state
+
+    def access(self, data: jax.Array, state: Any, option: GetOption) -> jax.Array:
+        """Read path (``Updater::Access`` = memcpy, ``updater.cpp:25-29``)."""
+        return data
+
+
+class SGDUpdater(Updater):
+    name = "sgd"
+    stateless = True
+    sign = -1.0
+
+    def apply(self, data, state, delta, option):
+        return data - delta.astype(data.dtype), state
+
+
+class MomentumUpdater(Updater):
+    name = "momentum_sgd"
+    stateless = False
+
+    def init_state(self, shape, dtype, num_workers):
+        return jnp.zeros(shape, dtype=dtype)
+
+    def apply(self, data, state, delta, option):
+        m = jnp.asarray(option.momentum, dtype=data.dtype)
+        s = m * state + (1.0 - m) * delta.astype(data.dtype)
+        return data - s, s
+
+
+class AdaGradUpdater(Updater):
+    name = "adagrad"
+    stateless = False
+
+    def init_state(self, shape, dtype, num_workers):
+        return jnp.zeros((num_workers,) + tuple(shape), dtype=dtype)
+
+    def apply(self, data, state, delta, option):
+        w = option.worker_id
+        delta = delta.astype(data.dtype)
+        g_sqr = state[w] + delta * delta
+        state = state.at[w].set(g_sqr)
+        scale = jnp.asarray(option.rho, data.dtype) / jnp.sqrt(g_sqr + _ADAGRAD_EPS)
+        lr = jnp.asarray(option.learning_rate, data.dtype)
+        return data - scale * delta / lr, state
+
+
+_UPDATERS: Dict[str, Type[Updater]] = {
+    "default": Updater,
+    "sgd": SGDUpdater,
+    "adagrad": AdaGradUpdater,
+    "momentum_sgd": MomentumUpdater,
+}
+
+
+def register_updater(name: str, cls: Type[Updater]) -> None:
+    _UPDATERS[name] = cls
+
+
+def get_updater(name: Optional[str] = None, dtype=None) -> Updater:
+    """Factory keyed by the ``updater_type`` flag (``updater.cpp:33-46``).
+
+    Integer tables always get the default accumulate updater, matching the
+    reference's type-dispatch (``updater.cpp:33-36``).
+    """
+    if dtype is not None and jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        return Updater()
+    if name is None:
+        name = config.get_flag("updater_type")
+    try:
+        return _UPDATERS[name]()
+    except KeyError:
+        Log.fatal(f"unknown updater_type {name!r}; expected one of {sorted(_UPDATERS)}")

@@ -1,0 +1,44 @@
+"""Test harness: single process, 8 virtual CPU devices.
+
+Mirrors the reference test enabler (SURVEY §4): there, default role=ALL means
+one process exercises the full worker->server round-trip with no mpirun; here
+one JAX process with ``xla_force_host_platform_device_count=8`` exercises the
+full sharded-table path (worker/server mesh axes) with no TPU pod.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The container's sitecustomize may have pre-registered a TPU plugin with
+# JAX_PLATFORMS pinned to it; override at the config level too.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture()
+def mv_session():
+    """Fresh framework session per test (init -> yield -> shutdown)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.dashboard import Dashboard
+    from multiverso_tpu.runtime import Session
+
+    # Reset leftover state from a prior test's session.
+    Session._instance = None
+    Dashboard.reset()
+    mv.set_flag("sync", False)
+    mv.set_flag("ma", False)
+    mv.set_flag("updater_type", "default")
+    mv.set_flag("mesh_shape", "")
+    mv.init()
+    yield mv
+    mv.shutdown()
+    Session._instance = None

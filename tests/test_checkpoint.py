@@ -1,0 +1,58 @@
+"""Session checkpoint/restore driver tests (reference: TestCheckPoint intent)."""
+
+import numpy as np
+import pytest
+
+
+def test_save_restore_roundtrip(mv_session, tmp_path):
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+
+    arr = mv.create_table("array", 32)
+    mat = mv.create_table("matrix", 8, 4)
+    kv = mv.create_table("kv")
+    arr.add(np.full(32, 2.0, np.float32))
+    mat.add_rows([1, 3], np.ones((2, 4), np.float32))
+    kv.add([7], [1.5])
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    checkpoint.save(ckpt_dir)
+
+    arr.add(np.ones(32, np.float32))
+    mat.add(np.ones((8, 4), np.float32))
+    kv.add([7], [10.0])
+
+    checkpoint.restore(ckpt_dir)
+    np.testing.assert_allclose(arr.get(), np.full(32, 2.0))
+    expect = np.zeros((8, 4), np.float32)
+    expect[[1, 3]] = 1.0
+    np.testing.assert_allclose(mat.get(), expect)
+    assert kv.get([7]) == [1.5]
+
+
+def test_restore_missing_manifest_fatal(mv_session, tmp_path):
+    from multiverso_tpu.io import checkpoint
+    from multiverso_tpu.log import FatalError
+
+    with pytest.raises(FatalError):
+        checkpoint.restore(str(tmp_path / "nope"))
+
+
+def test_restore_type_mismatch_fatal(mv_session, tmp_path):
+    import json
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+    from multiverso_tpu.log import FatalError
+
+    mv.create_table("array", 8)
+    ckpt_dir = str(tmp_path / "ckpt")
+    checkpoint.save(ckpt_dir)
+    manifest_path = ckpt_dir + "/manifest.json"
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    manifest["tables"][0]["type"] = "MatrixTable"
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(FatalError):
+        checkpoint.restore(ckpt_dir)

@@ -1,0 +1,66 @@
+"""Flag-system tests (reference: src/util/configure.cpp behaviors)."""
+
+import pytest
+
+from multiverso_tpu import config
+
+
+def test_define_and_get_defaults():
+    config.define_int("t_int", 7, "test int")
+    config.define_string("t_str", "hello", "test str")
+    config.define_bool("t_bool", True, "test bool")
+    config.define_float("t_float", 2.5, "test float")
+    assert config.get_flag("t_int") == 7
+    assert config.get_flag("t_str") == "hello"
+    assert config.get_flag("t_bool") is True
+    assert config.get_flag("t_float") == 2.5
+
+
+def test_parse_cmd_flags_consumes_known_tokens():
+    config.define_int("t_parse_a", 1)
+    config.define_bool("t_parse_b", False)
+    config.define_string("t_parse_c", "x")
+    rest = config.parse_cmd_flags(
+        ["prog", "-t_parse_a=42", "-t_parse_b=true", "--t_parse_c=abc",
+         "-unknown=1", "positional"]
+    )
+    assert config.get_flag("t_parse_a") == 42
+    assert config.get_flag("t_parse_b") is True
+    assert config.get_flag("t_parse_c") == "abc"
+    # argv compaction: unknown/positional tokens survive
+    assert rest == ["prog", "-unknown=1", "positional"]
+
+
+def test_set_flag_coercion_and_type_safety():
+    config.define_int("t_set_i", 0)
+    config.set_flag("t_set_i", "13")
+    assert config.get_flag("t_set_i") == 13
+    with pytest.raises(config.FlagError):
+        config.set_flag("t_set_i", "not-an-int")
+    with pytest.raises(config.FlagError):
+        config.set_flag("no_such_flag", 1)
+    with pytest.raises(config.FlagError):
+        config.get_flag("no_such_flag")
+
+
+def test_bool_parse_ladder():
+    config.define_bool("t_bool2", False)
+    for text, expect in [("true", True), ("1", True), ("on", True),
+                         ("false", False), ("0", False), ("off", False)]:
+        config.set_flag("t_bool2", text)
+        assert config.get_flag("t_bool2") is expect
+
+
+def test_redefine_same_type_keeps_value():
+    config.define_int("t_redef", 5)
+    config.set_flag("t_redef", 9)
+    config.define_int("t_redef", 5)  # module reload: no clobber
+    assert config.get_flag("t_redef") == 9
+    with pytest.raises(config.FlagError):
+        config.define_string("t_redef", "x")
+
+
+def test_core_flags_registered():
+    for name in ["ps_role", "ma", "sync", "updater_type", "omp_threads",
+                 "backup_worker_ratio", "mesh_shape", "sync_frequency"]:
+        assert config.registry().known(name)

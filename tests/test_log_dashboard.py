@@ -1,0 +1,67 @@
+"""Logger + dashboard tests (reference: util/log.h, dashboard.h)."""
+
+import time
+
+import pytest
+
+from multiverso_tpu.dashboard import Dashboard, Monitor, Timer, monitor
+from multiverso_tpu.log import FatalError, Log, LogLevel, check, check_notnull
+
+
+def test_fatal_raises():
+    Log.reset_kill_fatal(False)
+    with pytest.raises(FatalError):
+        Log.fatal("boom %d", 42)
+
+
+def test_check_macros():
+    check(True)
+    with pytest.raises(FatalError):
+        check(False, "invariant broken")
+    assert check_notnull(5) == 5
+    with pytest.raises(FatalError):
+        check_notnull(None, "ptr")
+
+
+def test_log_file_sink(tmp_path):
+    path = str(tmp_path / "mv.log")
+    Log.reset_log_file(path)
+    Log.info("hello file sink")
+    Log.reset_log_file("")  # detach
+    with open(path) as f:
+        content = f.read()
+    assert "hello file sink" in content
+    assert "[INFO]" in content
+
+
+def test_timer_measures():
+    t = Timer()
+    time.sleep(0.01)
+    assert t.elapse_ms() >= 5
+
+
+def test_monitor_accumulates():
+    Dashboard.reset()
+    mon = Monitor("unit_test_mon")
+    for _ in range(3):
+        mon.begin()
+        time.sleep(0.002)
+        mon.end()
+    assert mon.count == 3
+    assert mon.total_ms > 0
+    assert abs(mon.average_ms() - mon.total_ms / 3) < 1e-9
+    assert "unit_test_mon" in Dashboard.watch("unit_test_mon")
+    stats = Dashboard.stats("unit_test_mon")
+    assert stats["count"] == 3
+
+
+def test_monitor_context_manager_and_display():
+    Dashboard.reset()
+    with monitor("span_test"):
+        time.sleep(0.002)
+    with monitor("span_test"):
+        pass
+    assert Dashboard.stats("span_test")["count"] == 2
+    text = Dashboard.display(emit=lambda *a: None)
+    assert "span_test" in text
+    assert Dashboard.watch("missing") == "[missing] not monitored"

@@ -1,0 +1,74 @@
+"""Session/topology tests (reference: Zoo start/stop, multiverso.h queries)."""
+
+import numpy as np
+import pytest
+
+
+def test_init_queries_shutdown(mv_session):
+    mv = mv_session
+    assert mv.rank() == 0
+    assert mv.size() == 1
+    assert mv.num_workers() == 1
+    assert mv.num_servers() >= 1
+    assert mv.worker_id() == 0
+    assert mv.server_id() == 0
+    assert mv.is_worker() and mv.is_server()
+    mv.barrier()  # single-process barrier is a no-op that must not hang
+
+
+def test_mesh_has_worker_and_server_axes(mv_session):
+    mesh = mv_session.session().mesh
+    assert set(mesh.axis_names) == {"worker", "server"}
+    import jax
+
+    assert int(np.prod(list(mesh.shape.values()))) == len(jax.devices())
+
+
+def test_mesh_shape_flag_override():
+    import multiverso_tpu as mv
+    from multiverso_tpu.runtime import Session
+
+    Session._instance = None
+    mv.set_flag("mesh_shape", "4,2")
+    try:
+        mv.init()
+        mesh = mv.session().mesh
+        assert mesh.shape["worker"] == 4
+        assert mesh.shape["server"] == 2
+        mv.shutdown()
+    finally:
+        mv.set_flag("mesh_shape", "")
+        Session._instance = None
+
+
+def test_aggregate_single_process_identity(mv_session):
+    data = np.arange(8, dtype=np.float32)
+    out = mv_session.aggregate(data)
+    np.testing.assert_array_equal(out, np.arange(8, dtype=np.float32))
+
+
+def test_queries_before_init_fatal():
+    import multiverso_tpu as mv
+    from multiverso_tpu.log import FatalError
+    from multiverso_tpu.runtime import Session
+
+    Session._instance = None
+    with pytest.raises(FatalError):
+        mv.rank()
+    Session._instance = None
+
+
+def test_role_flag_parsing():
+    import multiverso_tpu as mv
+    from multiverso_tpu.runtime import Session
+
+    Session._instance = None
+    mv.set_flag("ps_role", "worker")
+    try:
+        mv.init()
+        assert mv.is_worker() and not mv.is_server()
+        assert mv.server_id() == -1
+        mv.shutdown()
+    finally:
+        mv.set_flag("ps_role", "default")
+        Session._instance = None

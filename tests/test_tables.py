@@ -1,0 +1,184 @@
+"""Table tests mirroring the reference integration invariants
+(Test/main.cpp: TestArray/TestMatrix/TestKV — value == sum of workers' adds).
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+
+def test_array_accumulation_invariant(mv_session):
+    mv = mv_session
+    table = mv.create_table("array", 64)
+    iters, workers = 5, 3  # simulate 3 workers adding in turn (1-process BSP)
+    delta = np.ones(64, np.float32)
+    for _ in range(iters):
+        for w in range(workers):
+            table.add(delta)
+    np.testing.assert_allclose(table.get(), np.full(64, iters * workers, np.float32))
+
+
+def test_array_async_then_wait(mv_session):
+    table = mv_session.create_table("array", 16)
+    handles = [table.add_async(np.ones(16, np.float32)) for _ in range(4)]
+    for h in handles:
+        h.wait()
+    np.testing.assert_allclose(table.get(), np.full(16, 4.0))
+
+
+def test_array_sharded_over_server_axis(mv_session):
+    table = mv_session.create_table("array", 64)
+    servers = mv_session.num_servers()
+    spec = table.array.sharding.spec
+    if servers > 1:
+        assert spec[0] == "server"
+
+
+def test_array_init_value_and_get_into(mv_session):
+    init = np.arange(10, dtype=np.float32)
+    table = mv_session.create_table("array", 10, init_value=init)
+    out = np.zeros(10, np.float32)
+    table.get_into(out)
+    np.testing.assert_array_equal(out, init)
+
+
+def test_matrix_whole_and_row_ops(mv_session):
+    mv = mv_session
+    num_row, num_col = 16, 8
+    table = mv.create_table("matrix", num_row, num_col)
+    table.add(np.ones((num_row, num_col), np.float32))
+    rows = [0, 3, 9]
+    table.add_rows(rows, np.full((3, num_col), 2.0, np.float32))
+    got = table.get()
+    expect = np.ones((num_row, num_col), np.float32)
+    for r in rows:
+        expect[r] += 2.0
+    np.testing.assert_allclose(got, expect)
+    np.testing.assert_allclose(table.get_rows(rows), expect[rows])
+    np.testing.assert_allclose(table.get_row(3), expect[3])
+
+
+def test_matrix_duplicate_row_adds_accumulate(mv_session):
+    table = mv_session.create_table("matrix", 4, 4)
+    table.add_rows([2, 2], np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(table.get_row(2), np.full(4, 2.0))
+
+
+def test_matrix_row_bucketing_many_sizes(mv_session):
+    # exercise several pad buckets (1, 8, 9->16, 100->128)
+    table = mv_session.create_table("matrix", 128, 4)
+    for count in [1, 8, 9, 100]:
+        ids = np.arange(count) % 128
+        table.add_rows(ids, np.ones((count, 4), np.float32))
+    total = table.get().sum()
+    np.testing.assert_allclose(total, (1 + 8 + 9 + 100) * 4)
+
+
+def test_matrix_random_init_distribution(mv_session):
+    table = mv_session.create_table("matrix", 100, 50, init_value="random", seed=1)
+    got = table.get()
+    # (U[0,1)-0.5)/num_col: bounded by 0.5/50
+    assert np.all(np.abs(got) <= 0.5 / 50 + 1e-7)
+    assert np.std(got) > 0
+
+
+def test_sparse_matrix_dirty_rows(mv_session):
+    mv = mv_session
+    table = mv.create_table("matrix", 8, 4, is_sparse=True, num_sim_workers=2)
+    from multiverso_tpu.updaters import AddOption
+
+    # worker 0 adds rows 1,5 -> dirty for worker 1 only
+    table.add_rows([1, 5], np.ones((2, 4), np.float32), AddOption(worker_id=0))
+    ids0, _ = table.get_dirty_rows(0)
+    assert ids0.size == 0  # own writes aren't dirty for self
+    ids1, rows1 = table.get_dirty_rows(1)
+    np.testing.assert_array_equal(ids1, [1, 5])
+    np.testing.assert_allclose(rows1, np.ones((2, 4)))
+    # second get: bitmap cleared
+    ids1b, _ = table.get_dirty_rows(1)
+    assert ids1b.size == 0
+
+
+def test_kv_table_add_get_raw(mv_session):
+    table = mv_session.create_table("kv")
+    table.add([1, 5, 9], [1.0, 2.0, 3.0])
+    table.add([5], [2.0])
+    assert table.get([1, 5, 9, 42]) == [1.0, 4.0, 3.0, 0]
+    raw = table.raw()
+    assert raw[5] == 4.0
+    assert len(table) == 3
+    table.sync()  # single-process: no-op, must not hang
+
+
+def test_sparse_table_keyed_ops(mv_session):
+    table = mv_session.create_table("sparse", 1000)
+    keys = [3, 500, 999]
+    table.add_keys(keys, [1.0, 2.0, 3.0])
+    table.add_keys([500], [0.5])
+    np.testing.assert_allclose(table.get_keys(keys), [1.0, 2.5, 3.0])
+    # untouched keys stay zero
+    np.testing.assert_allclose(table.get_keys([0, 42]), [0.0, 0.0])
+
+
+def test_ftrl_table_zn_accumulation(mv_session):
+    table = mv_session.create_table("ftrl", 100)
+    keys = [7, 42]
+    table.add_keys(keys, delta_z=[0.1, 0.2], delta_n=[1.0, 4.0])
+    table.add_keys([7], delta_z=[0.3], delta_n=[1.0])
+    z, n = table.get_keys(keys)
+    np.testing.assert_allclose(z, [0.4, 0.2], rtol=1e-6)
+    np.testing.assert_allclose(n, [2.0, 4.0], rtol=1e-6)
+
+
+def test_table_updater_selection(mv_session):
+    mv = mv_session
+    # sgd: data -= delta
+    table = mv.create_table("array", 8, updater="sgd")
+    table.add(np.full(8, 0.5, np.float32))
+    np.testing.assert_allclose(table.get(), np.full(8, -0.5))
+    # momentum on a matrix via dense fallback
+    mt = mv.create_table("matrix", 4, 4, updater="momentum_sgd")
+    from multiverso_tpu.updaters import AddOption
+
+    mt.add_rows([1], np.ones((1, 4), np.float32), AddOption(momentum=0.0))
+    expect = np.zeros((4, 4), np.float32)
+    expect[1] = -1.0
+    np.testing.assert_allclose(mt.get(), expect)
+
+
+def test_store_load_roundtrip(mv_session):
+    mv = mv_session
+    table = mv.create_table("matrix", 8, 4)
+    table.add(np.random.default_rng(0).random((8, 4)).astype(np.float32))
+    buf = io.BytesIO()
+    table.store(buf)
+    snapshot = table.get()
+    table.add(np.ones((8, 4), np.float32))  # mutate
+    buf.seek(0)
+    table.load(buf)
+    np.testing.assert_allclose(table.get(), snapshot)
+
+    kv = mv.create_table("kv")
+    kv.add([1, 2], [5.0, 6.0])
+    buf2 = io.BytesIO()
+    kv.store(buf2)
+    kv.add([1], [1.0])
+    buf2.seek(0)
+    kv.load(buf2)
+    assert kv.get([1, 2]) == [5.0, 6.0]
+
+
+def test_integer_table_forced_default_updater(mv_session):
+    import jax.numpy as jnp
+
+    table = mv_session.create_table("array", 8, dtype=jnp.int32, updater="sgd")
+    table.add(np.full(8, 3, np.int32))
+    np.testing.assert_array_equal(table.get(), np.full(8, 3, np.int32))
+
+
+def test_create_table_unknown_kind(mv_session):
+    from multiverso_tpu.log import FatalError
+
+    with pytest.raises(FatalError):
+        mv_session.create_table("nope")

@@ -1,0 +1,72 @@
+"""Updater formula tests vs reference semantics (SURVEY §2.3)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multiverso_tpu.updaters import (AddOption, AdaGradUpdater, MomentumUpdater,
+                                     SGDUpdater, Updater, get_updater)
+
+_ADAGRAD_EPS = 1e-6
+
+
+def _run(updater, data, deltas, option, num_workers=1):
+    data = jnp.asarray(data)
+    state = updater.init_state(data.shape, data.dtype, num_workers)
+    for d in deltas:
+        data, state = updater.apply(data, state, jnp.asarray(d), option)
+    return np.asarray(data), state
+
+
+def test_default_accumulates():
+    data, _ = _run(Updater(), np.zeros(4, np.float32),
+                   [np.full(4, 2.0, np.float32)] * 3, AddOption())
+    np.testing.assert_allclose(data, np.full(4, 6.0))
+
+
+def test_sgd_subtracts_prescaled_delta():
+    # sgd_updater.h: data -= delta (caller pre-scales by lr)
+    data, _ = _run(SGDUpdater(), np.ones(4, np.float32),
+                   [np.full(4, 0.25, np.float32)] * 2, AddOption())
+    np.testing.assert_allclose(data, np.full(4, 0.5))
+
+
+def test_momentum_ema():
+    # momentum_updater.h:17-24: s = m*s + (1-m)*delta; data -= s
+    m = 0.5
+    opt = AddOption(momentum=m)
+    deltas = [np.full(3, 1.0, np.float32), np.full(3, 2.0, np.float32)]
+    data, state = _run(MomentumUpdater(), np.zeros(3, np.float32), deltas, opt)
+    s1 = (1 - m) * 1.0
+    s2 = m * s1 + (1 - m) * 2.0
+    np.testing.assert_allclose(data, np.full(3, -(s1 + s2)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(state), np.full(3, s2), rtol=1e-6)
+
+
+def test_adagrad_per_worker_accumulators():
+    # adagrad_updater.h:22-40: G_w += d^2; data -= rho/sqrt(G_w+eps) * d/lr
+    opt0 = AddOption(worker_id=0, learning_rate=0.1, rho=0.2)
+    opt1 = AddOption(worker_id=1, learning_rate=0.1, rho=0.2)
+    upd = AdaGradUpdater()
+    data = jnp.zeros(2, jnp.float32)
+    state = upd.init_state((2,), jnp.float32, num_workers=2)
+    d = jnp.full(2, 0.5, jnp.float32)
+    data, state = upd.apply(data, state, d, opt0)
+    data, state = upd.apply(data, state, d, opt1)
+    g = 0.25
+    expect_step = 0.2 / np.sqrt(g + _ADAGRAD_EPS) * 0.5 / 0.1
+    np.testing.assert_allclose(np.asarray(data), np.full(2, -2 * expect_step), rtol=1e-5)
+    # accumulators are per worker, not shared
+    np.testing.assert_allclose(np.asarray(state), np.full((2, 2), g), rtol=1e-6)
+
+
+def test_factory_dispatch_and_integer_override():
+    assert isinstance(get_updater("sgd"), SGDUpdater)
+    assert isinstance(get_updater("adagrad"), AdaGradUpdater)
+    assert isinstance(get_updater("momentum_sgd"), MomentumUpdater)
+    # integer tables always use default accumulate (updater.cpp:33-36)
+    assert type(get_updater("sgd", dtype=jnp.int32)) is Updater
+    from multiverso_tpu.log import FatalError
+
+    with pytest.raises(FatalError):
+        get_updater("nope")
