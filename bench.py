@@ -1,0 +1,90 @@
+"""Benchmark: WordEmbedding training throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: word2vec skip-gram negative-sampling training pairs/sec on a
+synthetic zipf corpus — the throughput form of the reference's
+words/thread/sec log (``Applications/WordEmbedding/src/trainer.cpp:45-48``).
+``vs_baseline`` is the ratio against 1.0M pairs/sec, the ballpark of the
+reference C++ implementation's per-host throughput on its published hardware
+(the reference logs the metric but publishes no numbers — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_BASELINE_PAIRS_PER_SEC = 1_000_000.0
+
+
+def make_corpus(path: str, n_words: int = 400_000, vocab: int = 5000,
+                seed: int = 5) -> None:
+    rng = np.random.default_rng(seed)
+    # zipf-ish unigram distribution over a closed vocab
+    ranks = np.arange(1, vocab + 1)
+    probs = 1.0 / ranks
+    probs /= probs.sum()
+    words = rng.choice(vocab, size=n_words, p=probs)
+    with open(path, "w") as f:
+        for i in range(0, n_words, 1000):
+            f.write(" ".join(f"w{w}" for w in words[i:i + 1000]) + "\n")
+
+
+def main() -> int:
+    import multiverso_tpu as mv
+    from multiverso_tpu.apps.wordembedding import (Dictionary, encode_corpus,
+                                                   subsample_probs)
+    from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
+
+    corpus = "/tmp/mv_bench_corpus.txt"
+    if not os.path.exists(corpus):
+        make_corpus(corpus)
+
+    mv.init(["bench", "-log_level=error"])
+    dictionary = Dictionary.build(corpus, min_count=1)
+    cfg = Word2VecConfig(vocab_size=dictionary.vocab_size, embedding_size=256,
+                         window=5, negative=5, init_lr=0.025, batch_size=32768)
+    w_in = mv.create_table("matrix", dictionary.vocab_size, cfg.embedding_size,
+                           init_value="random")
+    w_out = mv.create_table("matrix", dictionary.vocab_size, cfg.embedding_size)
+    model = Word2Vec(cfg, w_in, w_out,
+                     counts=np.asarray(dictionary.counts, np.float64))
+    model.total_words = 10 ** 9
+
+    # device-resident corpus: upload once, sample+train on device
+    ids, sent_ids = encode_corpus(corpus, dictionary)
+    discard = subsample_probs(np.asarray(dictionary.counts, np.float64),
+                              1e-3).astype(np.float32)
+    model.load_corpus_chunk(ids, sent_ids, discard)
+
+    steps_per_call = 50
+    loss, count = model.train_device_steps(steps_per_call)  # compile
+    float(loss)
+
+    iters = 20
+    counts = []
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, count = model.train_device_steps(steps_per_call)
+        counts.append(count)
+    pairs = float(np.sum([float(c) for c in counts]))  # blocks on final
+    elapsed = time.perf_counter() - t0
+    mv.shutdown()
+
+    value = pairs / elapsed
+    print(json.dumps({
+        "metric": "word2vec_train_pairs_per_sec",
+        "value": round(value, 1),
+        "unit": "pairs/sec",
+        "vs_baseline": round(value / _BASELINE_PAIRS_PER_SEC, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+"""Reference applications: distributed word2vec + logistic regression."""

@@ -1,0 +1,219 @@
+"""Logistic-regression application: config-file driven train/test.
+
+TPU-native re-build of the reference LogisticRegression app driver
+(``Applications/LogisticRegression/src/logreg.cpp`` in the Multiverso
+reference): key=value config file (``LR/src/configure.h:9-93``), libsvm /
+dense text readers (``LR/src/reader.cpp:169``), epoch loop with minibatch
+updates, test accuracy, model save/load. With ``pipeline=true`` the
+reference's background ``SampleReader`` thread (``LR/src/reader.cpp:128``)
+maps to ``parallel.prefetch_iterator``: parsing runs ahead on a loader
+thread, overlapping device steps; ``sync_frequency=N`` makes the sparse
+model refresh its pulled weights every N minibatches
+(``PSModel::DoesNeedSync``, ``ps_model.cpp:172``).
+
+Usage: ``python -m multiverso_tpu.apps.logreg train.config``
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..io.stream import TextReader, open_stream
+from ..log import Log
+from ..models.logreg import FTRLLogReg, LogReg, LogRegConfig, SparseLogReg
+from ..parallel import prefetch_iterator
+
+
+def parse_config(path: str) -> dict:
+    """key=value config file (reference ``Configure``, ``LR/src/configure.cpp``)."""
+    out = {}
+    with TextReader(path) as reader:
+        for line in reader:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            key, _, value = line.partition("=")
+            out[key.strip()] = value.strip()
+    return out
+
+
+def config_from_dict(d: dict) -> LogRegConfig:
+    cfg = LogRegConfig()
+    casts = {
+        "input_size": int, "output_size": int, "minibatch_size": int,
+        "sync_frequency": int, "learning_rate": float,
+        "learning_rate_coef": float, "regular_coef": float,
+        "ftrl_alpha": float, "ftrl_beta": float,
+        "ftrl_lambda1": float, "ftrl_lambda2": float,
+    }
+    for key, value in d.items():
+        if key in ("objective_type", "regular_type"):
+            setattr(cfg, key, value)
+        elif key in ("sparse", "pipeline"):
+            setattr(cfg, key, value.lower() in ("1", "true", "yes"))
+        elif key in casts:
+            setattr(cfg, key, casts[key](value))
+    return cfg
+
+
+def parse_sample(line: str, sparse: bool, input_size: int
+                 ) -> Tuple[float, np.ndarray, np.ndarray]:
+    """libsvm ``label k:v k:v`` (sparse) or ``label v v v`` (dense) —
+    reference ``SampleReader::ParseLine`` (``LR/src/reader.cpp:169``)."""
+    parts = line.split()
+    label = float(parts[0])
+    if sparse:
+        keys, vals = [], []
+        for tok in parts[1:]:
+            k, _, v = tok.partition(":")
+            keys.append(int(k))
+            vals.append(float(v) if v else 1.0)
+        return label, np.asarray(keys, np.int64), np.asarray(vals, np.float64)
+    vals = np.zeros(input_size, np.float32)
+    dense = [float(t) for t in parts[1:]]
+    vals[: len(dense)] = dense
+    return label, np.arange(len(dense), dtype=np.int64), vals
+
+
+def iter_samples(path: str, sparse: bool, input_size: int):
+    with TextReader(path) as reader:
+        for line in reader:
+            if line.strip():
+                yield parse_sample(line, sparse, input_size)
+
+
+def iter_dense_minibatches(path: str, cfg: LogRegConfig
+                           ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Fixed-size [B, input] / [B, output] batches for the dense jitted path."""
+    xs, ys = [], []
+    for label, _, values in iter_samples(path, False, cfg.input_size):
+        xs.append(values)
+        if cfg.output_size == 1:
+            ys.append([label])
+        else:
+            onehot = np.zeros(cfg.output_size, np.float32)
+            onehot[int(label)] = 1.0
+            ys.append(onehot)
+        if len(xs) == cfg.minibatch_size:
+            yield np.stack(xs), np.asarray(ys, np.float32)
+            xs, ys = [], []
+    if xs:
+        yield np.stack(xs), np.asarray(ys, np.float32)
+
+
+def build_model(cfg: LogRegConfig):
+    """Table + model factory (reference ``Model::Get``/``PSModel`` ctor,
+    ``LR/src/model/model.cpp:212``, ``ps_model.cpp:13-67``)."""
+    import multiverso_tpu as mv
+
+    if cfg.objective_type == "ftrl":
+        table = mv.create_table("ftrl", cfg.input_size + 1, name="logreg_ftrl")
+        return FTRLLogReg(cfg, table)
+    if cfg.sparse:
+        table = mv.create_table("sparse", cfg.input_size + 1, updater="sgd",
+                                name="logreg_sparse")
+        return SparseLogReg(cfg, table)
+    table = mv.create_table("matrix", cfg.output_size, cfg.input_size + 1,
+                            updater="sgd", name="logreg_weights")
+    return LogReg(cfg, table)
+
+
+def train_file(model, cfg: LogRegConfig, path: str, epochs: int = 1,
+               log_every: int = 100) -> float:
+    """Epoch loop (reference ``LogReg::Train``, ``LR/src/logreg.cpp:40``)."""
+    loss = 0.0
+    for epoch in range(epochs):
+        if isinstance(model, LogReg):
+            batches = iter_dense_minibatches(path, cfg)
+            if cfg.pipeline:
+                batches = prefetch_iterator(batches, depth=4)
+            for i, (x, y) in enumerate(batches):
+                loss = model.train_minibatch(x, y)
+                if log_every and (i + 1) % log_every == 0:
+                    Log.info("epoch %d batch %d loss %.4f", epoch, i + 1,
+                             float(loss))
+            loss = float(loss)
+        elif isinstance(model, SparseLogReg):
+            batch: List = []
+            samples = iter_samples(path, True, cfg.input_size)
+            if cfg.pipeline:
+                samples = prefetch_iterator(samples, depth=4 * cfg.minibatch_size)
+            for label, keys, values in samples:
+                batch.append((keys, values, label))
+                if len(batch) == cfg.minibatch_size:
+                    loss = model.train_minibatch(batch)
+                    batch = []
+            if batch:
+                loss = model.train_minibatch(batch)
+        else:  # FTRL: per-sample proximal updates
+            for label, keys, values in iter_samples(path, True, cfg.input_size):
+                loss = model.train_sample(keys, values, label)
+    return float(loss)
+
+
+def test_file(model, cfg: LogRegConfig, path: str) -> float:
+    """Accuracy over a test file (reference ``LogReg::Test``)."""
+    if isinstance(model, LogReg):
+        correct = total = 0
+        for x, y in iter_dense_minibatches(path, cfg):
+            preds = model.predict(x)
+            if cfg.output_size == 1:
+                correct += int((((preds[:, 0] > 0.5) == (y[:, 0] > 0.5))).sum())
+            else:
+                correct += int((preds.argmax(-1) == y.argmax(-1)).sum())
+            total += x.shape[0]
+        return correct / max(total, 1)
+    correct = total = 0
+    for label, keys, values in iter_samples(path, True, cfg.input_size):
+        pred = model.predict_sample(keys, values)
+        correct += int((pred > 0.5) == (label > 0.5))
+        total += 1
+    return correct / max(total, 1)
+
+
+def save_model(model, path: str) -> None:
+    with open_stream(path, "wb") as stream:
+        model.table.store(stream)
+
+
+def load_model(model, path: str) -> None:
+    with open_stream(path, "rb") as stream:
+        model.table.load(stream)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import multiverso_tpu as mv
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("usage: logreg <config-file>")
+        return 2
+    import os
+
+    if not os.path.exists(argv[0]):
+        print(f"logreg: config file not found: {argv[0]}")
+        return 2
+    conf = parse_config(argv[0])
+    mv.init(argv[1:])
+    cfg = config_from_dict(conf)
+    model = build_model(cfg)
+    if conf.get("init_model_file"):
+        load_model(model, conf["init_model_file"])
+    if conf.get("train_file"):
+        epochs = int(conf.get("train_epoch", "1"))
+        loss = train_file(model, cfg, conf["train_file"], epochs=epochs)
+        Log.info("final train loss: %.4f", loss)
+    if conf.get("test_file"):
+        acc = test_file(model, cfg, conf["test_file"])
+        Log.info("test accuracy: %.4f", acc)
+    if conf.get("output_model_file"):
+        save_model(model, conf["output_model_file"])
+    mv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,436 @@
+"""Distributed word2vec application.
+
+TPU-native re-build of the reference WordEmbedding app
+(``Applications/WordEmbedding/src/distributed_wordembedding.cpp`` in the
+Multiverso reference): dictionary build, subsampling, skip-gram/CBOW pair
+generation, epoch loop with the words/sec throughput log (the north-star
+metric, ``WE/src/trainer.cpp:45-48``), and embedding save. The reference's
+block data pipeline (loader thread -> BlockQueue -> per-block row pulls,
+``distributed_wordembedding.cpp:33-62``) maps to a host-side batch generator
+feeding fixed-shape device batches, run ahead on a loader thread
+(``parallel.prefetch_iterator``) so pair generation overlaps device steps —
+and for maximum throughput the corpus can live in HBM entirely
+(``Word2Vec.load_corpus_chunk`` + ``train_device_steps``).
+
+CLI mirrors the reference options (``WE/src/util.cpp``):
+``python -m multiverso_tpu.apps.wordembedding -train_file corpus.txt
+-output vec.txt -size 100 -window 5 -negative 5 -epoch 1 ...``
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from collections import Counter
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..dashboard import Dashboard
+from ..io.stream import TextReader
+from ..log import Log
+from ..models.word2vec import (HuffmanCodes, Word2Vec, Word2VecConfig,
+                               build_huffman)
+
+
+class Dictionary:
+    """Vocab with counts + id mapping (reference ``WE/src/dictionary.cpp``)."""
+
+    def __init__(self, min_count: int = 5) -> None:
+        self.min_count = min_count
+        self.word2id = {}
+        self.words: List[str] = []
+        self.counts: List[int] = []
+
+    @classmethod
+    def build(cls, corpus_path: str, min_count: int = 5) -> "Dictionary":
+        counter: Counter = Counter()
+        with TextReader(corpus_path) as reader:
+            for line in reader:
+                counter.update(line.split())
+        d = cls(min_count)
+        for word, count in counter.most_common():
+            if count < min_count:
+                break
+            d.word2id[word] = len(d.words)
+            d.words.append(word)
+            d.counts.append(count)
+        return d
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.words)
+
+    @property
+    def train_words(self) -> int:
+        return int(sum(self.counts))
+
+    def encode(self, tokens: List[str]) -> List[int]:
+        w2i = self.word2id
+        return [w2i[t] for t in tokens if t in w2i]
+
+
+def subsample_probs(counts: np.ndarray, sample: float) -> np.ndarray:
+    """Word-discard probabilities (reference sub-sampling formula)."""
+    if sample <= 0:
+        return np.zeros(counts.shape[0], np.float64)
+    total = counts.sum()
+    freq = counts / total
+    keep = (np.sqrt(freq / sample) + 1) * (sample / np.maximum(freq, 1e-12))
+    return np.clip(1.0 - keep, 0.0, 1.0)
+
+
+def _pairs_from_chunk(ids: np.ndarray, sent_ids: np.ndarray, window: int,
+                      rng) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorised skip-gram pair generation over a word chunk.
+
+    ``ids`` is the concatenation of (subsampled) sentences, ``sent_ids``
+    marks sentence membership so windows never cross boundaries. Per-center
+    random window shrink matches the reference trainer's
+    ``rand % window + 1`` behavior. Returns (centers, contexts, mask).
+    """
+    n = ids.shape[0]
+    if n < 2:
+        return (np.empty(0, np.int32), np.empty(0, np.int32),
+                np.empty(0, np.float32))
+    shrink = rng.integers(1, window + 1, size=n)
+    centers_parts, contexts_parts = [], []
+    for d in range(1, window + 1):
+        same_sent = sent_ids[:-d] == sent_ids[d:]
+        # forward pairs: center i, context i+d (center's window covers d)
+        fwd = same_sent & (shrink[:-d] >= d)
+        centers_parts.append(ids[:-d][fwd])
+        contexts_parts.append(ids[d:][fwd])
+        # backward pairs: center i+d, context i
+        bwd = same_sent & (shrink[d:] >= d)
+        centers_parts.append(ids[d:][bwd])
+        contexts_parts.append(ids[:-d][bwd])
+    centers = np.concatenate(centers_parts).astype(np.int32)
+    contexts = np.concatenate(contexts_parts).astype(np.int32)
+    perm = rng.permutation(centers.shape[0])
+    return (centers[perm], contexts[perm],
+            np.ones(centers.shape[0], np.float32))
+
+
+def _cbow_from_chunk(ids: np.ndarray, sent_ids: np.ndarray, window: int,
+                     rng) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorised CBOW example generation: one example per center word with
+    its (shrunk) window as context slots. Returns
+    (centers [N], contexts [N, 2W], cmask [N, 2W])."""
+    n = ids.shape[0]
+    W = window
+    if n < 2:
+        return (np.empty(0, np.int32), np.empty((0, 2 * W), np.int32),
+                np.empty((0, 2 * W), np.float32))
+    shrink = rng.integers(1, W + 1, size=n)
+    offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
+    pos = np.arange(n)
+    ctx = pos[:, None] + offsets[None, :]
+    in_range = (ctx >= 0) & (ctx < n)
+    ctx_c = np.clip(ctx, 0, n - 1)
+    in_window = np.abs(offsets)[None, :] <= shrink[:, None]
+    valid = in_range & in_window & (sent_ids[ctx_c] == sent_ids[pos][:, None])
+    keep_rows = valid.any(axis=1)
+    centers = ids[pos[keep_rows]].astype(np.int32)
+    contexts = ids[ctx_c[keep_rows]].astype(np.int32)
+    cmask = valid[keep_rows].astype(np.float32)
+    perm = rng.permutation(centers.shape[0])
+    return centers[perm], contexts[perm], cmask[perm]
+
+
+def iter_pair_batches(
+    corpus_path: str,
+    dictionary: Dictionary,
+    window: int,
+    batch_size: int,
+    sample: float = 1e-3,
+    seed: int = 11,
+    cbow: bool = False,
+    chunk_words: int = 1 << 20,
+    progress: Optional[dict] = None,
+) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Yield fixed-size (centers, contexts, mask) batches.
+
+    Skip-gram: contexts/mask are [B]; CBOW: [B, 2*window] with per-slot
+    validity. Replaces the reference's loader-thread/BlockQueue pipeline
+    (``distributed_wordembedding.cpp:33-62``) with chunked vectorised numpy
+    generation: sentences accumulate into ~``chunk_words`` word chunks,
+    examples for a whole chunk are produced by array ops (no per-word Python
+    loop), then sliced into fixed-size device batches.
+
+    ``progress``, if given, is updated in place: ``progress["words"]`` counts
+    corpus words consumed so far (pre-subsampling — the reference's
+    ``word_count`` semantics) for exact lr-decay tracking.
+    """
+    rng = np.random.default_rng(seed)
+    discard = subsample_probs(np.asarray(dictionary.counts, np.float64), sample)
+    vocab_lookup = dictionary.word2id
+    from_chunk = _cbow_from_chunk if cbow else _pairs_from_chunk
+    chunk_ids: List[np.ndarray] = []
+    chunk_sents: List[np.ndarray] = []
+    chunk_len = 0
+    sent_counter = 0
+    leftovers: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+    leftover_len = 0
+
+    def flush_chunk():
+        nonlocal chunk_ids, chunk_sents, chunk_len, leftover_len
+        if not chunk_ids:
+            return
+        ids = np.concatenate(chunk_ids)
+        sents = np.concatenate(chunk_sents)
+        chunk_ids, chunk_sents, chunk_len = [], [], 0
+        example = from_chunk(ids, sents, window, rng)
+        leftovers.append(example)
+        leftover_len += example[0].shape[0]
+
+    def drain(final: bool):
+        nonlocal leftovers, leftover_len
+        if leftover_len == 0:
+            return
+        if not final and leftover_len < batch_size:
+            return
+        centers = np.concatenate([e[0] for e in leftovers])
+        contexts = np.concatenate([e[1] for e in leftovers])
+        masks = np.concatenate([e[2] for e in leftovers])
+        full = (centers.shape[0] // batch_size) * batch_size
+        for i in range(0, full, batch_size):
+            yield (centers[i:i + batch_size], contexts[i:i + batch_size],
+                   masks[i:i + batch_size])
+        rest = (centers[full:], contexts[full:], masks[full:])
+        if final and rest[0].shape[0]:
+            n_rest = rest[0].shape[0]
+            pad = batch_size - n_rest
+            yield (
+                np.concatenate([rest[0], np.zeros(pad, np.int32)]),
+                np.concatenate(
+                    [rest[1],
+                     np.zeros((pad,) + rest[1].shape[1:], np.int32)]),
+                np.concatenate(
+                    [rest[2],
+                     np.zeros((pad,) + rest[2].shape[1:], np.float32)]),
+            )
+            leftovers, leftover_len = [], 0
+        else:
+            leftovers = [rest]
+            leftover_len = rest[0].shape[0]
+
+    with TextReader(corpus_path) as reader:
+        for line in reader:
+            tokens = line.split()
+            arr = np.asarray([vocab_lookup[t] for t in tokens
+                              if t in vocab_lookup], dtype=np.int32)
+            if progress is not None:
+                progress["words"] = progress.get("words", 0) + int(arr.size)
+            if sample > 0 and arr.size:
+                keep = rng.random(arr.shape[0]) >= discard[arr]
+                arr = arr[keep]
+            if arr.size < 2:
+                continue
+            chunk_ids.append(arr)
+            chunk_sents.append(np.full(arr.shape[0], sent_counter, np.int32))
+            sent_counter += 1
+            chunk_len += arr.shape[0]
+            if chunk_len >= chunk_words:
+                flush_chunk()
+                yield from drain(final=False)
+    flush_chunk()
+    yield from drain(final=True)
+
+
+def encode_corpus(corpus_path: str, dictionary: Dictionary
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Encode a corpus to (word ids, sentence ids) arrays for upload to HBM
+    (the device-resident fast path, ``Word2Vec.load_corpus_chunk``)."""
+    ids_parts: List[np.ndarray] = []
+    sent_parts: List[np.ndarray] = []
+    lookup = dictionary.word2id
+    with TextReader(corpus_path) as reader:
+        for si, line in enumerate(reader):
+            arr = np.asarray([lookup[t] for t in line.split() if t in lookup],
+                             dtype=np.int32)
+            if arr.size < 2:
+                continue
+            ids_parts.append(arr)
+            sent_parts.append(np.full(arr.shape[0], si, np.int32))
+    if not ids_parts:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    return np.concatenate(ids_parts), np.concatenate(sent_parts)
+
+
+@dataclass
+class TrainResult:
+    words_trained: int        # corpus words seen (reference word_count_actual)
+    pairs_trained: int        # (center, context) training pairs
+    elapsed_s: float
+    words_per_sec: float
+    pairs_per_sec: float
+    final_loss: float
+
+
+def train(
+    corpus_path: str,
+    output_path: Optional[str] = None,
+    cfg: Optional[Word2VecConfig] = None,
+    epochs: int = 1,
+    min_count: int = 5,
+    sample: float = 1e-3,
+    dictionary: Optional[Dictionary] = None,
+    log_every: int = 200,
+) -> TrainResult:
+    """Full training driver (reference ``TrainNeuralNetwork``,
+    ``distributed_wordembedding.cpp:146``)."""
+    import multiverso_tpu as mv
+
+    cfg = cfg or Word2VecConfig()
+    if dictionary is None:
+        Log.info("building dictionary from %s ...", corpus_path)
+        dictionary = Dictionary.build(corpus_path, min_count=min_count)
+    vocab = dictionary.vocab_size
+    if vocab == 0:
+        Log.fatal(f"empty vocabulary from {corpus_path}")
+    cfg.vocab_size = vocab
+    counts = np.asarray(dictionary.counts, np.float64)
+    Log.info("vocab %d, train words %d", vocab, dictionary.train_words)
+
+    # The same two tables the reference allocates (WE/src/communicator.cpp:17-33);
+    # AdaGrad G state lives model-side when cfg.use_adagrad.
+    input_table = mv.create_table(
+        "matrix", vocab, cfg.embedding_size, init_value="random",
+        seed=cfg.seed, name="word2vec_input")
+    output_table = mv.create_table(
+        "matrix", vocab, cfg.embedding_size, name="word2vec_output")
+    # word-count bookkeeping table (reference KV wordcount table)
+    wordcount_table = mv.create_table("kv", name="word2vec_wordcount")
+
+    huffman = build_huffman(counts, cfg.max_code_length) if cfg.hs else None
+    model = Word2Vec(cfg, input_table, output_table, counts=counts,
+                     huffman=huffman)
+    model.total_words = dictionary.train_words * max(epochs, 1)
+
+    def batch_examples(mask: np.ndarray) -> int:
+        if cfg.cbow:
+            return int((mask.sum(axis=-1) > 0).sum())
+        return int(mask.sum())
+
+    pairs = 0
+    loss = 0.0
+    t0 = time.perf_counter()
+    mon = Dashboard.get_or_create("W2V_TRAIN_BATCH")
+    group = max(1, cfg.steps_per_call)
+    from ..parallel import prefetch_iterator
+
+    for epoch in range(epochs):
+        progress = {"words": 0}
+        # loader-thread overlap: batch generation runs ahead on a bg thread
+        batches = prefetch_iterator(
+            iter_pair_batches(corpus_path, dictionary, cfg.window,
+                              cfg.batch_size, sample=sample, cbow=cfg.cbow,
+                              seed=cfg.seed + epoch, progress=progress),
+            depth=2 * group)
+        pending = []
+        for step_idx, batch in enumerate(batches):
+            pending.append(batch)
+            if len(pending) < group:
+                continue
+            mon.begin()
+            if group == 1:
+                loss = model.train_batch(*pending[0])
+            else:
+                loss = model.train_batches(
+                    np.stack([b[0] for b in pending]),
+                    np.stack([b[1] for b in pending]),
+                    np.stack([b[2] for b in pending]))
+            pairs += sum(batch_examples(b[2]) for b in pending)
+            pending = []
+            mon.end()
+            # exact lr-decay progress in word units (reference word_count)
+            model.set_words_trained(
+                epoch * dictionary.train_words + progress["words"])
+            if log_every and (step_idx + 1) % log_every == 0:
+                elapsed = time.perf_counter() - t0
+                Log.info(
+                    "epoch %d step %d: %.0f pairs/sec, lr %.5f, loss %.4f",
+                    epoch, step_idx + 1, pairs / elapsed, model.current_lr(),
+                    float(loss))
+        for centers, contexts, mask in pending:  # tail batches, one dispatch each
+            loss = model.train_batch(centers, contexts, mask)
+            pairs += batch_examples(mask)
+        wordcount_table.add([0], [dictionary.train_words])
+        mv.barrier()
+    final_loss = float(loss)
+    elapsed = time.perf_counter() - t0
+
+    if output_path and mv.rank() == 0:
+        save_embeddings(output_path, dictionary, input_table.get())
+    # words/sec counts corpus words (reference word_count_actual semantics,
+    # WE/src/trainer.cpp:45-48); pairs/sec counts device training examples.
+    words = dictionary.train_words * epochs
+    result = TrainResult(words_trained=words, pairs_trained=pairs,
+                         elapsed_s=elapsed,
+                         words_per_sec=words / max(elapsed, 1e-9),
+                         pairs_per_sec=pairs / max(elapsed, 1e-9),
+                         final_loss=final_loss)
+    Log.info("trained %d words (%d pairs) in %.1fs: %.0f words/sec, %.0f pairs/sec",
+             words, pairs, result.elapsed_s, result.words_per_sec,
+             result.pairs_per_sec)
+    return result
+
+
+def save_embeddings(path: str, dictionary: Dictionary,
+                    vectors: np.ndarray) -> None:
+    """word2vec text format (reference SaveEmbedding,
+    ``distributed_wordembedding.cpp:260-328``)."""
+    with open(path, "w") as f:
+        f.write(f"{dictionary.vocab_size} {vectors.shape[1]}\n")
+        for i, word in enumerate(dictionary.words):
+            vec = " ".join(f"{x:.6f}" for x in vectors[i])
+            f.write(f"{word} {vec}\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import multiverso_tpu as mv
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    def opt(name, default, cast=str):
+        flag = f"-{name}"
+        if flag in argv:
+            i = argv.index(flag)
+            val = cast(argv[i + 1])
+            del argv[i:i + 2]
+            return val
+        return default
+
+    train_file = opt("train_file", "")
+    output = opt("output", "embeddings.txt")
+    size = opt("size", 100, int)
+    window = opt("window", 5, int)
+    negative = opt("negative", 5, int)
+    hs = bool(opt("hs", 0, int))
+    cbow = bool(opt("cbow", 0, int))
+    epochs = opt("epoch", 1, int)
+    min_count = opt("min_count", 5, int)
+    sample = opt("sample", 1e-3, float)
+    lr = opt("lr", 0.025, float)
+    batch = opt("batch_size", 1024, int)
+    adagrad = bool(opt("use_adagrad", 0, int))
+    if not train_file:
+        print("usage: wordembedding -train_file FILE [-output F] [-size N] "
+              "[-window N] [-negative N] [-hs 0|1] [-cbow 0|1] [-epoch N] "
+              "[-min_count N] [-sample F] [-lr F] [-batch_size N] "
+              "[-use_adagrad 0|1]")
+        return 2
+    mv.init(argv)
+    cfg = Word2VecConfig(embedding_size=size, window=window, negative=negative,
+                         hs=hs, cbow=cbow, init_lr=lr, batch_size=batch,
+                         use_adagrad=adagrad)
+    train(train_file, output, cfg, epochs=epochs, min_count=min_count,
+          sample=sample)
+    mv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Model families: word2vec (skip-gram/CBOW) and logistic regression/FTRL."""
+
+from .logreg import FTRLLogReg, LogReg, LogRegConfig, SparseLogReg
+from .word2vec import (HuffmanCodes, Word2Vec, Word2VecConfig,
+                       build_huffman, build_unigram_alias)
+
+__all__ = [
+    "FTRLLogReg",
+    "LogReg",
+    "LogRegConfig",
+    "SparseLogReg",
+    "HuffmanCodes",
+    "Word2Vec",
+    "Word2VecConfig",
+    "build_huffman",
+    "build_unigram_alias",
+]

@@ -1,0 +1,275 @@
+"""Logistic regression / softmax / FTRL model family.
+
+TPU-native re-build of the reference LogisticRegression application's model
+layer (``Applications/LogisticRegression/src`` in the Multiverso reference):
+objectives (linear/sigmoid/softmax — ``objective/objective.cpp:29-315``;
+FTRL-proximal — ``objective/ftrl*``), L1/L2 regularisers (``regular/*``),
+dense minibatch training against a weight table, and the sparse/FTRL keyed
+path. The reference computes per-sample gradients in C++ loops and pushes
+averaged deltas to PS tables; here the whole minibatch is one jitted step on
+the table's sharded state (weights never leave HBM on the dense path), and
+the sparse path pulls/pushes only touched keys (``SparseTable``/``FTRLTable``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..log import Log
+from ..tables.base import _option_scalars
+from ..updaters import AddOption
+
+
+@dataclass
+class LogRegConfig:
+    """Mirrors the reference config file keys (``LR/src/configure.h:9-93``)."""
+
+    input_size: int = 0          # feature dim (bias handled internally)
+    output_size: int = 1         # 1 = binary, >1 = softmax classes
+    objective_type: str = "sigmoid"   # linear|sigmoid|softmax|ftrl
+    regular_type: str = "none"        # none|l1|l2
+    regular_coef: float = 0.0
+    learning_rate: float = 0.1
+    learning_rate_coef: float = 1.0   # lr decay: lr/(1+coef*t) (reference sgd)
+    minibatch_size: int = 64
+    sparse: bool = False
+    sync_frequency: int = 1
+    pipeline: bool = False
+    # FTRL hyperparameters (LR/src/configure.h)
+    ftrl_alpha: float = 0.1
+    ftrl_beta: float = 1.0
+    ftrl_lambda1: float = 0.001
+    ftrl_lambda2: float = 0.001
+
+
+def _regular_grad(w, kind: str, coef: float):
+    if kind == "l2":
+        return coef * w
+    if kind == "l1":
+        return coef * jnp.sign(w)
+    return jnp.zeros_like(w)
+
+
+class LogReg:
+    """Dense model against a MatrixTable of weights [output, input+1].
+
+    The trailing column is the bias. ``train_minibatch`` runs one jitted
+    step: forward, objective gradient, regulariser, updater application —
+    the reference's ``Model::Update`` + ``PSModel::UpdateTable`` collapsed
+    (``LR/src/model/model.cpp:58-123``, ``ps_model.cpp:185``).
+    """
+
+    def __init__(self, cfg: LogRegConfig, table) -> None:
+        if cfg.objective_type not in ("linear", "sigmoid", "softmax"):
+            Log.fatal(f"LogReg: unsupported objective {cfg.objective_type!r} "
+                      "(use FTRLLogReg for ftrl)")
+        if cfg.output_size < 1:
+            Log.fatal("output_size must be >= 1")
+        self.cfg = cfg
+        self.table = table
+        self._steps = 0
+        self._step_fn = self._build_step()
+        self._predict_fn = jax.jit(self._forward)
+
+    # -- math --------------------------------------------------------------
+    def _forward(self, w, x):
+        """x: [B, input]; w: [output, input+1] -> scores [B, output]."""
+        scores = x @ w[:, :-1].T + w[:, -1]
+        obj = self.cfg.objective_type
+        if obj == "sigmoid":
+            return jax.nn.sigmoid(scores)
+        if obj == "softmax":
+            return jax.nn.softmax(scores, axis=-1)
+        return scores
+
+    def _build_step(self):
+        cfg = self.cfg
+        updater = self.table.updater
+
+        def step(w, ustate, x, y, lr, momentum, rho, lam, wid):
+            def loss_fn(w):
+                scores = x @ w[:, :-1].T + w[:, -1]
+                if cfg.objective_type == "sigmoid":
+                    # y: [B, output] in {0,1}
+                    loss = jnp.mean(
+                        jnp.sum(jax.nn.softplus(scores) - y * scores, axis=-1))
+                elif cfg.objective_type == "softmax":
+                    logp = jax.nn.log_softmax(scores, axis=-1)
+                    loss = -jnp.mean(jnp.sum(y * logp, axis=-1))
+                else:  # linear: squared error
+                    loss = 0.5 * jnp.mean(jnp.sum((scores - y) ** 2, axis=-1))
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(w)
+            grads = grads + _regular_grad(w, cfg.regular_type, cfg.regular_coef)
+            option = AddOption(worker_id=wid, learning_rate=lr,
+                               momentum=momentum, rho=rho, lam=lam)
+            delta = lr * grads
+            w, ustate = updater.apply(w, ustate, delta, option)
+            return w, ustate, loss
+
+        return jax.jit(step, donate_argnums=(0, 1),
+                       out_shardings=(self.table.sharding,
+                                      self.table._ustate_sharding, None))
+
+    # -- API ---------------------------------------------------------------
+    def current_lr(self) -> float:
+        cfg = self.cfg
+        return cfg.learning_rate / (1.0 + cfg.learning_rate_coef * self._steps)
+
+    def train_minibatch(self, x: np.ndarray, y: np.ndarray,
+                        option: Optional[AddOption] = None):
+        """One minibatch step; y is [B, output] (one-hot for softmax)."""
+        option = option or AddOption()
+        option.learning_rate = self.current_lr()
+        t = self.table
+        with t._lock:
+            t._data, t._ustate, loss = self._step_fn(
+                t._data, t._ustate,
+                jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32),
+                *_option_scalars(option, t.dtype))
+        self._steps += 1
+        return loss
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        with self.table._lock:
+            out = self._predict_fn(self.table._data, jnp.asarray(x, jnp.float32))
+        return np.asarray(out)
+
+    def test(self, x: np.ndarray, y: np.ndarray) -> float:
+        """Accuracy (reference ``LogReg::Test``, ``LR/src/logreg.cpp:118``)."""
+        preds = self.predict(x)
+        if self.cfg.output_size == 1:
+            correct = (preds[:, 0] > 0.5) == (y.ravel() > 0.5)
+        else:
+            correct = preds.argmax(-1) == y.argmax(-1)
+        return float(np.mean(correct))
+
+
+class FTRLLogReg:
+    """FTRL-proximal binary LR over an FTRLTable of (z, n) state.
+
+    Worker-side closed-form weight reconstruction + server-side (z, n)
+    accumulation — the reference's FTRL objective + FTRL sparse table
+    (``LR/src/objective/ftrl*``, ``util/ftrl_sparse_table.h``). Touched keys
+    only: the natural sparse path.
+    """
+
+    def __init__(self, cfg: LogRegConfig, table) -> None:
+        self.cfg = cfg
+        self.table = table  # FTRLTable of size input_size + 1 (bias key = last)
+        self.bias_key = cfg.input_size
+
+    def _weights_from_zn(self, z: np.ndarray, n: np.ndarray) -> np.ndarray:
+        cfg = self.cfg
+        sign = np.sign(z)
+        w = -(z - sign * cfg.ftrl_lambda1) / (
+            (cfg.ftrl_beta + np.sqrt(n)) / cfg.ftrl_alpha + cfg.ftrl_lambda2)
+        w[np.abs(z) <= cfg.ftrl_lambda1] = 0.0
+        return w
+
+    def train_sample(self, keys: np.ndarray, values: np.ndarray,
+                     label: float) -> float:
+        """One sparse sample: keys/values + bias; returns the loss."""
+        cfg = self.cfg
+        keys = np.concatenate([np.asarray(keys, np.int64),
+                               [self.bias_key]])
+        values = np.concatenate([np.asarray(values, np.float64), [1.0]])
+        z, n = self.table.get_keys(keys)
+        w = self._weights_from_zn(np.asarray(z, np.float64),
+                                  np.asarray(n, np.float64))
+        score = float(w @ values)
+        pred = 1.0 / (1.0 + np.exp(-np.clip(score, -35, 35)))
+        g = (pred - label) * values
+        sigma = (np.sqrt(n + g * g) - np.sqrt(n)) / cfg.ftrl_alpha
+        delta_z = g - sigma * w
+        delta_n = g * g
+        self.table.add_keys(keys, delta_z, delta_n)
+        eps = 1e-12
+        return float(-(label * np.log(pred + eps)
+                       + (1 - label) * np.log(1 - pred + eps)))
+
+    def predict_sample(self, keys: np.ndarray, values: np.ndarray) -> float:
+        keys = np.concatenate([np.asarray(keys, np.int64), [self.bias_key]])
+        values = np.concatenate([np.asarray(values, np.float64), [1.0]])
+        z, n = self.table.get_keys(keys)
+        w = self._weights_from_zn(np.asarray(z, np.float64),
+                                  np.asarray(n, np.float64))
+        score = float(w @ values)
+        return 1.0 / (1.0 + np.exp(-np.clip(score, -35, 35)))
+
+
+class SparseLogReg:
+    """Binary LR over a SparseTable, touched-keys-only traffic.
+
+    The reference's sparse PS path (``LR/src/model/ps_model.cpp`` with
+    ``SparseWorkerTable``): pull the minibatch's keyset, compute gradients
+    host-side on the gathered slice, push keyed deltas (sgd updater applies
+    ``-=``).
+    """
+
+    def __init__(self, cfg: LogRegConfig, table) -> None:
+        self.cfg = cfg
+        self.table = table  # SparseTable(input_size + 1, updater="sgd")
+        self.bias_key = cfg.input_size
+        self._steps = 0
+        # local weight cache: fresh Get every ``sync_frequency`` minibatches
+        # (reference DoesNeedSync, ``LR/src/model/ps_model.cpp:172``); deltas
+        # are pushed every minibatch and mirrored locally in between.
+        self._w_cache: Dict[int, float] = {}
+
+    def current_lr(self) -> float:
+        cfg = self.cfg
+        return cfg.learning_rate / (1.0 + cfg.learning_rate_coef * self._steps)
+
+    def _fetch_into_cache(self, keys: np.ndarray) -> None:
+        values = np.asarray(self.table.get_keys(keys), np.float64)
+        for k, v in zip(keys, values):
+            self._w_cache[int(k)] = float(v)
+
+    def train_minibatch(self, samples) -> float:
+        """samples: list of (keys, values, label)."""
+        all_keys = sorted({int(k) for keys, _, _ in samples for k in keys}
+                          | {self.bias_key})
+        key_arr = np.asarray(all_keys, np.int64)
+        idx = {k: i for i, k in enumerate(all_keys)}
+        sync_every = max(self.cfg.sync_frequency, 1)
+        if self._steps % sync_every == 0:
+            self._fetch_into_cache(key_arr)  # full refresh this window
+        else:
+            missing = np.asarray([k for k in all_keys
+                                  if k not in self._w_cache], np.int64)
+            if missing.size:
+                self._fetch_into_cache(missing)
+        w = np.asarray([self._w_cache[k] for k in all_keys], np.float64)
+        grad = np.zeros_like(w)
+        loss = 0.0
+        for keys, values, label in samples:
+            cols = [idx[int(k)] for k in keys] + [idx[self.bias_key]]
+            vals = np.concatenate([np.asarray(values, np.float64), [1.0]])
+            score = float(w[cols] @ vals)
+            pred = 1.0 / (1.0 + np.exp(-np.clip(score, -35, 35)))
+            g = pred - label
+            grad[cols] += g * vals
+            eps = 1e-12
+            loss += -(label * np.log(pred + eps)
+                      + (1 - label) * np.log(1 - pred + eps))
+        grad /= len(samples)
+        delta = self.current_lr() * grad  # sgd updater applies data -= delta
+        self.table.add_keys(key_arr, delta.astype(np.float32))
+        for k, d in zip(all_keys, delta):  # read-your-writes between syncs
+            self._w_cache[k] = self._w_cache.get(k, 0.0) - float(d)
+        self._steps += 1
+        return loss / len(samples)
+
+    def predict_sample(self, keys, values) -> float:
+        key_arr = np.concatenate([np.asarray(keys, np.int64), [self.bias_key]])
+        vals = np.concatenate([np.asarray(values, np.float64), [1.0]])
+        w = np.asarray(self.table.get_keys(key_arr), np.float64)
+        score = float(w @ vals)
+        return 1.0 / (1.0 + np.exp(-np.clip(score, -35, 35)))

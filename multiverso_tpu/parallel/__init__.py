@@ -4,7 +4,7 @@ Replaces the reference's net/allreduce-engine layer and sync-server machinery
 with XLA-native forms — see per-module docstrings for the mapping.
 """
 
-from .async_buffer import ASyncBuffer, PipelinedGetter
+from .async_buffer import ASyncBuffer, PipelinedGetter, prefetch_iterator
 from .collectives import (all_gather, allreduce, allreduce_replicated,
                           reduce_scatter, ring_shift)
 from .sync_step import make_sync_step
@@ -12,6 +12,7 @@ from .sync_step import make_sync_step
 __all__ = [
     "ASyncBuffer",
     "PipelinedGetter",
+    "prefetch_iterator",
     "all_gather",
     "allreduce",
     "allreduce_replicated",

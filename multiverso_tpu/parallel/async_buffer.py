@@ -78,6 +78,38 @@ class ASyncBuffer(Generic[T]):
             self._todo.put(1 - idx if idx is not None else 0)
 
 
+def prefetch_iterator(iterable, depth: int = 2):
+    """Background-thread prefetch of an iterator.
+
+    The loader-thread pattern (reference ``BlockQueue`` +
+    ``LoadDataFromFile`` thread, ``WE/src/distributed_wordembedding.cpp:33-56``;
+    LogReg ``SampleReader`` thread, ``LR/src/reader.cpp:128``): the producer
+    runs ``depth`` items ahead on a daemon thread so host parsing overlaps
+    device execution. Exceptions in the producer re-raise at the consumer.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    done = object()
+
+    def run():
+        try:
+            for item in iterable:
+                q.put((None, item))
+        except BaseException as exc:  # propagate to consumer
+            q.put((exc, None))
+            return
+        q.put((done, None))
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    while True:
+        exc, item = q.get()
+        if exc is done:
+            return
+        if exc is not None:
+            raise exc
+        yield item
+
+
 class PipelinedGetter:
     """Double-buffered table Gets keyed by a per-window keyset.
 

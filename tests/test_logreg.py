@@ -1,0 +1,173 @@
+"""LogReg model family tests (reference: LR objectives + app invariants)."""
+
+import numpy as np
+import pytest
+
+
+def _binary_data(n=512, dim=10, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal(dim)
+    x = rng.standard_normal((n, dim)).astype(np.float32)
+    y = (x @ w + 0.1 * rng.standard_normal(n) > 0).astype(np.float32)
+    return x, y[:, None], w
+
+
+def test_dense_sigmoid_learns(mv_session):
+    from multiverso_tpu.apps.logreg import build_model
+    from multiverso_tpu.models.logreg import LogRegConfig
+
+    x, y, _ = _binary_data()
+    cfg = LogRegConfig(input_size=10, output_size=1, objective_type="sigmoid",
+                       learning_rate=0.5, learning_rate_coef=0.001,
+                       minibatch_size=64)
+    model = build_model(cfg)
+    for epoch in range(30):
+        for i in range(0, len(x), 64):
+            model.train_minibatch(x[i:i + 64], y[i:i + 64])
+    assert model.test(x, y) > 0.95
+
+
+def test_dense_softmax_learns(mv_session):
+    from multiverso_tpu.apps.logreg import build_model
+    from multiverso_tpu.models.logreg import LogRegConfig
+
+    rng = np.random.default_rng(1)
+    centers = np.asarray([[2, 0], [-2, 2], [0, -2]], np.float32)
+    labels = rng.integers(0, 3, 600)
+    x = centers[labels] + 0.5 * rng.standard_normal((600, 2)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[labels]
+    cfg = LogRegConfig(input_size=2, output_size=3, objective_type="softmax",
+                       learning_rate=0.5, learning_rate_coef=0.001)
+    model = build_model(cfg)
+    for _ in range(40):
+        for i in range(0, 600, 64):
+            model.train_minibatch(x[i:i + 64], y[i:i + 64])
+    assert model.test(x, y) > 0.9
+
+
+def test_linear_objective_and_regulariser(mv_session):
+    from multiverso_tpu.apps.logreg import build_model
+    from multiverso_tpu.models.logreg import LogRegConfig
+
+    x, y, w_true = _binary_data()
+    y_reg = (x @ w_true).astype(np.float32)[:, None]
+    cfg = LogRegConfig(input_size=10, output_size=1, objective_type="linear",
+                       regular_type="l2", regular_coef=1e-4,
+                       learning_rate=0.05, learning_rate_coef=0.0)
+    model = build_model(cfg)
+    losses = []
+    for _ in range(50):
+        for i in range(0, len(x), 64):
+            loss = model.train_minibatch(x[i:i + 64], y_reg[i:i + 64])
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_sparse_logreg_learns(mv_session):
+    from multiverso_tpu.apps.logreg import build_model
+    from multiverso_tpu.models.logreg import LogRegConfig
+
+    rng = np.random.default_rng(2)
+    dim = 100
+    w = np.zeros(dim)
+    w[:10] = rng.standard_normal(10) * 2
+    samples = []
+    for _ in range(400):
+        keys = np.sort(rng.choice(dim, size=8, replace=False))
+        vals = rng.standard_normal(8)
+        label = float(w[keys] @ vals > 0)
+        samples.append((keys.astype(np.int64), vals, label))
+    cfg = LogRegConfig(input_size=dim, sparse=True, learning_rate=0.5,
+                       learning_rate_coef=0.001, minibatch_size=32)
+    model = build_model(cfg)
+    for _ in range(30):
+        for i in range(0, len(samples), 32):
+            model.train_minibatch(samples[i:i + 32])
+    correct = sum(
+        (model.predict_sample(k, v) > 0.5) == (lab > 0.5)
+        for k, v, lab in samples)
+    assert correct / len(samples) > 0.85
+
+
+def test_ftrl_learns_and_is_sparse(mv_session):
+    from multiverso_tpu.apps.logreg import build_model
+    from multiverso_tpu.models.logreg import LogRegConfig
+
+    rng = np.random.default_rng(3)
+    dim = 50
+    w = np.zeros(dim)
+    w[:5] = [3, -3, 2, -2, 4]
+    samples = []
+    for _ in range(600):
+        keys = np.sort(rng.choice(dim, size=6, replace=False))
+        vals = np.ones(6)
+        label = float(w[keys].sum() > 0)
+        samples.append((keys.astype(np.int64), vals, label))
+    cfg = LogRegConfig(input_size=dim, objective_type="ftrl",
+                       ftrl_alpha=0.5, ftrl_beta=1.0,
+                       ftrl_lambda1=0.1, ftrl_lambda2=0.01)
+    model = build_model(cfg)
+    for k, v, lab in samples:
+        model.train_sample(k, v, lab)
+    correct = sum(
+        (model.predict_sample(k, v) > 0.5) == (lab > 0.5)
+        for k, v, lab in samples)
+    assert correct / len(samples) > 0.8
+    # L1 proximal: |z| <= lambda1 reconstructs an exact zero weight
+    weights = model._weights_from_zn(np.asarray([0.05, -0.05, 1.0]),
+                                     np.asarray([1.0, 1.0, 1.0]))
+    assert weights[0] == 0 and weights[1] == 0 and weights[2] != 0
+
+
+def test_logreg_app_end_to_end(mv_session, tmp_path):
+    """Config-file driven app run: train -> test -> save -> load."""
+    from multiverso_tpu.apps import logreg as app
+
+    x, y, _ = _binary_data(n=256, dim=5, seed=4)
+    train_path = tmp_path / "train.txt"
+    lines = [" ".join([str(int(y[i, 0]))] + [f"{v:.5f}" for v in x[i]])
+             for i in range(200)]
+    train_path.write_text("\n".join(lines))
+    test_path = tmp_path / "test.txt"
+    lines = [" ".join([str(int(y[i, 0]))] + [f"{v:.5f}" for v in x[i]])
+             for i in range(200, 256)]
+    test_path.write_text("\n".join(lines))
+    config_path = tmp_path / "lr.config"
+    config_path.write_text("\n".join([
+        "input_size=5",
+        "output_size=1",
+        "objective_type=sigmoid",
+        "learning_rate=0.5",
+        "learning_rate_coef=0.001",
+        "minibatch_size=32",
+        f"train_file={train_path}",
+        f"test_file={test_path}",
+        "train_epoch=40",
+        f"output_model_file={tmp_path}/model.bin",
+    ]))
+
+    conf = app.parse_config(str(config_path))
+    cfg = app.config_from_dict(conf)
+    model = app.build_model(cfg)
+    app.train_file(model, cfg, conf["train_file"],
+                   epochs=int(conf["train_epoch"]), log_every=0)
+    acc = app.test_file(model, cfg, conf["test_file"])
+    assert acc > 0.9
+    app.save_model(model, conf["output_model_file"])
+
+    model2 = app.build_model(cfg)
+    app.load_model(model2, conf["output_model_file"])
+    np.testing.assert_allclose(model2.table.get(), model.table.get())
+
+
+def test_parse_sample_formats():
+    from multiverso_tpu.apps.logreg import parse_sample
+
+    label, keys, vals = parse_sample("1 3:0.5 7:2.0", True, 10)
+    assert label == 1.0
+    np.testing.assert_array_equal(keys, [3, 7])
+    np.testing.assert_allclose(vals, [0.5, 2.0])
+    label, keys, vals = parse_sample("0 0.1 0.2 0.3", False, 5)
+    assert label == 0.0
+    np.testing.assert_allclose(vals[:3], [0.1, 0.2, 0.3])
+    assert vals.shape == (5,)
