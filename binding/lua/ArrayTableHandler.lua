@@ -1,0 +1,54 @@
+-- ArrayTableHandler: 1-D float table (reference
+-- binding/lua/ArrayTableHandler.lua:13-43 in the Multiverso reference).
+
+local ffi = require 'ffi'
+local util = require 'multiverso.util'
+
+ffi.cdef[[
+    void MV_NewArrayTable(int size, TableHandler* out);
+    void MV_GetArrayTable(TableHandler handler, float* data, int size);
+    void MV_AddArrayTable(TableHandler handler, float* data, int size);
+    void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size);
+]]
+
+local tbh = {}
+tbh.__index = tbh
+
+function tbh:new(size, init_value)
+    local t = setmetatable({}, tbh)
+    local mv = require 'multiverso'
+    t._lib = mv._lib
+    t._size = size
+    local handler = ffi.new('TableHandler[1]')
+    t._lib.MV_NewArrayTable(size, handler)
+    t._handler = handler[0]
+    if init_value ~= nil then
+        -- each worker contributes init_value / num_workers; the summed
+        -- result equals the average of the processes' initial values
+        local buf = util.to_cdata(init_value, size)
+        local workers = mv.num_workers()
+        for i = 0, size - 1 do
+            buf[i] = buf[i] / workers
+        end
+        t._lib.MV_AddArrayTable(t._handler, buf, size)
+    end
+    return t
+end
+
+function tbh:get(as_tensor)
+    local buf = ffi.new('float[?]', self._size)
+    self._lib.MV_GetArrayTable(self._handler, buf, self._size)
+    return util.to_result(buf, self._size, as_tensor)
+end
+
+function tbh:add(data, sync)
+    sync = sync or false
+    local buf = util.to_cdata(data, self._size)
+    if sync then
+        self._lib.MV_AddArrayTable(self._handler, buf, self._size)
+    else
+        self._lib.MV_AddAsyncArrayTable(self._handler, buf, self._size)
+    end
+end
+
+return tbh

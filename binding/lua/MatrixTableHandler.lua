@@ -1,0 +1,81 @@
+-- MatrixTableHandler: 2-D float table with whole/row access (reference
+-- binding/lua/MatrixTableHandler.lua:16-76 in the Multiverso reference).
+
+local ffi = require 'ffi'
+local util = require 'multiverso.util'
+
+ffi.cdef[[
+    void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
+    void MV_GetMatrixTableAll(TableHandler handler, float* data, int size);
+    void MV_AddMatrixTableAll(TableHandler handler, float* data, int size);
+    void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size);
+    void MV_GetMatrixTableByRows(TableHandler handler, float* data,
+                                 int size, int row_ids[], int row_ids_n);
+    void MV_AddMatrixTableByRows(TableHandler handler, float* data,
+                                 int size, int row_ids[], int row_ids_n);
+    void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data,
+                                      int size, int row_ids[], int row_ids_n);
+]]
+
+local tbh = {}
+tbh.__index = tbh
+
+function tbh:new(num_row, num_col, init_value)
+    local t = setmetatable({}, tbh)
+    local mv = require 'multiverso'
+    t._lib = mv._lib
+    t._num_row = num_row
+    t._num_col = num_col
+    t._size = num_row * num_col
+    local handler = ffi.new('TableHandler[1]')
+    t._lib.MV_NewMatrixTable(num_row, num_col, handler)
+    t._handler = handler[0]
+    if init_value ~= nil then
+        local buf = util.to_cdata(init_value, t._size)
+        local workers = mv.num_workers()
+        for i = 0, t._size - 1 do
+            buf[i] = buf[i] / workers
+        end
+        t._lib.MV_AddMatrixTableAll(t._handler, buf, t._size)
+    end
+    return t
+end
+
+function tbh:get(row_ids, as_tensor)
+    if row_ids == nil then
+        local buf = ffi.new('float[?]', self._size)
+        self._lib.MV_GetMatrixTableAll(self._handler, buf, self._size)
+        return util.to_result(buf, self._size, as_tensor)
+    end
+    local n = #row_ids
+    local size = n * self._num_col
+    local buf = ffi.new('float[?]', size)
+    local ids = util.to_int_cdata(row_ids, n)
+    self._lib.MV_GetMatrixTableByRows(self._handler, buf, size, ids, n)
+    return util.to_result(buf, size, as_tensor)
+end
+
+function tbh:add(data, row_ids, sync)
+    sync = sync or false
+    if row_ids == nil then
+        local buf = util.to_cdata(data, self._size)
+        if sync then
+            self._lib.MV_AddMatrixTableAll(self._handler, buf, self._size)
+        else
+            self._lib.MV_AddAsyncMatrixTableAll(self._handler, buf, self._size)
+        end
+    else
+        local n = #row_ids
+        local size = n * self._num_col
+        local buf = util.to_cdata(data, size)
+        local ids = util.to_int_cdata(row_ids, n)
+        if sync then
+            self._lib.MV_AddMatrixTableByRows(self._handler, buf, size, ids, n)
+        else
+            self._lib.MV_AddAsyncMatrixTableByRows(self._handler, buf, size,
+                                                   ids, n)
+        end
+    end
+end
+
+return tbh

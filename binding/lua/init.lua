@@ -1,0 +1,79 @@
+-- multiverso-tpu Lua binding (LuaJIT FFI over the C ABI in cpp/c_api.h).
+--
+-- Source-compatible with the reference Lua binding surface
+-- (binding/lua/init.lua:28-65 in the Multiverso reference):
+-- mv.init/barrier/shutdown/num_workers/worker_id/server_id plus
+-- ArrayTableHandler / MatrixTableHandler. Loaded standalone, the shared
+-- library serves tables from its in-process native store; when a Python
+-- host has installed the bridge, the same calls hit TPU-resident tables.
+
+local ffi = require 'ffi'
+
+local mv = {}
+
+ffi.cdef[[
+    typedef void* TableHandler;
+    void MV_Init(int* argc, char* argv[]);
+    void MV_ShutDown();
+    void MV_Barrier();
+    int MV_NumWorkers();
+    int MV_WorkerId();
+    int MV_ServerId();
+    int MV_SetFlag(const char* name, const char* value);
+]]
+
+local lib_path = os.getenv('MV_NATIVE_LIB')
+if lib_path == nil then
+    package.cpath = './cpp/?.so;/usr/local/lib/?.so;' .. package.cpath
+    lib_path = package.searchpath('libmultiverso_tpu', package.cpath, '')
+end
+if lib_path == nil then
+    error([[multiverso-tpu shared object `libmultiverso_tpu.so` not found.
+Build it with `make -C cpp` and set MV_NATIVE_LIB or install it on cpath.]])
+end
+local libmv = ffi.load(lib_path, true)
+mv._lib = libmv
+
+mv.ArrayTableHandler = require('multiverso.ArrayTableHandler')
+mv.MatrixTableHandler = require('multiverso.MatrixTableHandler')
+
+function mv.init(sync)
+    sync = sync or false
+    local args = { '' }  -- argv[0] placeholder
+    if sync then
+        table.insert(args, '-sync=true')
+    end
+    local argc = ffi.new('int[1]', #args)
+    local argv = ffi.new('char*[?]', #args)
+    for i = 1, #args do
+        argv[i - 1] = ffi.new('char[?]', #args[i] + 1)
+        ffi.copy(argv[i - 1], args[i])
+    end
+    libmv.MV_Init(argc, argv)
+end
+
+function mv.barrier()
+    libmv.MV_Barrier()
+end
+
+function mv.shutdown()
+    libmv.MV_ShutDown()
+end
+
+function mv.num_workers()
+    return libmv.MV_NumWorkers()
+end
+
+function mv.worker_id()
+    return libmv.MV_WorkerId()
+end
+
+function mv.server_id()
+    return libmv.MV_ServerId()
+end
+
+function mv.set_flag(name, value)
+    return libmv.MV_SetFlag(name, tostring(value))
+end
+
+return mv

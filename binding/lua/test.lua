@@ -1,0 +1,54 @@
+-- Binding self-test (reference binding/lua/test.lua invariants: values scale
+-- with num_workers so the same assertions pass for 1..N processes).
+-- Run: luajit -e "package.path='./binding/?/init.lua;./binding/lua/?.lua;'..package.path" binding/lua/test.lua
+
+package.path = './binding/?/init.lua;./binding/?.lua;./binding/lua/?.lua;'
+    .. package.path
+package.loaded['multiverso.util'] = dofile('binding/lua/util.lua')
+package.loaded['multiverso.ArrayTableHandler'] =
+    dofile('binding/lua/ArrayTableHandler.lua')
+package.loaded['multiverso.MatrixTableHandler'] =
+    dofile('binding/lua/MatrixTableHandler.lua')
+local mv = dofile('binding/lua/init.lua')
+package.loaded['multiverso'] = mv
+
+local function assert_near(a, b, msg)
+    assert(math.abs(a - b) < 1e-4, (msg or '') .. ': ' .. a .. ' vs ' .. b)
+end
+
+mv.init()
+local workers = mv.num_workers()
+
+-- array invariants
+local size = 16
+local at = mv.ArrayTableHandler:new(size)
+mv.barrier()
+for iter = 1, 3 do
+    local delta = {}
+    for i = 1, size do delta[i] = i end
+    at:add(delta)
+end
+mv.barrier()
+local got = at:get()
+for i = 1, size do
+    assert_near(got[i], 3 * i * workers, 'array accumulation')
+end
+
+-- matrix invariants (whole + rows)
+local num_row, num_col = 4, 3
+local mt = mv.MatrixTableHandler:new(num_row, num_col)
+mv.barrier()
+local delta = {}
+for i = 1, num_row * num_col do delta[i] = 1 end
+mt:add(delta)
+mv.barrier()
+mt:add({ 10, 10, 10 }, { 1 })  -- row 1 += 10 (0-based row id 1)
+mv.barrier()
+local all = mt:get()
+assert_near(all[1], 1 * workers, 'matrix row 0')
+assert_near(all[num_col + 1], (1 + 10) * workers, 'matrix row 1')
+local rows = mt:get({ 1 })
+assert_near(rows[1], (1 + 10) * workers, 'matrix get by row')
+
+mv.shutdown()
+print('lua binding test: OK (workers=' .. workers .. ')')

@@ -1,0 +1,50 @@
+-- Conversion helpers between lua tables / torch tensors and C float buffers
+-- (reference binding/lua/util.lua:16-34 in the Multiverso reference).
+
+local ffi = require 'ffi'
+
+local util = {}
+
+local has_torch, torch = pcall(require, 'torch')
+
+-- any numeric source -> float[n] cdata
+function util.to_cdata(data, n)
+    local buf = ffi.new('float[?]', n)
+    if has_torch and torch.isTensor(data) then
+        local flat = data:contiguous():view(-1)
+        for i = 1, n do
+            buf[i - 1] = flat[i]
+        end
+    else
+        for i = 1, n do
+            buf[i - 1] = data[i] or 0
+        end
+    end
+    return buf
+end
+
+-- float[n] cdata -> lua table (1-based) or torch tensor when available
+function util.to_result(buf, n, as_tensor)
+    if as_tensor and has_torch then
+        local out = torch.FloatTensor(n)
+        for i = 1, n do
+            out[i] = buf[i - 1]
+        end
+        return out
+    end
+    local out = {}
+    for i = 1, n do
+        out[i] = buf[i - 1]
+    end
+    return out
+end
+
+function util.to_int_cdata(ids, n)
+    local buf = ffi.new('int[?]', n)
+    for i = 1, n do
+        buf[i - 1] = ids[i]
+    end
+    return buf
+end
+
+return util

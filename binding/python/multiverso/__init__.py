@@ -1,0 +1,27 @@
+"""multiverso: source-compatible Python binding.
+
+Mirrors the reference package surface (``binding/python/multiverso`` in the
+Multiverso reference — ``api.py:12-66``, ``tables.py:38-163``) on top of the
+TPU-native framework: same ``init``/``shutdown``/``barrier``/``workers_num``/
+``worker_id``/``server_id``/``is_master_worker`` functions and the same
+``ArrayTableHandler``/``MatrixTableHandler`` classes (float32 numpy in/out,
+init_value averaging across workers, sync/async adds). User scripts written
+against the reference binding run unchanged; underneath, tables are sharded
+``jax.Array``s in HBM instead of MPI-attached C++ shards.
+"""
+
+from .api import (barrier, init, is_master_worker, server_id, shutdown,
+                  worker_id, workers_num)
+from .tables import ArrayTableHandler, MatrixTableHandler
+
+__all__ = [
+    "init",
+    "shutdown",
+    "barrier",
+    "workers_num",
+    "worker_id",
+    "server_id",
+    "is_master_worker",
+    "ArrayTableHandler",
+    "MatrixTableHandler",
+]

@@ -1,0 +1,48 @@
+"""Process API (reference ``binding/python/multiverso/api.py:12-66``)."""
+
+from __future__ import annotations
+
+import multiverso_tpu as _mv
+
+
+def init(sync: bool = False, args=None) -> None:
+    """Initialize multiverso. Call once before training.
+
+    ``sync=True`` selects synchronous (BSP) parameter-server semantics
+    (the reference's ``-sync=true`` argv injection, ``api.py:20-25``).
+    """
+    argv = ["multiverso"]
+    if sync:
+        argv.append("-sync=true")
+    if args:
+        argv.extend(args)
+    _mv.init(argv)
+
+
+def shutdown() -> None:
+    """Shutdown multiverso. Call once after training."""
+    _mv.shutdown()
+
+
+def barrier() -> None:
+    """Wait until all workers reach this barrier."""
+    _mv.barrier()
+
+
+def workers_num() -> int:
+    """Total number of workers."""
+    return _mv.num_workers()
+
+
+def worker_id() -> int:
+    """Zero-based id of the current worker."""
+    return max(_mv.worker_id(), 0)
+
+
+def server_id() -> int:
+    return max(_mv.server_id(), 0)
+
+
+def is_master_worker() -> bool:
+    """Worker 0 handles one-process jobs (validation, init, output)."""
+    return worker_id() == 0

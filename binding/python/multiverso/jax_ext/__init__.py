@@ -1,0 +1,5 @@
+"""JAX/Flax parameter synchronisation (modern replacement for theano_ext)."""
+
+from .param_manager import MVNetParamManager, MVSharedArray
+
+__all__ = ["MVNetParamManager", "MVSharedArray"]

@@ -1,0 +1,91 @@
+"""Table handlers (reference ``binding/python/multiverso/tables.py:38-163``).
+
+Same classes, signatures and semantics as the reference binding; the state
+lives in the TPU framework's sharded tables.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import multiverso_tpu as _mv
+
+from . import api
+from .utils import convert_data
+
+
+class TableHandler:
+    """Base class (reference ``tables.py:19-31``)."""
+
+    def get(self):
+        raise NotImplementedError("You must implement the get method.")
+
+    def add(self, data, sync: bool = False):
+        raise NotImplementedError("You must implement the add method.")
+
+
+class ArrayTableHandler(TableHandler):
+    """Syncs an array-like (one-dimensional) float32 value."""
+
+    def __init__(self, size: int, init_value=None) -> None:
+        """If ``init_value`` differs across processes, their average is used
+        (each worker adds ``init_value / workers_num`` — reference
+        ``tables.py:47-57``)."""
+        self._size = int(size)
+        self._table = _mv.create_table("array", self._size)
+        if init_value is not None:
+            init_value = convert_data(init_value)
+            # sync add: the initial value must be visible when we return
+            self.add(init_value / api.workers_num(), sync=True)
+
+    def get(self) -> np.ndarray:
+        return np.asarray(self._table.get(), dtype=np.float32)
+
+    def add(self, data, sync: bool = False) -> None:
+        data = convert_data(data)
+        assert data.size == self._size
+        if sync:
+            self._table.add(data)
+        else:
+            self._table.add_async(data)
+
+
+class MatrixTableHandler(TableHandler):
+    """Syncs a matrix-like (two-dimensional) float32 value."""
+
+    def __init__(self, num_row: int, num_col: int, init_value=None) -> None:
+        self._num_row = int(num_row)
+        self._num_col = int(num_col)
+        self._size = self._num_row * self._num_col
+        self._table = _mv.create_table("matrix", self._num_row, self._num_col)
+        if init_value is not None:
+            init_value = convert_data(init_value)
+            self.add(init_value / api.workers_num(), sync=True)
+
+    def get(self, row_ids=None) -> np.ndarray:
+        """Whole table, or the selected rows as a 2-D array."""
+        if row_ids is None:
+            return np.asarray(self._table.get(), dtype=np.float32)
+        return np.asarray(self._table.get_rows(list(row_ids)),
+                          dtype=np.float32)
+
+    def add(self, data=None, row_ids=None, sync: bool = False) -> None:
+        assert data is not None
+        data = convert_data(data)
+        if row_ids is None:
+            assert data.size == self._size
+            if sync:
+                self._table.add(data.reshape(self._num_row, self._num_col))
+            else:
+                self._table.add_async(
+                    data.reshape(self._num_row, self._num_col))
+        else:
+            row_ids = list(row_ids)
+            assert data.size == len(row_ids) * self._num_col
+            rows = data.reshape(len(row_ids), self._num_col)
+            if sync:
+                self._table.add_rows(row_ids, rows)
+            else:
+                self._table.add_rows_async(row_ids, rows)

@@ -1,0 +1,5 @@
+"""PyTorch parameter synchronisation (replacement for the Lua/Torch hook)."""
+
+from .param_manager import MVTorchParamManager
+
+__all__ = ["MVTorchParamManager"]

@@ -1,0 +1,48 @@
+"""Data-parallel parameter sync for torch modules.
+
+Python-side successor to the reference Lua/Torch binding's training hook
+(``binding/lua`` + the fb.resnet.torch integration in the Multiverso
+reference): flattens all module parameters into one ArrayTable and syncs
+with the push-delta / pull-merged protocol.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import api
+from ..tables import ArrayTableHandler
+
+
+class MVTorchParamManager:
+    def __init__(self, module) -> None:
+        self.module = module
+        flat = self._flatten()
+        self.tbh = ArrayTableHandler(flat.size, init_value=flat)
+        api.barrier()
+        self._last = self.tbh.get()
+        self._write_back(self._last)
+
+    def _flatten(self) -> np.ndarray:
+        return np.concatenate([
+            p.detach().cpu().numpy().astype(np.float32).ravel()
+            for p in self.module.parameters()])
+
+    def _write_back(self, flat: np.ndarray) -> None:
+        import torch
+
+        offset = 0
+        with torch.no_grad():
+            for p in self.module.parameters():
+                size = p.numel()
+                chunk = flat[offset:offset + size].reshape(tuple(p.shape))
+                p.copy_(torch.from_numpy(chunk.astype(np.float32)))
+                offset += size
+
+    def sync_all_param(self) -> None:
+        current = self._flatten()
+        delta = (current - self._last) / api.workers_num()
+        self.tbh.add(delta, sync=True)
+        api.barrier()
+        self._last = self.tbh.get()
+        self._write_back(self._last)

@@ -1,0 +1,11 @@
+"""Helpers (reference ``binding/python/multiverso/utils.py:70-74``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def convert_data(data) -> np.ndarray:
+    """Coerce user input to a contiguous float32 ndarray (reference
+    ``convert_data``)."""
+    return np.ascontiguousarray(np.asarray(data, dtype=np.float32))

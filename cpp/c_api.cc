@@ -1,0 +1,369 @@
+// C ABI implementation: local native store by default, host bridge when
+// installed (see c_api.h). Reference surface: src/c_api.cpp:10-92 in the
+// Multiverso reference.
+#include "c_api.h"
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "mvtpu/flags.h"
+#include "mvtpu/log.h"
+#include "mvtpu/reader.h"
+#include "mvtpu/table_store.h"
+
+namespace {
+
+using mvtpu::AddOptionC;
+using mvtpu::Flags;
+using mvtpu::TableStore;
+
+MV_Bridge g_bridge;
+bool g_bridge_installed = false;
+std::mutex g_mu;
+
+// Handlers encode the table id + kind; 1-based so NULL stays invalid.
+constexpr intptr_t kArrayTag = 1 << 28;
+
+intptr_t MakeHandler(int id, bool is_array) {
+  return (is_array ? kArrayTag : 0) | (id + 1);
+}
+int HandlerId(TableHandler h) {
+  return static_cast<int>((reinterpret_cast<intptr_t>(h) & (kArrayTag - 1)) -
+                          1);
+}
+
+bool BridgeHas(void* fn) { return g_bridge_installed && fn != nullptr; }
+
+void RegisterCoreFlags() {
+  Flags& flags = Flags::Get();
+  flags.DefineString("ps_role", "default");
+  flags.DefineBool("ma", false);
+  flags.DefineBool("sync", false);
+  flags.DefineString("updater_type", "default");
+  flags.DefineInt("num_workers", 1);
+  flags.DefineInt("omp_threads", 4);
+  flags.DefineString("log_level", "info");
+}
+
+}  // namespace
+
+extern "C" {
+
+void MV_InstallBridge(const MV_Bridge* bridge) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  std::memcpy(&g_bridge, bridge, sizeof(MV_Bridge));
+  g_bridge_installed = true;
+}
+
+void MV_ClearBridge() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_bridge_installed = false;
+  std::memset(&g_bridge, 0, sizeof(MV_Bridge));
+}
+
+void MV_Init(int* argc, char* argv[]) {
+  RegisterCoreFlags();
+  if (argc != nullptr && argv != nullptr) {
+    *argc = Flags::Get().ParseCmdFlags(*argc, argv);
+  }
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.init))) {
+    g_bridge.init(argc, argv);
+  }
+}
+
+void MV_ShutDown() {
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.shutdown))) {
+    g_bridge.shutdown();
+    return;
+  }
+  TableStore::Get().Flush();
+}
+
+void MV_Barrier() {
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.barrier))) {
+    g_bridge.barrier();
+    return;
+  }
+  TableStore::Get().Flush();  // in-process: drain pending async adds
+}
+
+int MV_NumWorkers() {
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.num_workers)))
+    return g_bridge.num_workers();
+  return static_cast<int>(Flags::Get().GetInt("num_workers", 1));
+}
+
+int MV_WorkerId() {
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.worker_id)))
+    return g_bridge.worker_id();
+  return 0;
+}
+
+int MV_ServerId() {
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.server_id)))
+    return g_bridge.server_id();
+  return 0;
+}
+
+int MV_Rank() {
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.rank))) return g_bridge.rank();
+  return 0;
+}
+
+int MV_Size() {
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.size))) return g_bridge.size();
+  return 1;
+}
+
+int MV_NumServers() {
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.num_servers)))
+    return g_bridge.num_servers();
+  return 1;
+}
+
+int MV_SetFlag(const char* name, const char* value) {
+  RegisterCoreFlags();
+  return Flags::Get().Set(name, value) ? 0 : -1;
+}
+
+/* ---- array tables ---- */
+
+void MV_NewArrayTable(int size, TableHandler* out) {
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.new_array))) {
+    *out = reinterpret_cast<TableHandler>(
+        MakeHandler(g_bridge.new_array(size), true));
+    return;
+  }
+  int id = TableStore::Get().CreateTable(size, 1);
+  *out = reinterpret_cast<TableHandler>(MakeHandler(id, true));
+}
+
+void MV_GetArrayTable(TableHandler handler, float* data, int size) {
+  int id = HandlerId(handler);
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.get_array))) {
+    g_bridge.get_array(id, data, size);
+    return;
+  }
+  TableStore::Get().Flush();
+  mvtpu::Table* t = TableStore::Get().table(id);
+  MVTPU_CHECK(t != nullptr);
+  t->Get(data, size);
+}
+
+void MV_AddArrayTable(TableHandler handler, float* data, int size) {
+  int id = HandlerId(handler);
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.add_array))) {
+    g_bridge.add_array(id, data, size, 0);
+    return;
+  }
+  mvtpu::Table* t = TableStore::Get().table(id);
+  MVTPU_CHECK(t != nullptr);
+  t->Add(data, size, AddOptionC{});
+}
+
+void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size) {
+  int id = HandlerId(handler);
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.add_array))) {
+    g_bridge.add_array(id, data, size, 1);
+    return;
+  }
+  TableStore::Get().AddAsync(id, std::vector<float>(data, data + size), {},
+                             AddOptionC{});
+}
+
+/* ---- matrix tables ---- */
+
+void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out) {
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.new_matrix))) {
+    *out = reinterpret_cast<TableHandler>(
+        MakeHandler(g_bridge.new_matrix(num_row, num_col), false));
+    return;
+  }
+  int id = TableStore::Get().CreateTable(num_row, num_col);
+  *out = reinterpret_cast<TableHandler>(MakeHandler(id, false));
+}
+
+void MV_GetMatrixTableAll(TableHandler handler, float* data, int size) {
+  int id = HandlerId(handler);
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.get_matrix))) {
+    g_bridge.get_matrix(id, data, size);
+    return;
+  }
+  TableStore::Get().Flush();
+  mvtpu::Table* t = TableStore::Get().table(id);
+  MVTPU_CHECK(t != nullptr);
+  t->Get(data, size);
+}
+
+void MV_AddMatrixTableAll(TableHandler handler, float* data, int size) {
+  int id = HandlerId(handler);
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.add_matrix))) {
+    g_bridge.add_matrix(id, data, size, 0);
+    return;
+  }
+  mvtpu::Table* t = TableStore::Get().table(id);
+  MVTPU_CHECK(t != nullptr);
+  t->Add(data, size, AddOptionC{});
+}
+
+void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size) {
+  int id = HandlerId(handler);
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.add_matrix))) {
+    g_bridge.add_matrix(id, data, size, 1);
+    return;
+  }
+  TableStore::Get().AddAsync(id, std::vector<float>(data, data + size), {},
+                             AddOptionC{});
+}
+
+void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n) {
+  int id = HandlerId(handler);
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.get_rows))) {
+    g_bridge.get_rows(id, data, size, row_ids, row_ids_n);
+    return;
+  }
+  TableStore::Get().Flush();
+  mvtpu::Table* t = TableStore::Get().table(id);
+  MVTPU_CHECK(t != nullptr);
+  t->GetRows(row_ids, row_ids_n, data);
+}
+
+void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int row_ids[], int row_ids_n) {
+  int id = HandlerId(handler);
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.add_rows))) {
+    g_bridge.add_rows(id, data, size, row_ids, row_ids_n, 0);
+    return;
+  }
+  mvtpu::Table* t = TableStore::Get().table(id);
+  MVTPU_CHECK(t != nullptr);
+  t->AddRows(row_ids, row_ids_n, data, AddOptionC{});
+}
+
+void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
+                                  int row_ids[], int row_ids_n) {
+  int id = HandlerId(handler);
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.add_rows))) {
+    g_bridge.add_rows(id, data, size, row_ids, row_ids_n, 1);
+    return;
+  }
+  TableStore::Get().AddAsync(id, std::vector<float>(data, data + size),
+                             std::vector<int>(row_ids, row_ids + row_ids_n),
+                             AddOptionC{});
+}
+
+int MV_StoreTable(TableHandler handler, const char* path) {
+  int id = HandlerId(handler);
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.store_table)))
+    return g_bridge.store_table(id, path);
+  TableStore::Get().Flush();
+  mvtpu::Table* t = TableStore::Get().table(id);
+  if (t == nullptr) return -1;
+  std::FILE* f = std::fopen(path, "wb");
+  if (f == nullptr) return -1;
+  bool ok = t->Store(f);
+  std::fclose(f);
+  return ok ? 0 : -1;
+}
+
+int MV_LoadTable(TableHandler handler, const char* path) {
+  int id = HandlerId(handler);
+  if (BridgeHas(reinterpret_cast<void*>(g_bridge.load_table)))
+    return g_bridge.load_table(id, path);
+  TableStore::Get().Flush();
+  mvtpu::Table* t = TableStore::Get().table(id);
+  if (t == nullptr) return -1;
+  std::FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return -1;
+  bool ok = t->Load(f);
+  std::fclose(f);
+  return ok ? 0 : -1;
+}
+
+/* ---- native data loaders ---- */
+
+VocabHandler MV_VocabBuild(const char* path, int min_count) {
+  auto* vocab = new mvtpu::Vocab();
+  if (!vocab->Build(path, min_count)) {
+    delete vocab;
+    return nullptr;
+  }
+  return vocab;
+}
+
+int MV_VocabSize(VocabHandler vocab) {
+  return static_cast<mvtpu::Vocab*>(vocab)->size();
+}
+
+long long MV_VocabTrainWords(VocabHandler vocab) {
+  return static_cast<mvtpu::Vocab*>(vocab)->train_words();
+}
+
+void MV_VocabCounts(VocabHandler vocab, long long* out) {
+  const auto& counts = static_cast<mvtpu::Vocab*>(vocab)->counts();
+  std::memcpy(out, counts.data(), counts.size() * sizeof(long long));
+}
+
+const char* MV_VocabWord(VocabHandler vocab, int id) {
+  return static_cast<mvtpu::Vocab*>(vocab)->word(id).c_str();
+}
+
+void MV_VocabFree(VocabHandler vocab) {
+  delete static_cast<mvtpu::Vocab*>(vocab);
+}
+
+long long MV_CorpusEncode(VocabHandler vocab, const char* path,
+                          int32_t** ids_out, int32_t** sents_out,
+                          long long* n_out) {
+  auto* v = static_cast<mvtpu::Vocab*>(vocab);
+  std::vector<int32_t> ids, sents;
+  long long words_read = 0;
+  if (!v->Encode(path, &ids, &sents, &words_read)) return -1;
+  auto* ids_buf = new int32_t[ids.size()];
+  auto* sents_buf = new int32_t[sents.size()];
+  std::memcpy(ids_buf, ids.data(), ids.size() * sizeof(int32_t));
+  std::memcpy(sents_buf, sents.data(), sents.size() * sizeof(int32_t));
+  *ids_out = ids_buf;
+  *sents_out = sents_buf;
+  *n_out = static_cast<long long>(ids.size());
+  return words_read;
+}
+
+void MV_BufferFree(void* ptr) { delete[] static_cast<int32_t*>(ptr); }
+
+SvmHandler MV_SvmParse(const char* path) {
+  auto* data = new mvtpu::SvmData();
+  if (!mvtpu::ParseLibsvm(path, data)) {
+    delete data;
+    return nullptr;
+  }
+  return data;
+}
+
+long long MV_SvmNumSamples(SvmHandler svm) {
+  return static_cast<long long>(
+      static_cast<mvtpu::SvmData*>(svm)->labels.size());
+}
+
+long long MV_SvmNumEntries(SvmHandler svm) {
+  return static_cast<long long>(static_cast<mvtpu::SvmData*>(svm)->keys.size());
+}
+
+void MV_SvmCopy(SvmHandler svm, float* labels, int64_t* indptr, int32_t* keys,
+                float* values) {
+  auto* data = static_cast<mvtpu::SvmData*>(svm);
+  std::memcpy(labels, data->labels.data(),
+              data->labels.size() * sizeof(float));
+  std::memcpy(indptr, data->indptr.data(),
+              data->indptr.size() * sizeof(int64_t));
+  std::memcpy(keys, data->keys.data(), data->keys.size() * sizeof(int32_t));
+  std::memcpy(values, data->values.data(),
+              data->values.size() * sizeof(float));
+}
+
+void MV_SvmFree(SvmHandler svm) { delete static_cast<mvtpu::SvmData*>(svm); }
+
+}  // extern "C"

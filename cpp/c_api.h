@@ -1,0 +1,125 @@
+/* C ABI of multiverso-tpu.
+ *
+ * Source-compatible with the reference surface
+ * (include/multiverso/c_api.h:14-54 in the Multiverso reference): the same
+ * MV_Init/ShutDown/Barrier, worker queries, and float Array/Matrix table
+ * calls, so the reference's Python/Lua callers port unchanged.
+ *
+ * Backends: by default the library serves tables from an in-process native
+ * store (single-process PS — the reference's role=ALL mode). A host runtime
+ * (the Python/JAX framework) can install a bridge (MV_InstallBridge) that
+ * reroutes every call to TPU-resident sharded tables.
+ *
+ * Extensions beyond the reference surface are marked "ext".
+ */
+#ifndef MVTPU_C_API_H_
+#define MVTPU_C_API_H_
+
+#include <stdint.h>
+
+#define DllExport
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* TableHandler;
+
+DllExport void MV_Init(int* argc, char* argv[]);
+DllExport void MV_ShutDown();
+DllExport void MV_Barrier();
+DllExport int MV_NumWorkers();
+DllExport int MV_WorkerId();
+DllExport int MV_ServerId();
+
+/* ext: more process queries + flags */
+DllExport int MV_Rank();
+DllExport int MV_Size();
+DllExport int MV_NumServers();
+DllExport int MV_SetFlag(const char* name, const char* value);
+
+/* Array Table (float) */
+DllExport void MV_NewArrayTable(int size, TableHandler* out);
+DllExport void MV_GetArrayTable(TableHandler handler, float* data, int size);
+DllExport void MV_AddArrayTable(TableHandler handler, float* data, int size);
+DllExport void MV_AddAsyncArrayTable(TableHandler handler, float* data,
+                                     int size);
+
+/* Matrix Table (float) */
+DllExport void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
+DllExport void MV_GetMatrixTableAll(TableHandler handler, float* data,
+                                    int size);
+DllExport void MV_AddMatrixTableAll(TableHandler handler, float* data,
+                                    int size);
+DllExport void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data,
+                                         int size);
+DllExport void MV_GetMatrixTableByRows(TableHandler handler, float* data,
+                                       int size, int row_ids[], int row_ids_n);
+DllExport void MV_AddMatrixTableByRows(TableHandler handler, float* data,
+                                       int size, int row_ids[], int row_ids_n);
+DllExport void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data,
+                                            int size, int row_ids[],
+                                            int row_ids_n);
+
+/* ext: table checkpoint (reference Serializable Store/Load) */
+DllExport int MV_StoreTable(TableHandler handler, const char* path);
+DllExport int MV_LoadTable(TableHandler handler, const char* path);
+
+/* ext: host-runtime bridge. All pointers may be NULL (falls back to the
+ * local store for that operation). */
+typedef struct MV_Bridge {
+  void (*init)(int* argc, char** argv);
+  void (*shutdown)(void);
+  void (*barrier)(void);
+  int (*num_workers)(void);
+  int (*worker_id)(void);
+  int (*server_id)(void);
+  int (*rank)(void);
+  int (*size)(void);
+  int (*num_servers)(void);
+  /* tables: ids are small ints chosen by the bridge owner */
+  int (*new_array)(int size);
+  void (*get_array)(int table, float* data, int size);
+  void (*add_array)(int table, const float* data, int size, int async_hint);
+  int (*new_matrix)(int num_row, int num_col);
+  void (*get_matrix)(int table, float* data, int size);
+  void (*add_matrix)(int table, const float* data, int size, int async_hint);
+  void (*get_rows)(int table, float* data, int size, const int* row_ids,
+                   int row_ids_n);
+  void (*add_rows)(int table, const float* data, int size, const int* row_ids,
+                   int row_ids_n, int async_hint);
+  int (*store_table)(int table, const char* path);
+  int (*load_table)(int table, const char* path);
+} MV_Bridge;
+
+DllExport void MV_InstallBridge(const MV_Bridge* bridge);
+DllExport void MV_ClearBridge();
+
+/* ext: native data loaders (word2vec corpus + libsvm) */
+typedef void* VocabHandler;
+DllExport VocabHandler MV_VocabBuild(const char* path, int min_count);
+DllExport int MV_VocabSize(VocabHandler vocab);
+DllExport long long MV_VocabTrainWords(VocabHandler vocab);
+DllExport void MV_VocabCounts(VocabHandler vocab, long long* out);
+DllExport const char* MV_VocabWord(VocabHandler vocab, int id);
+DllExport void MV_VocabFree(VocabHandler vocab);
+/* Encodes the corpus; returns word/sentence-id buffers owned by the library
+ * (free with MV_BufferFree). *n_out = token count; returns words consumed. */
+DllExport long long MV_CorpusEncode(VocabHandler vocab, const char* path,
+                                    int32_t** ids_out, int32_t** sents_out,
+                                    long long* n_out);
+DllExport void MV_BufferFree(void* ptr);
+
+typedef void* SvmHandler;
+DllExport SvmHandler MV_SvmParse(const char* path);
+DllExport long long MV_SvmNumSamples(SvmHandler svm);
+DllExport long long MV_SvmNumEntries(SvmHandler svm);
+DllExport void MV_SvmCopy(SvmHandler svm, float* labels, int64_t* indptr,
+                          int32_t* keys, float* values);
+DllExport void MV_SvmFree(SvmHandler svm);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* MVTPU_C_API_H_ */

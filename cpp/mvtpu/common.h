@@ -1,0 +1,112 @@
+// Host-side concurrency primitives.
+//
+// Native equivalents of the reference utilities (Multiverso reference:
+// include/multiverso/util/mt_queue.h:19-147, util/waiter.h:9-35,
+// util/async_buffer.h:11-116). These back the local table store's async
+// apply thread and the native data loaders.
+#ifndef MVTPU_COMMON_H_
+#define MVTPU_COMMON_H_
+
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <utility>
+
+namespace mvtpu {
+
+// Blocking MPMC queue with an Exit/Alive shutdown protocol.
+template <typename T>
+class MtQueue {
+ public:
+  MtQueue() : alive_(true) {}
+
+  void Push(T item) {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      queue_.push_back(std::move(item));
+    }
+    cv_.notify_one();
+  }
+
+  // Blocks until an item arrives or Exit(); returns false on shutdown.
+  bool Pop(T* out) {
+    std::unique_lock<std::mutex> lock(mu_);
+    cv_.wait(lock, [this] { return !queue_.empty() || !alive_; });
+    if (queue_.empty()) return false;
+    *out = std::move(queue_.front());
+    queue_.pop_front();
+    return true;
+  }
+
+  bool TryPop(T* out) {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (queue_.empty()) return false;
+    *out = std::move(queue_.front());
+    queue_.pop_front();
+    return true;
+  }
+
+  size_t Size() const {
+    std::lock_guard<std::mutex> lock(mu_);
+    return queue_.size();
+  }
+
+  bool Empty() const {
+    std::lock_guard<std::mutex> lock(mu_);
+    return queue_.empty();
+  }
+
+  void Exit() {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      alive_ = false;
+    }
+    cv_.notify_all();
+  }
+
+  bool Alive() const {
+    std::lock_guard<std::mutex> lock(mu_);
+    return alive_;
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<T> queue_;
+  bool alive_;
+};
+
+// Counted latch: Wait blocks until the count reaches zero.
+class Waiter {
+ public:
+  explicit Waiter(int count = 0) : count_(count) {}
+
+  void Reset(int count) {
+    std::lock_guard<std::mutex> lock(mu_);
+    count_ = count;
+  }
+
+  void Notify() {
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      --count_;
+    }
+    cv_.notify_all();
+  }
+
+  void Wait() {
+    std::unique_lock<std::mutex> lock(mu_);
+    cv_.wait(lock, [this] { return count_ <= 0; });
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int count_;
+};
+
+}  // namespace mvtpu
+
+#endif  // MVTPU_COMMON_H_

@@ -1,0 +1,31 @@
+#include "mvtpu/dashboard.h"
+
+#include <sstream>
+
+namespace mvtpu {
+
+std::mutex Dashboard::mu_;
+std::map<std::string, Monitor*> Dashboard::monitors_;
+
+Monitor* Dashboard::GetOrCreate(const std::string& name) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = monitors_.find(name);
+  if (it != monitors_.end()) return it->second;
+  Monitor* mon = new Monitor();
+  monitors_[name] = mon;
+  return mon;
+}
+
+std::string Dashboard::Display() {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::ostringstream out;
+  out << "--------------Dashboard--------------\n";
+  for (const auto& kv : monitors_) {
+    out << "[" << kv.first << "] count = " << kv.second->count()
+        << " total = " << kv.second->total_ms()
+        << " ms avg = " << kv.second->average_ms() << " ms\n";
+  }
+  return out.str();
+}
+
+}  // namespace mvtpu

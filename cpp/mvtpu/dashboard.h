@@ -1,0 +1,50 @@
+// Named timing monitors (count / total ms / average).
+//
+// Native form of the reference Dashboard/Monitor (Multiverso reference:
+// include/multiverso/dashboard.h:16-73, src/dashboard.cpp:14-45).
+#ifndef MVTPU_DASHBOARD_H_
+#define MVTPU_DASHBOARD_H_
+
+#include <chrono>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace mvtpu {
+
+class Monitor {
+ public:
+  void Begin() { start_ = std::chrono::steady_clock::now(); }
+  void End() {
+    double ms = std::chrono::duration<double, std::milli>(
+                    std::chrono::steady_clock::now() - start_)
+                    .count();
+    std::lock_guard<std::mutex> lock(mu_);
+    ++count_;
+    total_ms_ += ms;
+  }
+  long long count() const { return count_; }
+  double total_ms() const { return total_ms_; }
+  double average_ms() const { return count_ ? total_ms_ / count_ : 0.0; }
+
+ private:
+  std::mutex mu_;
+  long long count_ = 0;
+  double total_ms_ = 0.0;
+  std::chrono::steady_clock::time_point start_;
+};
+
+class Dashboard {
+ public:
+  static Monitor* GetOrCreate(const std::string& name);
+  // Renders "[name] count = N total = X ms avg = Y ms" lines.
+  static std::string Display();
+
+ private:
+  static std::mutex mu_;
+  static std::map<std::string, Monitor*> monitors_;
+};
+
+}  // namespace mvtpu
+
+#endif  // MVTPU_DASHBOARD_H_

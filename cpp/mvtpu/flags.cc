@@ -1,0 +1,152 @@
+#include "mvtpu/flags.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <cstring>
+
+namespace mvtpu {
+
+Flags& Flags::Get() {
+  static Flags instance;
+  return instance;
+}
+
+void Flags::DefineInt(const std::string& name, long long value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (entries_.count(name)) return;
+  Entry e;
+  e.type = Type::kInt;
+  e.i = value;
+  entries_[name] = e;
+}
+
+void Flags::DefineDouble(const std::string& name, double value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (entries_.count(name)) return;
+  Entry e;
+  e.type = Type::kDouble;
+  e.d = value;
+  entries_[name] = e;
+}
+
+void Flags::DefineBool(const std::string& name, bool value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (entries_.count(name)) return;
+  Entry e;
+  e.type = Type::kBool;
+  e.b = value;
+  entries_[name] = e;
+}
+
+void Flags::DefineString(const std::string& name, const std::string& value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (entries_.count(name)) return;
+  Entry e;
+  e.type = Type::kString;
+  e.s = value;
+  entries_[name] = e;
+}
+
+static bool ParseBool(const std::string& text, bool* out) {
+  std::string t;
+  t.reserve(text.size());
+  for (char c : text) t.push_back(static_cast<char>(std::tolower(c)));
+  if (t == "true" || t == "1" || t == "yes" || t == "on") {
+    *out = true;
+    return true;
+  }
+  if (t == "false" || t == "0" || t == "no" || t == "off") {
+    *out = false;
+    return true;
+  }
+  return false;
+}
+
+bool Flags::Set(const std::string& name, const std::string& text) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = entries_.find(name);
+  if (it == entries_.end()) return false;
+  Entry& e = it->second;
+  char* end = nullptr;
+  switch (e.type) {
+    case Type::kInt: {
+      long long v = std::strtoll(text.c_str(), &end, 10);
+      if (end == text.c_str() || *end != '\0') return false;
+      e.i = v;
+      return true;
+    }
+    case Type::kDouble: {
+      double v = std::strtod(text.c_str(), &end);
+      if (end == text.c_str() || *end != '\0') return false;
+      e.d = v;
+      return true;
+    }
+    case Type::kBool:
+      return ParseBool(text, &e.b);
+    case Type::kString:
+      e.s = text;
+      return true;
+  }
+  return false;
+}
+
+bool Flags::Known(const std::string& name) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return entries_.count(name) != 0;
+}
+
+long long Flags::GetInt(const std::string& name, long long fallback) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = entries_.find(name);
+  return it != entries_.end() && it->second.type == Type::kInt ? it->second.i
+                                                               : fallback;
+}
+
+double Flags::GetDouble(const std::string& name, double fallback) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = entries_.find(name);
+  return it != entries_.end() && it->second.type == Type::kDouble
+             ? it->second.d
+             : fallback;
+}
+
+bool Flags::GetBool(const std::string& name, bool fallback) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = entries_.find(name);
+  return it != entries_.end() && it->second.type == Type::kBool ? it->second.b
+                                                                : fallback;
+}
+
+std::string Flags::GetString(const std::string& name,
+                             const std::string& fallback) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = entries_.find(name);
+  return it != entries_.end() && it->second.type == Type::kString
+             ? it->second.s
+             : fallback;
+}
+
+int Flags::ParseCmdFlags(int argc, char** argv) {
+  int kept = 0;
+  for (int i = 0; i < argc; ++i) {
+    const char* token = argv[i];
+    const char* body = nullptr;
+    if (std::strncmp(token, "--", 2) == 0) {
+      body = token + 2;
+    } else if (token[0] == '-') {
+      body = token + 1;
+    }
+    bool consumed = false;
+    if (body != nullptr) {
+      const char* eq = std::strchr(body, '=');
+      if (eq != nullptr) {
+        std::string key(body, eq - body);
+        if (Known(key) && Set(key, std::string(eq + 1))) consumed = true;
+      }
+    }
+    if (!consumed) argv[kept++] = argv[i];
+  }
+  return kept;
+}
+
+}  // namespace mvtpu

@@ -1,0 +1,54 @@
+// Typed flag registry + "-key=value" CLI parsing.
+//
+// Native form of the reference config system (Multiverso reference:
+// include/multiverso/util/configure.h:67-110, src/util/configure.cpp:9-44),
+// sharing behavior with the Python registry in multiverso_tpu/config.py:
+// one registry keyed by name, argv compaction on parse, programmatic set.
+#ifndef MVTPU_FLAGS_H_
+#define MVTPU_FLAGS_H_
+
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace mvtpu {
+
+class Flags {
+ public:
+  static Flags& Get();
+
+  void DefineInt(const std::string& name, long long value);
+  void DefineDouble(const std::string& name, double value);
+  void DefineBool(const std::string& name, bool value);
+  void DefineString(const std::string& name, const std::string& value);
+
+  // Returns false if the flag is unknown or the text does not coerce.
+  bool Set(const std::string& name, const std::string& text);
+  bool Known(const std::string& name) const;
+
+  long long GetInt(const std::string& name, long long fallback = 0) const;
+  double GetDouble(const std::string& name, double fallback = 0.0) const;
+  bool GetBool(const std::string& name, bool fallback = false) const;
+  std::string GetString(const std::string& name,
+                        const std::string& fallback = "") const;
+
+  // Consumes known "-key=value" tokens, compacting argv in place; returns
+  // the new argc.
+  int ParseCmdFlags(int argc, char** argv);
+
+ private:
+  enum class Type { kInt, kDouble, kBool, kString };
+  struct Entry {
+    Type type;
+    long long i = 0;
+    double d = 0.0;
+    bool b = false;
+    std::string s;
+  };
+  mutable std::mutex mu_;
+  std::map<std::string, Entry> entries_;
+};
+
+}  // namespace mvtpu
+
+#endif  // MVTPU_FLAGS_H_

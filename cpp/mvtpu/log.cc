@@ -1,0 +1,92 @@
+#include "mvtpu/log.h"
+
+#include <cstdarg>
+#include <cstdlib>
+#include <ctime>
+
+namespace mvtpu {
+namespace {
+
+struct LogState {
+  std::mutex mu;
+  LogLevel level = LogLevel::kInfo;
+  FILE* file = nullptr;
+};
+
+LogState& State() {
+  static LogState state;
+  return state;
+}
+
+const char* LevelName(LogLevel level) {
+  switch (level) {
+    case LogLevel::kDebug: return "DEBUG";
+    case LogLevel::kInfo: return "INFO";
+    case LogLevel::kError: return "ERROR";
+    case LogLevel::kFatal: return "FATAL";
+  }
+  return "?";
+}
+
+void VWrite(LogLevel level, const char* format, va_list args) {
+  LogState& state = State();
+  std::lock_guard<std::mutex> lock(state.mu);
+  if (level < state.level) return;
+  char stamp[32];
+  std::time_t now = std::time(nullptr);
+  std::tm tm_buf;
+  localtime_r(&now, &tm_buf);
+  std::strftime(stamp, sizeof(stamp), "%Y-%m-%d %H:%M:%S", &tm_buf);
+  char message[2048];
+  std::vsnprintf(message, sizeof(message), format, args);
+  std::fprintf(stdout, "[%s] [%s] %s\n", LevelName(level), stamp, message);
+  std::fflush(stdout);
+  if (state.file != nullptr) {
+    std::fprintf(state.file, "[%s] [%s] %s\n", LevelName(level), stamp,
+                 message);
+    std::fflush(state.file);
+  }
+}
+
+}  // namespace
+
+void Log::ResetLogLevel(LogLevel level) { State().level = level; }
+
+void Log::ResetLogFile(const std::string& path) {
+  LogState& state = State();
+  std::lock_guard<std::mutex> lock(state.mu);
+  if (state.file != nullptr) {
+    std::fclose(state.file);
+    state.file = nullptr;
+  }
+  if (!path.empty()) state.file = std::fopen(path.c_str(), "a");
+}
+
+#define MVTPU_LOG_IMPL(name, level)           \
+  void Log::name(const char* format, ...) {   \
+    va_list args;                             \
+    va_start(args, format);                   \
+    VWrite(level, format, args);              \
+    va_end(args);                             \
+  }
+
+MVTPU_LOG_IMPL(Debug, LogLevel::kDebug)
+MVTPU_LOG_IMPL(Info, LogLevel::kInfo)
+MVTPU_LOG_IMPL(Error, LogLevel::kError)
+
+void Log::Write(LogLevel level, const char* format, ...) {
+  va_list args;
+  va_start(args, format);
+  VWrite(level, format, args);
+  va_end(args);
+}
+
+void Log::Fatal(const char* format, ...) {
+  va_list args;
+  va_start(args, format);
+  VWrite(LogLevel::kFatal, format, args);
+  va_end(args);
+  std::abort();
+}
+
+}  // namespace mvtpu

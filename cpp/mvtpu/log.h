@@ -1,0 +1,41 @@
+// Leveled, timestamped logger.
+//
+// Native form of the reference logger (Multiverso reference:
+// include/multiverso/util/log.h:9-18,110-142): Debug/Info/Error/Fatal with
+// "[LEVEL] [timestamp]" prefixes, optional file sink, CHECK macro.
+#ifndef MVTPU_LOG_H_
+#define MVTPU_LOG_H_
+
+#include <cstdio>
+#include <mutex>
+#include <string>
+
+namespace mvtpu {
+
+enum class LogLevel { kDebug = 0, kInfo = 1, kError = 2, kFatal = 3 };
+
+class Log {
+ public:
+  static void ResetLogLevel(LogLevel level);
+  static void ResetLogFile(const std::string& path);  // "" detaches
+  static void Write(LogLevel level, const char* format, ...);
+
+  static void Debug(const char* format, ...);
+  static void Info(const char* format, ...);
+  static void Error(const char* format, ...);
+  // Logs and aborts the process (the local store has no exception channel
+  // across the C ABI).
+  [[noreturn]] static void Fatal(const char* format, ...);
+};
+
+#define MVTPU_CHECK(cond)                                                  \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      ::mvtpu::Log::Fatal("CHECK failed at %s:%d: %s", __FILE__, __LINE__, \
+                          #cond);                                          \
+    }                                                                      \
+  } while (0)
+
+}  // namespace mvtpu
+
+#endif  // MVTPU_LOG_H_

@@ -1,0 +1,160 @@
+#include "mvtpu/reader.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace mvtpu {
+
+namespace {
+
+// Buffered line reader over stdio (TextReader analogue, io.h:114-130).
+class LineReader {
+ public:
+  explicit LineReader(const std::string& path)
+      : file_(std::fopen(path.c_str(), "rb")) {}
+  ~LineReader() {
+    if (file_ != nullptr) std::fclose(file_);
+  }
+  bool ok() const { return file_ != nullptr; }
+
+  bool NextLine(std::string* line) {
+    if (file_ == nullptr) return false;
+    line->clear();
+    char buf[1 << 16];
+    while (std::fgets(buf, sizeof(buf), file_) != nullptr) {
+      size_t len = std::strlen(buf);
+      bool end = len > 0 && buf[len - 1] == '\n';
+      if (end) buf[--len] = '\0';
+      if (len > 0 && buf[len - 1] == '\r') buf[--len] = '\0';
+      line->append(buf, len);
+      if (end) return true;
+      if (len + 1 < sizeof(buf)) return true;  // EOF without newline
+    }
+    return !line->empty();
+  }
+
+ private:
+  std::FILE* file_;
+};
+
+inline bool IsSpace(char c) {
+  return c == ' ' || c == '\t' || c == '\v' || c == '\f';
+}
+
+template <typename Fn>
+void ForEachToken(const std::string& line, Fn fn) {
+  const char* p = line.c_str();
+  while (*p != '\0') {
+    while (IsSpace(*p)) ++p;
+    if (*p == '\0') break;
+    const char* start = p;
+    while (*p != '\0' && !IsSpace(*p)) ++p;
+    fn(start, static_cast<size_t>(p - start));
+  }
+}
+
+}  // namespace
+
+bool Vocab::Build(const std::string& path, int min_count) {
+  LineReader reader(path);
+  if (!reader.ok()) return false;
+  std::unordered_map<std::string, long long> counter;
+  counter.reserve(1 << 20);
+  std::string line, token;
+  while (reader.NextLine(&line)) {
+    ForEachToken(line, [&](const char* start, size_t len) {
+      token.assign(start, len);
+      ++counter[token];
+    });
+  }
+  std::vector<std::pair<std::string, long long>> sorted;
+  sorted.reserve(counter.size());
+  for (auto& kv : counter) {
+    if (kv.second >= min_count) sorted.emplace_back(kv.first, kv.second);
+  }
+  std::sort(sorted.begin(), sorted.end(), [](const auto& a, const auto& b) {
+    return a.second != b.second ? a.second > b.second : a.first < b.first;
+  });
+  index_.clear();
+  words_.clear();
+  counts_.clear();
+  train_words_ = 0;
+  words_.reserve(sorted.size());
+  counts_.reserve(sorted.size());
+  for (auto& kv : sorted) {
+    index_[kv.first] = static_cast<int>(words_.size());
+    words_.push_back(kv.first);
+    counts_.push_back(kv.second);
+    train_words_ += kv.second;
+  }
+  return true;
+}
+
+bool Vocab::Encode(const std::string& path, std::vector<int32_t>* ids,
+                   std::vector<int32_t>* sent_ids,
+                   long long* words_read) const {
+  LineReader reader(path);
+  if (!reader.ok()) return false;
+  ids->clear();
+  sent_ids->clear();
+  long long consumed = 0;
+  std::string line, token;
+  std::vector<int32_t> sentence;
+  int32_t sent_counter = 0;
+  while (reader.NextLine(&line)) {
+    sentence.clear();
+    ForEachToken(line, [&](const char* start, size_t len) {
+      token.assign(start, len);
+      auto it = index_.find(token);
+      if (it != index_.end()) {
+        sentence.push_back(it->second);
+        ++consumed;
+      }
+    });
+    if (sentence.size() < 2) continue;
+    ids->insert(ids->end(), sentence.begin(), sentence.end());
+    sent_ids->insert(sent_ids->end(), sentence.size(), sent_counter);
+    ++sent_counter;
+  }
+  if (words_read != nullptr) *words_read = consumed;
+  return true;
+}
+
+bool ParseLibsvm(const std::string& path, SvmData* out) {
+  LineReader reader(path);
+  if (!reader.ok()) return false;
+  out->labels.clear();
+  out->indptr.assign(1, 0);
+  out->keys.clear();
+  out->values.clear();
+  std::string line;
+  while (reader.NextLine(&line)) {
+    bool first = true;
+    bool any = false;
+    ForEachToken(line, [&](const char* start, size_t len) {
+      if (first) {
+        out->labels.push_back(std::strtof(start, nullptr));
+        first = false;
+        any = true;
+        return;
+      }
+      const char* colon =
+          static_cast<const char*>(std::memchr(start, ':', len));
+      if (colon == nullptr) {
+        out->keys.push_back(
+            static_cast<int32_t>(std::strtol(start, nullptr, 10)));
+        out->values.push_back(1.0f);
+      } else {
+        out->keys.push_back(
+            static_cast<int32_t>(std::strtol(start, nullptr, 10)));
+        out->values.push_back(std::strtof(colon + 1, nullptr));
+      }
+    });
+    if (any) out->indptr.push_back(static_cast<int64_t>(out->keys.size()));
+  }
+  return true;
+}
+
+}  // namespace mvtpu

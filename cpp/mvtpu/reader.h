@@ -1,0 +1,63 @@
+// Native data loaders: word2vec corpus vocab/encoder + libsvm parser.
+//
+// Native re-build of the reference app readers — WordEmbedding's
+// Dictionary/Reader (Multiverso reference:
+// Applications/WordEmbedding/src/dictionary.cpp, reader.cpp) and
+// LogisticRegression's SampleReader parse path
+// (Applications/LogisticRegression/src/reader.cpp:169). These are the
+// host-side hot loops of the data pipeline; the Python apps call them via
+// ctypes (multiverso_tpu/native.py) to feed the device-resident training
+// paths without Python tokenisation overhead.
+#ifndef MVTPU_READER_H_
+#define MVTPU_READER_H_
+
+#include <cstdint>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace mvtpu {
+
+class Vocab {
+ public:
+  // Streams the corpus, counts whitespace tokens, keeps count >= min_count,
+  // orders by descending count (reference Dictionary semantics).
+  bool Build(const std::string& path, int min_count);
+
+  int size() const { return static_cast<int>(words_.size()); }
+  long long train_words() const { return train_words_; }
+  const std::vector<long long>& counts() const { return counts_; }
+  const std::string& word(int id) const { return words_[id]; }
+  int id(const std::string& word) const {
+    auto it = index_.find(word);
+    return it == index_.end() ? -1 : it->second;
+  }
+
+  // Encodes the corpus into (word ids, sentence ids); one input line = one
+  // sentence; out-of-vocab tokens are dropped; sentences with < 2 surviving
+  // tokens are skipped. Returns the consumed word count (pre-drop) in
+  // *words_read for lr-decay bookkeeping.
+  bool Encode(const std::string& path, std::vector<int32_t>* ids,
+              std::vector<int32_t>* sent_ids, long long* words_read) const;
+
+ private:
+  std::unordered_map<std::string, int> index_;
+  std::vector<std::string> words_;
+  std::vector<long long> counts_;
+  long long train_words_ = 0;
+};
+
+// Parsed libsvm/dense samples in CSR-like layout.
+struct SvmData {
+  std::vector<float> labels;
+  std::vector<int64_t> indptr;  // size labels.size() + 1
+  std::vector<int32_t> keys;
+  std::vector<float> values;
+};
+
+// "label k:v k:v ..." per line (value defaults to 1 when omitted).
+bool ParseLibsvm(const std::string& path, SvmData* out);
+
+}  // namespace mvtpu
+
+#endif  // MVTPU_READER_H_

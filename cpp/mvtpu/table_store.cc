@@ -1,0 +1,218 @@
+#include "mvtpu/table_store.h"
+
+#include <cmath>
+#include <cstring>
+
+#include "mvtpu/flags.h"
+#include "mvtpu/log.h"
+
+namespace mvtpu {
+
+namespace {
+
+constexpr float kAdaGradEps = 1e-6f;
+
+class DefaultUpdater : public Updater {
+ public:
+  void Update(std::vector<float>& data, const float* delta, size_t offset,
+              size_t size, const AddOptionC&) override {
+#pragma omp parallel for
+    for (long long i = 0; i < static_cast<long long>(size); ++i) {
+      data[offset + i] += delta[i];
+    }
+  }
+};
+
+class SgdUpdater : public Updater {
+ public:
+  void Update(std::vector<float>& data, const float* delta, size_t offset,
+              size_t size, const AddOptionC&) override {
+#pragma omp parallel for
+    for (long long i = 0; i < static_cast<long long>(size); ++i) {
+      data[offset + i] -= delta[i];
+    }
+  }
+};
+
+class MomentumUpdater : public Updater {
+ public:
+  explicit MomentumUpdater(size_t table_size) : state_(table_size, 0.0f) {}
+
+  void Update(std::vector<float>& data, const float* delta, size_t offset,
+              size_t size, const AddOptionC& option) override {
+    const float m = option.momentum;
+#pragma omp parallel for
+    for (long long i = 0; i < static_cast<long long>(size); ++i) {
+      float s = m * state_[offset + i] + (1.0f - m) * delta[i];
+      state_[offset + i] = s;
+      data[offset + i] -= s;
+    }
+  }
+
+ private:
+  std::vector<float> state_;
+};
+
+class AdaGradUpdater : public Updater {
+ public:
+  AdaGradUpdater(size_t table_size, int num_workers)
+      : size_(table_size),
+        g_sqr_(static_cast<size_t>(num_workers) * table_size, 0.0f) {}
+
+  void Update(std::vector<float>& data, const float* delta, size_t offset,
+              size_t size, const AddOptionC& option) override {
+    float* g = g_sqr_.data() + static_cast<size_t>(option.worker_id) * size_;
+    const float rho = option.rho;
+    const float lr = option.learning_rate;
+#pragma omp parallel for
+    for (long long i = 0; i < static_cast<long long>(size); ++i) {
+      float d = delta[i];
+      float acc = g[offset + i] + d * d;
+      g[offset + i] = acc;
+      data[offset + i] -= rho / std::sqrt(acc + kAdaGradEps) * d / lr;
+    }
+  }
+
+ private:
+  size_t size_;
+  std::vector<float> g_sqr_;
+};
+
+}  // namespace
+
+std::unique_ptr<Updater> Updater::Create(const std::string& type,
+                                         size_t table_size, int num_workers) {
+  if (type == "sgd") return std::unique_ptr<Updater>(new SgdUpdater());
+  if (type == "momentum_sgd")
+    return std::unique_ptr<Updater>(new MomentumUpdater(table_size));
+  if (type == "adagrad")
+    return std::unique_ptr<Updater>(
+        new AdaGradUpdater(table_size, num_workers < 1 ? 1 : num_workers));
+  return std::unique_ptr<Updater>(new DefaultUpdater());
+}
+
+Table::Table(long long num_row, long long num_col,
+             const std::string& updater_type, int num_workers)
+    : num_row_(num_row),
+      num_col_(num_col),
+      data_(static_cast<size_t>(num_row * num_col), 0.0f),
+      updater_(Updater::Create(updater_type, static_cast<size_t>(num_row * num_col),
+                               num_workers)) {}
+
+void Table::Get(float* out, long long size) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  MVTPU_CHECK(size <= this->size());
+  std::memcpy(out, data_.data(), static_cast<size_t>(size) * sizeof(float));
+}
+
+void Table::GetRows(const int* row_ids, int n, float* out) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (int i = 0; i < n; ++i) {
+    MVTPU_CHECK(row_ids[i] >= 0 && row_ids[i] < num_row_);
+    std::memcpy(out + static_cast<size_t>(i) * num_col_,
+                data_.data() + static_cast<size_t>(row_ids[i]) * num_col_,
+                static_cast<size_t>(num_col_) * sizeof(float));
+  }
+}
+
+void Table::Add(const float* delta, long long size, const AddOptionC& option) {
+  std::lock_guard<std::mutex> lock(mu_);
+  MVTPU_CHECK(size <= this->size());
+  updater_->Update(data_, delta, 0, static_cast<size_t>(size), option);
+}
+
+void Table::AddRows(const int* row_ids, int n, const float* delta,
+                    const AddOptionC& option) {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (int i = 0; i < n; ++i) {
+    MVTPU_CHECK(row_ids[i] >= 0 && row_ids[i] < num_row_);
+    updater_->Update(data_, delta + static_cast<size_t>(i) * num_col_,
+                     static_cast<size_t>(row_ids[i]) * num_col_,
+                     static_cast<size_t>(num_col_), option);
+  }
+}
+
+bool Table::Store(std::FILE* f) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  long long dims[2] = {num_row_, num_col_};
+  if (std::fwrite(dims, sizeof(dims), 1, f) != 1) return false;
+  return std::fwrite(data_.data(), sizeof(float), data_.size(), f) ==
+         data_.size();
+}
+
+bool Table::Load(std::FILE* f) {
+  std::lock_guard<std::mutex> lock(mu_);
+  long long dims[2];
+  if (std::fread(dims, sizeof(dims), 1, f) != 1) return false;
+  if (dims[0] != num_row_ || dims[1] != num_col_) return false;
+  return std::fread(data_.data(), sizeof(float), data_.size(), f) ==
+         data_.size();
+}
+
+TableStore& TableStore::Get() {
+  static TableStore instance;
+  return instance;
+}
+
+TableStore::TableStore() {
+  running_ = true;
+  apply_thread_ = std::thread(&TableStore::ApplyLoop, this);
+}
+
+TableStore::~TableStore() { Shutdown(); }
+
+void TableStore::Shutdown() {
+  bool expected = true;
+  if (!running_.compare_exchange_strong(expected, false)) return;
+  queue_.Exit();
+  if (apply_thread_.joinable()) apply_thread_.join();
+}
+
+int TableStore::CreateTable(long long num_row, long long num_col) {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::string updater_type =
+      Flags::Get().GetString("updater_type", "default");
+  int workers = static_cast<int>(Flags::Get().GetInt("num_workers", 1));
+  tables_.emplace_back(new Table(num_row, num_col, updater_type, workers));
+  return static_cast<int>(tables_.size()) - 1;
+}
+
+Table* TableStore::table(int id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (id < 0 || id >= static_cast<int>(tables_.size())) return nullptr;
+  return tables_[id].get();
+}
+
+void TableStore::AddAsync(int table_id, std::vector<float> delta,
+                          std::vector<int> row_ids, AddOptionC option) {
+  ++enqueued_;
+  queue_.Push(PendingAdd{table_id, std::move(delta), std::move(row_ids),
+                         option});
+}
+
+void TableStore::ApplyLoop() {
+  PendingAdd add;
+  while (queue_.Pop(&add)) {
+    Table* t = table(add.table_id);
+    if (t != nullptr) {
+      if (add.row_ids.empty()) {
+        t->Add(add.delta.data(), static_cast<long long>(add.delta.size()),
+               add.option);
+      } else {
+        t->AddRows(add.row_ids.data(), static_cast<int>(add.row_ids.size()),
+                   add.delta.data(), add.option);
+      }
+    }
+    ++applied_;
+  }
+}
+
+void TableStore::Flush() {
+  // Spin-wait until the apply thread catches up (barrier semantics; the
+  // queue is typically short). Matches Actor::Stop's drain in the reference.
+  while (applied_.load() < enqueued_.load() && running_.load()) {
+    std::this_thread::yield();
+  }
+}
+
+}  // namespace mvtpu

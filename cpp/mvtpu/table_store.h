@@ -1,0 +1,115 @@
+// In-process float table store: the C ABI's standalone backend.
+//
+// Native re-implementation of the reference's single-process PS semantics
+// (Multiverso reference: role=ALL where worker and server live in one
+// process — src/zoo.cpp:23,31 — backed by ArrayTable/MatrixTable storage,
+// src/table/array_table.cpp:98-152, src/table/matrix_table.cpp:348-465,
+// with server-side updaters, src/updater/). Bindings that load the shared
+// library without a host runtime (the Lua FFI binding, C programs) get the
+// full Get/Add/updater/checkpoint behavior locally; when the Python runtime
+// installs the bridge (bridge.h), these tables are bypassed and state lives
+// in TPU HBM instead.
+//
+// Async adds run on a per-store apply thread draining an MtQueue — the
+// worker-actor pattern (src/worker.cpp) reduced to one process.
+#ifndef MVTPU_TABLE_STORE_H_
+#define MVTPU_TABLE_STORE_H_
+
+#include <atomic>
+#include <cstdio>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "mvtpu/common.h"
+
+namespace mvtpu {
+
+struct AddOptionC {
+  int worker_id = 0;
+  float learning_rate = 0.01f;
+  float momentum = 0.0f;
+  float rho = 0.1f;
+  float lambda = 0.1f;
+};
+
+// Server-side updater over a contiguous float shard (default/sgd/adagrad/
+// momentum_sgd, matching src/updater formulas; OpenMP-parallel like
+// src/updater/updater.cpp:15-22).
+class Updater {
+ public:
+  virtual ~Updater() = default;
+  virtual void Update(std::vector<float>& data, const float* delta,
+                      size_t offset, size_t size, const AddOptionC& option) = 0;
+  static std::unique_ptr<Updater> Create(const std::string& type,
+                                         size_t table_size, int num_workers);
+};
+
+class Table {
+ public:
+  Table(long long num_row, long long num_col, const std::string& updater_type,
+        int num_workers);
+
+  long long num_row() const { return num_row_; }
+  long long num_col() const { return num_col_; }
+  long long size() const { return num_row_ * num_col_; }
+
+  void Get(float* out, long long size) const;
+  void GetRows(const int* row_ids, int n, float* out) const;
+  void Add(const float* delta, long long size, const AddOptionC& option);
+  void AddRows(const int* row_ids, int n, const float* delta,
+               const AddOptionC& option);
+
+  bool Store(std::FILE* f) const;
+  bool Load(std::FILE* f);
+
+ private:
+  friend class TableStore;
+  long long num_row_;
+  long long num_col_;
+  mutable std::mutex mu_;
+  std::vector<float> data_;
+  std::unique_ptr<Updater> updater_;
+};
+
+// Owns tables + the async apply thread.
+class TableStore {
+ public:
+  static TableStore& Get();
+
+  int CreateTable(long long num_row, long long num_col);
+  Table* table(int id);
+
+  // Enqueue an async whole-table or row add (copies the delta).
+  void AddAsync(int table_id, std::vector<float> delta,
+                std::vector<int> row_ids, AddOptionC option);
+  // Drain pending async adds (MV_Barrier semantics in-process).
+  void Flush();
+  void Shutdown();
+
+ private:
+  TableStore();
+  ~TableStore();
+  void ApplyLoop();
+
+  struct PendingAdd {
+    int table_id;
+    std::vector<float> delta;
+    std::vector<int> row_ids;  // empty = whole table
+    AddOptionC option;
+  };
+
+  std::mutex mu_;
+  std::vector<std::unique_ptr<Table>> tables_;
+  MtQueue<PendingAdd> queue_;
+  std::atomic<long long> enqueued_{0};
+  std::atomic<long long> applied_{0};
+  std::thread apply_thread_;
+  std::atomic<bool> running_{false};
+};
+
+}  // namespace mvtpu
+
+#endif  // MVTPU_TABLE_STORE_H_

@@ -45,6 +45,16 @@ class Dictionary:
 
     @classmethod
     def build(cls, corpus_path: str, min_count: int = 5) -> "Dictionary":
+        from .. import native
+
+        if native.available():  # C++ tokeniser/counter (cpp/mvtpu/reader.cc)
+            vocab = native.build_vocab(corpus_path, min_count)
+            d = cls(min_count)
+            d.words = vocab.words()
+            d.counts = [int(c) for c in vocab.counts()]
+            d.word2id = {w: i for i, w in enumerate(d.words)}
+            d._native_vocab = vocab
+            return d
         counter: Counter = Counter()
         with TextReader(corpus_path) as reader:
             for line in reader:
@@ -243,6 +253,10 @@ def encode_corpus(corpus_path: str, dictionary: Dictionary
                   ) -> Tuple[np.ndarray, np.ndarray]:
     """Encode a corpus to (word ids, sentence ids) arrays for upload to HBM
     (the device-resident fast path, ``Word2Vec.load_corpus_chunk``)."""
+    vocab = getattr(dictionary, "_native_vocab", None)
+    if vocab is not None:  # native encoder
+        ids, sents, _ = vocab.encode(corpus_path)
+        return ids, sents
     ids_parts: List[np.ndarray] = []
     sent_parts: List[np.ndarray] = []
     lookup = dictionary.word2id

@@ -70,6 +70,12 @@ class LogReg:
                       "(use FTRLLogReg for ftrl)")
         if cfg.output_size < 1:
             Log.fatal("output_size must be >= 1")
+        if table.updater.name == "default":
+            # The step pre-scales delta = lr*grads; the accumulate updater
+            # would ADD it (gradient ascent). The reference pins sgd too
+            # (ps_model.cpp:24).
+            Log.fatal("LogReg requires a descent updater on its table "
+                      "(create it with updater='sgd'/'momentum_sgd'/'adagrad')")
         self.cfg = cfg
         self.table = table
         self._steps = 0

@@ -1,0 +1,352 @@
+"""ctypes interface to the native runtime (``cpp/libmultiverso_tpu.so``).
+
+Two directions of integration:
+
+* **Loaders** — the native corpus/libsvm readers (``cpp/mvtpu/reader.cc``)
+  are the fast host path for the data pipeline; ``build_vocab`` /
+  ``encode_corpus`` / ``parse_libsvm`` wrap them with numpy outputs.
+* **Bridge** — ``install_bridge()`` points the C ABI's function-pointer
+  table (``cpp/c_api.h`` MV_Bridge) at this process's JAX session, so C and
+  Lua callers of ``MV_GetArrayTable``/... operate on TPU-resident sharded
+  tables instead of the library's local store.
+
+The library is optional: every caller falls back to pure Python when it is
+absent (``available()``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .log import Log
+
+_LIB_ENV = "MV_NATIVE_LIB"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _lib_candidates() -> List[str]:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [
+        os.environ.get(_LIB_ENV, ""),
+        os.path.join(here, "cpp", "libmultiverso_tpu.so"),
+        "libmultiverso_tpu.so",
+    ]
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.MV_Init.argtypes = [c.POINTER(c.c_int), c.POINTER(c.c_char_p)]
+    lib.MV_SetFlag.argtypes = [c.c_char_p, c.c_char_p]
+    lib.MV_SetFlag.restype = c.c_int
+    lib.MV_NewArrayTable.argtypes = [c.c_int, c.POINTER(c.c_void_p)]
+    lib.MV_GetArrayTable.argtypes = [c.c_void_p, c.POINTER(c.c_float), c.c_int]
+    lib.MV_AddArrayTable.argtypes = [c.c_void_p, c.POINTER(c.c_float), c.c_int]
+    lib.MV_AddAsyncArrayTable.argtypes = lib.MV_AddArrayTable.argtypes
+    lib.MV_NewMatrixTable.argtypes = [c.c_int, c.c_int, c.POINTER(c.c_void_p)]
+    lib.MV_GetMatrixTableAll.argtypes = [c.c_void_p, c.POINTER(c.c_float),
+                                         c.c_int]
+    lib.MV_AddMatrixTableAll.argtypes = lib.MV_GetMatrixTableAll.argtypes
+    lib.MV_AddAsyncMatrixTableAll.argtypes = lib.MV_GetMatrixTableAll.argtypes
+    rows_sig = [c.c_void_p, c.POINTER(c.c_float), c.c_int,
+                c.POINTER(c.c_int), c.c_int]
+    lib.MV_GetMatrixTableByRows.argtypes = rows_sig
+    lib.MV_AddMatrixTableByRows.argtypes = rows_sig
+    lib.MV_AddAsyncMatrixTableByRows.argtypes = rows_sig
+    lib.MV_StoreTable.argtypes = [c.c_void_p, c.c_char_p]
+    lib.MV_StoreTable.restype = c.c_int
+    lib.MV_LoadTable.argtypes = [c.c_void_p, c.c_char_p]
+    lib.MV_LoadTable.restype = c.c_int
+    lib.MV_VocabBuild.argtypes = [c.c_char_p, c.c_int]
+    lib.MV_VocabBuild.restype = c.c_void_p
+    lib.MV_VocabSize.argtypes = [c.c_void_p]
+    lib.MV_VocabSize.restype = c.c_int
+    lib.MV_VocabTrainWords.argtypes = [c.c_void_p]
+    lib.MV_VocabTrainWords.restype = c.c_longlong
+    lib.MV_VocabCounts.argtypes = [c.c_void_p, c.POINTER(c.c_longlong)]
+    lib.MV_VocabWord.argtypes = [c.c_void_p, c.c_int]
+    lib.MV_VocabWord.restype = c.c_char_p
+    lib.MV_VocabFree.argtypes = [c.c_void_p]
+    lib.MV_CorpusEncode.argtypes = [
+        c.c_void_p, c.c_char_p, c.POINTER(c.POINTER(c.c_int32)),
+        c.POINTER(c.POINTER(c.c_int32)), c.POINTER(c.c_longlong)]
+    lib.MV_CorpusEncode.restype = c.c_longlong
+    lib.MV_BufferFree.argtypes = [c.c_void_p]
+    lib.MV_SvmParse.argtypes = [c.c_char_p]
+    lib.MV_SvmParse.restype = c.c_void_p
+    lib.MV_SvmNumSamples.argtypes = [c.c_void_p]
+    lib.MV_SvmNumSamples.restype = c.c_longlong
+    lib.MV_SvmNumEntries.argtypes = [c.c_void_p]
+    lib.MV_SvmNumEntries.restype = c.c_longlong
+    lib.MV_SvmCopy.argtypes = [c.c_void_p, c.POINTER(c.c_float),
+                               c.POINTER(c.c_int64), c.POINTER(c.c_int32),
+                               c.POINTER(c.c_float)]
+    lib.MV_SvmFree.argtypes = [c.c_void_p]
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load and return the native library, or None if unavailable.
+
+    A failed load is retried on the next call (the library may be built or
+    ``MV_NATIVE_LIB`` set later in the process); a successful load sticks.
+    """
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        for path in _lib_candidates():
+            if not path:
+                continue
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                continue
+            _declare(lib)
+            _lib = lib
+            Log.debug("native runtime loaded: %s", path)
+            break
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# -- native loaders ----------------------------------------------------------
+
+class NativeVocab:
+    """Wrapper over the native corpus vocab (reference Dictionary)."""
+
+    def __init__(self, handle: int, lib: ctypes.CDLL) -> None:
+        self._h = handle
+        self._lib = lib
+        self.size = int(lib.MV_VocabSize(handle))
+        self.train_words = int(lib.MV_VocabTrainWords(handle))
+
+    def counts(self) -> np.ndarray:
+        out = np.zeros(self.size, np.int64)
+        self._lib.MV_VocabCounts(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)))
+        return out
+
+    def words(self) -> List[str]:
+        # errors="replace" matches the pure-Python TextReader path, so a
+        # non-UTF-8 corpus degrades identically instead of crashing here.
+        return [self._lib.MV_VocabWord(self._h, i).decode("utf-8",
+                                                          errors="replace")
+                for i in range(self.size)]
+
+    def encode(self, path: str) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Returns (ids, sentence_ids, words_read)."""
+        lib = self._lib
+        ids_p = ctypes.POINTER(ctypes.c_int32)()
+        sents_p = ctypes.POINTER(ctypes.c_int32)()
+        n = ctypes.c_longlong()
+        words_read = lib.MV_CorpusEncode(
+            self._h, path.encode(), ctypes.byref(ids_p), ctypes.byref(sents_p),
+            ctypes.byref(n))
+        if words_read < 0:
+            raise IOError(f"native corpus encode failed: {path}")
+        count = int(n.value)
+        ids = np.ctypeslib.as_array(ids_p, shape=(count,)).copy()
+        sents = np.ctypeslib.as_array(sents_p, shape=(count,)).copy()
+        lib.MV_BufferFree(ids_p)
+        lib.MV_BufferFree(sents_p)
+        return ids, sents, int(words_read)
+
+    def free(self) -> None:
+        if self._h:
+            self._lib.MV_VocabFree(self._h)
+            self._h = 0
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.free()
+        except Exception:
+            pass
+
+
+def build_vocab(path: str, min_count: int = 5) -> Optional[NativeVocab]:
+    lib = load()
+    if lib is None:
+        return None
+    handle = lib.MV_VocabBuild(path.encode(), min_count)
+    if not handle:
+        raise IOError(f"native vocab build failed: {path}")
+    return NativeVocab(handle, lib)
+
+
+def parse_libsvm(path: str):
+    """Returns (labels, indptr, keys, values) numpy arrays, or None."""
+    lib = load()
+    if lib is None:
+        return None
+    handle = lib.MV_SvmParse(path.encode())
+    if not handle:
+        raise IOError(f"native libsvm parse failed: {path}")
+    n = int(lib.MV_SvmNumSamples(handle))
+    entries = int(lib.MV_SvmNumEntries(handle))
+    labels = np.zeros(n, np.float32)
+    indptr = np.zeros(n + 1, np.int64)
+    keys = np.zeros(entries, np.int32)
+    values = np.zeros(entries, np.float32)
+    c = ctypes
+    lib.MV_SvmCopy(handle,
+                   labels.ctypes.data_as(c.POINTER(c.c_float)),
+                   indptr.ctypes.data_as(c.POINTER(c.c_int64)),
+                   keys.ctypes.data_as(c.POINTER(c.c_int32)),
+                   values.ctypes.data_as(c.POINTER(c.c_float)))
+    lib.MV_SvmFree(handle)
+    return labels, indptr, keys, values
+
+
+# -- bridge ------------------------------------------------------------------
+
+class _BridgeStruct(ctypes.Structure):
+    _void = ctypes.CFUNCTYPE(None)
+    _fields_ = [
+        ("init", ctypes.CFUNCTYPE(None, ctypes.POINTER(ctypes.c_int),
+                                  ctypes.POINTER(ctypes.c_char_p))),
+        ("shutdown", ctypes.CFUNCTYPE(None)),
+        ("barrier", ctypes.CFUNCTYPE(None)),
+        ("num_workers", ctypes.CFUNCTYPE(ctypes.c_int)),
+        ("worker_id", ctypes.CFUNCTYPE(ctypes.c_int)),
+        ("server_id", ctypes.CFUNCTYPE(ctypes.c_int)),
+        ("rank", ctypes.CFUNCTYPE(ctypes.c_int)),
+        ("size", ctypes.CFUNCTYPE(ctypes.c_int)),
+        ("num_servers", ctypes.CFUNCTYPE(ctypes.c_int)),
+        ("new_array", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int)),
+        ("get_array", ctypes.CFUNCTYPE(None, ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_float),
+                                       ctypes.c_int)),
+        ("add_array", ctypes.CFUNCTYPE(None, ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_float),
+                                       ctypes.c_int, ctypes.c_int)),
+        ("new_matrix", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
+                                        ctypes.c_int)),
+        ("get_matrix", ctypes.CFUNCTYPE(None, ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_float),
+                                        ctypes.c_int)),
+        ("add_matrix", ctypes.CFUNCTYPE(None, ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_float),
+                                        ctypes.c_int, ctypes.c_int)),
+        ("get_rows", ctypes.CFUNCTYPE(None, ctypes.c_int,
+                                      ctypes.POINTER(ctypes.c_float),
+                                      ctypes.c_int,
+                                      ctypes.POINTER(ctypes.c_int),
+                                      ctypes.c_int)),
+        ("add_rows", ctypes.CFUNCTYPE(None, ctypes.c_int,
+                                      ctypes.POINTER(ctypes.c_float),
+                                      ctypes.c_int,
+                                      ctypes.POINTER(ctypes.c_int),
+                                      ctypes.c_int, ctypes.c_int)),
+        ("store_table", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
+                                         ctypes.c_char_p)),
+        ("load_table", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
+                                        ctypes.c_char_p)),
+    ]
+
+
+_bridge_refs: List[object] = []  # keep callbacks alive for the library
+
+
+def install_bridge() -> bool:
+    """Route the C ABI at this process's JAX session. Returns False if the
+    native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return False
+    import multiverso_tpu as mv
+
+    def table(tid):
+        return mv.session().table(tid)
+
+    F = dict(_BridgeStruct._fields_)
+
+    def cb(name, fn):
+        wrapped = F[name](fn)
+        _bridge_refs.append(wrapped)
+        return wrapped
+
+    def _init(argc, argv):
+        mv.init()
+
+    def _get(tid, data, size):
+        out = np.ascontiguousarray(
+            np.asarray(table(tid).get(), np.float32).ravel()[:size])
+        ctypes.memmove(data, out.ctypes.data, min(size, out.size) * 4)
+
+    def _add(tid, data, size, async_hint):
+        arr = np.ctypeslib.as_array(data, shape=(size,)).copy()
+        t = table(tid)
+        delta = arr.reshape(t.shape)
+        if async_hint:
+            t.add_async(delta)
+        else:
+            t.add(delta)
+
+    def _get_rows(tid, data, size, row_ids, n):
+        ids = np.ctypeslib.as_array(row_ids, shape=(n,)).copy()
+        rows = np.asarray(table(tid).get_rows(ids), np.float32)
+        ctypes.memmove(data, rows.ctypes.data, min(size, rows.size) * 4)
+
+    def _add_rows(tid, data, size, row_ids, n, async_hint):
+        ids = np.ctypeslib.as_array(row_ids, shape=(n,)).copy()
+        t = table(tid)
+        vals = np.ctypeslib.as_array(data, shape=(n, t.num_col)).copy()
+        if async_hint:
+            t.add_rows_async(ids, vals)
+        else:
+            t.add_rows(ids, vals)
+
+    def _store(tid, path):
+        from .io.stream import open_stream
+
+        with open_stream(path.decode(), "wb") as stream:
+            table(tid).store(stream)
+        return 0
+
+    def _load(tid, path):
+        from .io.stream import open_stream
+
+        with open_stream(path.decode(), "rb") as stream:
+            table(tid).load(stream)
+        return 0
+
+    bridge = _BridgeStruct(
+        init=cb("init", _init),
+        shutdown=cb("shutdown", lambda: mv.shutdown()),
+        barrier=cb("barrier", lambda: mv.barrier()),
+        num_workers=cb("num_workers", lambda: mv.num_workers()),
+        worker_id=cb("worker_id", lambda: max(mv.worker_id(), 0)),
+        server_id=cb("server_id", lambda: max(mv.server_id(), 0)),
+        rank=cb("rank", lambda: mv.rank()),
+        size=cb("size", lambda: mv.size()),
+        num_servers=cb("num_servers", lambda: mv.num_servers()),
+        new_array=cb("new_array",
+                     lambda size: mv.create_table("array", size).table_id),
+        get_array=cb("get_array", _get),
+        add_array=cb("add_array", _add),
+        new_matrix=cb("new_matrix",
+                      lambda r, c: mv.create_table("matrix", r, c).table_id),
+        get_matrix=cb("get_matrix", _get),
+        add_matrix=cb("add_matrix", _add),
+        get_rows=cb("get_rows", _get_rows),
+        add_rows=cb("add_rows", _add_rows),
+        store_table=cb("store_table", _store),
+        load_table=cb("load_table", _load),
+    )
+    _bridge_refs.append(bridge)
+    lib.MV_InstallBridge(ctypes.byref(bridge))
+    return True
+
+
+def clear_bridge() -> None:
+    lib = load()
+    if lib is not None:
+        lib.MV_ClearBridge()

@@ -89,25 +89,46 @@ def prefetch_iterator(iterable, depth: int = 2):
     """
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     done = object()
+    stop = threading.Event()
+
+    def put(entry) -> bool:
+        # bounded put that gives up when the consumer is gone, so an
+        # abandoned generator doesn't leak a thread blocked on a full queue
+        while not stop.is_set():
+            try:
+                q.put(entry, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def run():
         try:
-            for item in iterable:
-                q.put((None, item))
-        except BaseException as exc:  # propagate to consumer
-            q.put((exc, None))
-            return
-        q.put((done, None))
+            try:
+                for item in iterable:
+                    if not put((None, item)):
+                        return
+            except BaseException as exc:  # propagate to consumer
+                put((exc, None))
+                return
+            put((done, None))
+        finally:
+            close = getattr(iterable, "close", None)
+            if close is not None:
+                close()
 
     thread = threading.Thread(target=run, daemon=True)
     thread.start()
-    while True:
-        exc, item = q.get()
-        if exc is done:
-            return
-        if exc is not None:
-            raise exc
-        yield item
+    try:
+        while True:
+            exc, item = q.get()
+            if exc is done:
+                return
+            if exc is not None:
+                raise exc
+            yield item
+    finally:
+        stop.set()
 
 
 class PipelinedGetter:

@@ -171,3 +171,12 @@ def test_parse_sample_formats():
     assert label == 0.0
     np.testing.assert_allclose(vals[:3], [0.1, 0.2, 0.3])
     assert vals.shape == (5,)
+
+
+def test_logreg_rejects_accumulate_updater(mv_session):
+    from multiverso_tpu.log import FatalError
+    from multiverso_tpu.models.logreg import LogReg, LogRegConfig
+
+    table = mv_session.create_table("matrix", 1, 6)  # default updater
+    with pytest.raises(FatalError):
+        LogReg(LogRegConfig(input_size=5, output_size=1), table)
