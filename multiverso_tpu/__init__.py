@@ -21,6 +21,7 @@ from .config import (define_bool, define_float, define_int, define_string,
                      get_flag, parse_cmd_flags, set_flag)
 from .dashboard import Dashboard, Monitor, Timer, monitor
 from .log import Log, LogLevel, check, check_notnull
+from .quantization import SparseFilter
 from .runtime import Session
 from .topology import SERVER_AXIS, SEQ_AXIS, WORKER_AXIS, make_mesh, sharding_for
 

@@ -4,12 +4,14 @@ Replaces the reference's net/allreduce-engine layer and sync-server machinery
 with XLA-native forms — see per-module docstrings for the mapping.
 """
 
+from .allreduce_engine import AllreduceEngine
 from .async_buffer import ASyncBuffer, PipelinedGetter, prefetch_iterator
 from .collectives import (all_gather, allreduce, allreduce_replicated,
                           reduce_scatter, ring_shift)
 from .sync_step import make_sync_step
 
 __all__ = [
+    "AllreduceEngine",
     "ASyncBuffer",
     "PipelinedGetter",
     "prefetch_iterator",

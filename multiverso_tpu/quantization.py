@@ -1,0 +1,132 @@
+"""Wire compression for sparse-ish payloads (the reference ``SparseFilter``).
+
+TPU-native re-expression of ``include/multiverso/util/quantization_util.h``
+in the Multiverso reference (``SparseFilter`` at ``:25``, ``TryCompress`` at
+``:95``, ``DeCompress`` at ``:139``): when more than half of a payload's
+values are within ``clip`` of zero, rewrite it as (index, value) pairs before
+it crosses a slow link; otherwise ship it dense. On TPU the *device* data
+plane never needs this — sharded tables ride ICI and sparse row traffic is
+"send only touched rows" by construction (``tables/matrix_table.py``) — so
+this filter serves the **host/DCN** paths: cross-process delta aggregation in
+sync mode, checkpoint streams, and the C-ABI bridge, where payloads are host
+ndarrays ("blobs") and bandwidth is the reference's motivation unchanged.
+
+Blob model: a payload is a list of 1-D contiguous ndarrays. ``filter_in``
+compresses each eligible blob and appends one trailing **size-info** blob
+(int64; original element count per blob, or -1 when shipped dense — the
+reference's extra size blob). ``filter_out`` inverts it. Like the reference
+(a ``SparseFilter<data_t, index_t>`` template) a filter instance is typed:
+``dtype`` for values, int32 for indices.
+
+The reference also declares a never-implemented ``OneBitsFilter``
+(``quantization_util.h:160-161``); we do not reproduce dead code.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .log import Log
+
+_INDEX_DTYPE = np.dtype(np.int32)
+
+
+class SparseFilter:
+    """Sparsity-gated (index, value) wire compression.
+
+    ``clip`` — magnitude at or below which a value is treated as zero (the
+    reference's lossy clip threshold). ``skip_option_blob`` — when True the
+    final blob of a payload (an Add/GetOption) passes through untouched,
+    mirroring ``skip_option_blob_`` in the reference.
+    """
+
+    def __init__(self, clip: float = 0.0, skip_option_blob: bool = False,
+                 dtype=np.float32) -> None:
+        self.clip = float(clip)
+        self.skip_option_blob = bool(skip_option_blob)
+        self.dtype = np.dtype(dtype)
+
+    # -- single-blob primitives (``TryCompress`` / ``DeCompress``) ---------
+    def try_compress(self, blob: np.ndarray) -> Optional[np.ndarray]:
+        """Return the compressed pair buffer, or None when the blob is too
+        dense to profit (at most half the values are within ``clip``)."""
+        flat = np.ascontiguousarray(blob, dtype=self.dtype).ravel()
+        keep = np.abs(flat) > self.clip
+        n_keep = int(keep.sum())
+        # Profitability is measured in wire bytes, not element counts: a pair
+        # costs index+value bytes (for float32 this reduces to the
+        # reference's ">50% of values small" rule).
+        pair_bytes = _INDEX_DTYPE.itemsize + self.dtype.itemsize
+        if n_keep * pair_bytes >= flat.nbytes:
+            return None
+        indices = np.nonzero(keep)[0].astype(_INDEX_DTYPE)
+        values = flat[keep]
+        out = np.empty(indices.nbytes + values.nbytes, np.uint8)
+        out[: indices.nbytes] = indices.view(np.uint8)
+        out[indices.nbytes:] = values.view(np.uint8)
+        return out
+
+    def decompress(self, comp: np.ndarray, count: int) -> np.ndarray:
+        """Inverse of ``try_compress`` given the original element count."""
+        pair_bytes = _INDEX_DTYPE.itemsize + self.dtype.itemsize
+        if comp.nbytes % pair_bytes:
+            Log.fatal(
+                f"corrupt compressed blob: {comp.nbytes} bytes not a multiple "
+                f"of pair size {pair_bytes}")
+        n_pairs = comp.nbytes // pair_bytes
+        buf = np.ascontiguousarray(comp).view(np.uint8)
+        indices = buf[: n_pairs * _INDEX_DTYPE.itemsize].view(_INDEX_DTYPE)
+        values = buf[n_pairs * _INDEX_DTYPE.itemsize:].view(self.dtype)
+        if n_pairs and (indices.min() < 0 or indices.max() >= count):
+            Log.fatal(
+                f"corrupt compressed blob: index out of range for count {count}")
+        out = np.zeros(count, self.dtype)
+        out[indices] = values
+        return out
+
+    # -- payload API (``FilterIn`` / ``FilterOut``) ------------------------
+    def filter_in(self, blobs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Compress a payload; appends the trailing size-info blob."""
+        out: List[np.ndarray] = []
+        size_info = np.empty(len(blobs), np.int64)
+        for i, blob in enumerate(blobs):
+            if self.skip_option_blob and i == len(blobs) - 1:
+                out.append(np.asarray(blob))
+                size_info[i] = -1
+                continue
+            comp = self.try_compress(blob)
+            if comp is None:
+                out.append(np.asarray(blob))
+                size_info[i] = -1
+            else:
+                out.append(comp)
+                size_info[i] = np.asarray(blob).size
+        out.append(size_info)
+        return out
+
+    def filter_out(self, blobs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Invert ``filter_in`` (drops the size-info blob)."""
+        if not blobs:
+            return []
+        size_info = np.asarray(blobs[-1], np.int64)
+        payload = blobs[:-1]
+        if size_info.size != len(payload):
+            Log.fatal(
+                f"size-info blob has {size_info.size} entries for "
+                f"{len(payload)} payload blobs")
+        out: List[np.ndarray] = []
+        for blob, count in zip(payload, size_info):
+            if count < 0:
+                out.append(np.asarray(blob))
+            else:
+                out.append(self.decompress(np.asarray(blob), int(count)))
+        return out
+
+    def compressed_ratio(self, blobs: Sequence[np.ndarray],
+                         filtered: Sequence[np.ndarray]) -> float:
+        """Wire bytes after / before (diagnostic)."""
+        before = sum(np.asarray(b).nbytes for b in blobs)
+        after = sum(np.asarray(b).nbytes for b in filtered)
+        return after / max(before, 1)

@@ -1,0 +1,123 @@
+"""SparseFilter tests, mirroring the reference ``Test/test_filter.cpp:7-126``
+case matrix (all-zero / mostly-zero / half-zero / non-zero blobs, clip
+behaviour, full FilterIn/FilterOut round trip, option-blob pass-through)."""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.quantization import SparseFilter
+
+
+def roundtrip(f, blobs):
+    return f.filter_out(f.filter_in(blobs))
+
+
+def test_all_zero_blob_compresses_to_empty():
+    f = SparseFilter()
+    blob = np.zeros(64, np.float32)
+    comp = f.try_compress(blob)
+    assert comp is not None and comp.size == 0
+    np.testing.assert_array_equal(f.decompress(comp, 64), blob)
+
+
+def test_mostly_zero_blob_roundtrips_exactly():
+    rng = np.random.default_rng(0)
+    blob = np.zeros(100, np.float32)
+    idx = rng.choice(100, size=10, replace=False)
+    blob[idx] = rng.standard_normal(10).astype(np.float32) + 2.0
+    f = SparseFilter()
+    comp = f.try_compress(blob)
+    assert comp is not None
+    # 10 pairs of (int32 index, float32 value)
+    assert comp.nbytes == 10 * 8
+    np.testing.assert_array_equal(f.decompress(comp, 100), blob)
+
+
+def test_half_zero_blob_not_compressed():
+    # Exactly half small: compression needs a strict majority (>50%).
+    blob = np.array([0.0, 1.0] * 8, np.float32)
+    assert SparseFilter().try_compress(blob) is None
+
+
+def test_dense_blob_not_compressed():
+    blob = np.arange(1, 65, dtype=np.float32)
+    assert SparseFilter().try_compress(blob) is None
+
+
+def test_clip_drops_small_magnitudes():
+    f = SparseFilter(clip=0.5)
+    blob = np.array([0.4, -0.5, 0.6, 0.0, -2.0, 0.1, 0.2, 0.3], np.float32)
+    comp = f.try_compress(blob)
+    assert comp is not None  # 6 of 8 within clip
+    out = f.decompress(comp, blob.size)
+    expected = np.where(np.abs(blob) > 0.5, blob, 0.0).astype(np.float32)
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_filter_in_out_roundtrip_mixed_payload():
+    rng = np.random.default_rng(1)
+    sparse = np.zeros(200, np.float32)
+    sparse[rng.choice(200, 20, replace=False)] = 1.5
+    dense = rng.standard_normal(50).astype(np.float32) + 3.0
+    f = SparseFilter()
+    wire = f.filter_in([sparse, dense])
+    assert len(wire) == 3  # payload + size-info
+    size_info = wire[-1]
+    assert size_info[0] == 200 and size_info[1] == -1
+    assert f.compressed_ratio([sparse, dense], wire[:-1]) < 1.0
+    out = f.filter_out(wire)
+    np.testing.assert_array_equal(out[0], sparse)
+    np.testing.assert_array_equal(out[1], dense)
+
+
+def test_option_blob_passthrough():
+    f = SparseFilter(skip_option_blob=True)
+    payload = np.zeros(64, np.float32)
+    option = np.array([3], np.int32)  # GetOption{worker_id}
+    wire = f.filter_in([payload, option])
+    assert wire[-1][-1] == -1  # option shipped dense even though tiny
+    out = f.filter_out(wire)
+    np.testing.assert_array_equal(out[0], payload)
+    np.testing.assert_array_equal(out[1], option)
+    assert out[1].dtype == np.int32
+
+
+def test_empty_blob_ships_dense():
+    f = SparseFilter()
+    wire = f.filter_in([np.zeros(0, np.float32)])
+    out = f.filter_out(wire)
+    assert out[0].size == 0
+
+
+def test_narrow_dtype_gates_on_bytes():
+    # float16 pairs cost 6 bytes vs 2 dense; 7 nonzeros of 16 would satisfy
+    # the element-count rule but inflate the wire — must ship dense.
+    f = SparseFilter(dtype=np.float16)
+    blob = np.zeros(16, np.float16)
+    blob[:7] = 1.0
+    assert f.try_compress(blob) is None
+    blob2 = np.zeros(16, np.float16)
+    blob2[0] = 1.0  # 6 bytes < 32 bytes: profitable
+    comp = f.try_compress(blob2)
+    assert comp is not None
+    np.testing.assert_array_equal(f.decompress(comp, 16), blob2)
+
+
+def test_decompress_rejects_out_of_range_index():
+    from multiverso_tpu.log import FatalError
+
+    f = SparseFilter()
+    blob = np.zeros(100, np.float32)
+    blob[50] = 1.0
+    comp = f.try_compress(blob)
+    with pytest.raises(FatalError):
+        f.decompress(comp, 10)  # stored index 50 exceeds claimed count
+
+
+def test_float64_filter():
+    f = SparseFilter(dtype=np.float64)
+    blob = np.zeros(32, np.float64)
+    blob[3] = 7.0
+    comp = f.try_compress(blob)
+    assert comp is not None and comp.nbytes == 4 + 8
+    np.testing.assert_array_equal(f.decompress(comp, 32), blob)

@@ -1,0 +1,98 @@
+"""Dense/sparse matrix-table performance harness.
+
+Port of the reference ``TestDensePerf`` / ``TestSparsePerf`` drivers
+(``Test/main.cpp:343-497`` in the Multiverso reference): a 1M x 50 float
+matrix table, timed rounds of whole-table Get, %-sparse row Add, and Get
+again, printing per-op wall times and the Dashboard dump at the end.
+
+Usage:
+    python tools/perf_tables.py [dense|sparse] [-rows=1000000] [-cols=50]
+                                [-rounds=10] [-percent=1.0]
+
+``sparse`` adds only ``percent``%% of rows per round (the touched-row wire
+path); ``dense`` adds the whole table. Runs on whatever devices the process
+sees (one real TPU chip, or CPU with JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import multiverso_tpu as mv
+from multiverso_tpu.dashboard import Dashboard
+
+
+def main(argv) -> int:
+    mode = "dense"
+    args = []
+    for a in argv[1:]:
+        if a in ("dense", "sparse"):
+            mode = a
+        else:
+            args.append(a)
+    mv.define_int("rows", 1_000_000, "table rows")
+    mv.define_int("cols", 50, "table cols")
+    mv.define_int("rounds", 10, "timed rounds")
+    mv.define_float("percent", 1.0, "rows touched per sparse add (%)")
+    mv.init(["perf"] + args)
+    rows, cols = mv.get_flag("rows"), mv.get_flag("cols")
+    rounds = mv.get_flag("rounds")
+
+    table = mv.create_table("matrix", rows, cols, name="perf_matrix")
+    rng = np.random.default_rng(0)
+
+    n_touch = max(1, int(rows * mv.get_flag("percent") / 100.0))
+
+    # warm up the jitted paths with the timed shapes (first compile is not
+    # the steady state; row ops bucket by id-set size, so warm with n_touch)
+    table.get()
+    if mode == "dense":
+        table.add(np.zeros((rows, cols), np.float32))
+    else:
+        warm_ids = np.arange(n_touch, dtype=np.int32)
+        table.add_rows(warm_ids, np.zeros((n_touch, cols), np.float32))
+        table.get_rows(warm_ids)
+
+    def timed(label, fn, op_bytes):
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            fn()
+        dt = (time.perf_counter() - t0) / rounds
+        print(f"{label:28s} {dt * 1e3:10.2f} ms/round "
+              f"({op_bytes / 1e6 / dt:.0f} MB/s)")
+        return dt
+
+    print(f"[{mode}] matrix {rows}x{cols} float32 "
+          f"({rows * cols * 4 / 1e6:.0f} MB), {rounds} rounds, "
+          f"mesh {dict(mv.session().mesh.shape)}")
+
+    table_bytes = rows * cols * 4
+    timed("get (whole table)", table.get, table_bytes)
+
+    if mode == "dense":
+        delta = rng.standard_normal((rows, cols)).astype(np.float32)
+        timed("add (whole table)", lambda: table.add(delta), table_bytes)
+    else:
+        ids = rng.choice(rows, size=n_touch, replace=False).astype(np.int32)
+        vals = rng.standard_normal((n_touch, cols)).astype(np.float32)
+        touched_bytes = n_touch * cols * 4
+        print(f"touched rows per add: {n_touch}")
+        timed(f"add_rows ({mv.get_flag('percent')}% rows)",
+              lambda: table.add_rows(ids, vals), touched_bytes)
+        timed(f"get_rows ({mv.get_flag('percent')}% rows)",
+              lambda: table.get_rows(ids), touched_bytes)
+
+    timed("get (whole table, after)", table.get, table_bytes)
+
+    Dashboard.display()
+    mv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
