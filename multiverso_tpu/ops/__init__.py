@@ -1,12 +1,17 @@
 """Compute ops: embedding gather/scatter, ring attention, pallas kernels."""
 
 from .embedding import embedding_lookup, scatter_add_rows, segment_mean_rows
+from .flash_attention import (flash_attention, flash_attention_partial,
+                              merge_partials)
 from .ring_attention import reference_attention, ring_attention
 
 __all__ = [
     "embedding_lookup",
     "scatter_add_rows",
     "segment_mean_rows",
+    "flash_attention",
+    "flash_attention_partial",
+    "merge_partials",
     "reference_attention",
     "ring_attention",
 ]

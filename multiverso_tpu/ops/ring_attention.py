@@ -63,12 +63,20 @@ def ring_attention(
     axis: str = SEQ_AXIS,
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "xla",
 ) -> jax.Array:
     """Exact attention with sequence sharded over ``axis``.
 
     Shapes: q/k/v ``[seq, heads, dim]`` (batch handled via vmap by callers),
     sharded ``P(axis, None, None)``. Returns same shape/sharding as ``q``.
+
+    ``impl="pallas"`` runs each ring step's block attention as the Pallas
+    flash kernel (``ops.flash_attention_partial``) — the MXU-heavy part —
+    with the cheap running-max merge in XLA while ``ppermute`` rotates K/V;
+    forward-only (use the default XLA impl when differentiating through).
     """
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown ring attention impl {impl!r}")
     n_blocks = int(mesh.shape[axis])
     seq = q.shape[0]
     if seq % n_blocks != 0:
@@ -93,9 +101,17 @@ def ring_attention(
             # after `step` rotations, we hold the block that started at
             # ring position (my_idx - step) mod n
             src = jnp.mod(my_idx - step, n_blocks)
-            k_pos = src * block + jnp.arange(block)
-            m, l, acc = _block_attn(q_blk, k_cur, v_cur, q_pos, k_pos,
-                                    causal, scale, m, l, acc)
+            if impl == "pallas":
+                from .flash_attention import (flash_attention_partial,
+                                              merge_partials)
+                acc_b, m_b, l_b = flash_attention_partial(
+                    q_blk, k_cur, v_cur, my_idx * block, src * block,
+                    causal=causal, scale=scale)
+                m, l, acc = merge_partials(m, l, acc, m_b, l_b, acc_b)
+            else:
+                k_pos = src * block + jnp.arange(block)
+                m, l, acc = _block_attn(q_blk, k_cur, v_cur, q_pos, k_pos,
+                                        causal, scale, m, l, acc)
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
             return m, l, acc, k_nxt, v_nxt
@@ -103,7 +119,9 @@ def ring_attention(
         m, l, acc, _, _ = jax.lax.fori_loop(
             0, n_blocks, body, (m0, l0, acc0, k_blk, v_blk))
         denom = jnp.maximum(l, 1e-20).transpose(1, 0)[:, :, None]
-        return acc / denom
+        # keep the two impls interchangeable: partial-merge math runs in
+        # f32, but the contract is out.dtype == q.dtype
+        return (acc / denom).astype(q_blk.dtype)
 
     return _ring(q, k, v)
 

@@ -118,12 +118,14 @@ class AllreduceEngine:
         c = vec.shape[0] // n
         buf = vec.reshape((n, c) + vec.shape[1:])
         fwd = [(i, (i + 1) % n) for i in range(n)]
+        # schedule starts one chunk behind the owner so that after the n-1
+        # neighbour steps rank i holds fully-reduced chunk i directly (no
+        # extra handoff ppermute)
         for s in range(n - 1):
-            outgoing = buf[(idx - s) % n]
+            outgoing = buf[(idx - s - 1) % n]
             recv = jax.lax.ppermute(outgoing, axis, fwd)
-            buf = buf.at[(idx - s - 1) % n].add(recv)
-        # rank i now holds fully-reduced chunk (i+1)%n; hand it to its owner.
-        return jax.lax.ppermute(buf[(idx + 1) % n], axis, fwd)
+            buf = buf.at[(idx - s - 2) % n].add(recv)
+        return buf[idx]
 
     def _reduce_scatter_shard(self, vec):
         if recursive_halving_schedule(self.n):
