@@ -13,6 +13,10 @@
 #include "mvtpu/reader.h"
 #include "mvtpu/table_store.h"
 
+namespace mvtpu {
+int RunNativeTests();  // self_test.cc
+}
+
 namespace {
 
 using mvtpu::AddOptionC;
@@ -365,5 +369,7 @@ void MV_SvmCopy(SvmHandler svm, float* labels, int64_t* indptr, int32_t* keys,
 }
 
 void MV_SvmFree(SvmHandler svm) { delete static_cast<mvtpu::SvmData*>(svm); }
+
+int MV_RunNativeTests(void) { return mvtpu::RunNativeTests(); }
 
 }  // extern "C"

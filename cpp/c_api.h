@@ -118,6 +118,10 @@ DllExport void MV_SvmCopy(SvmHandler svm, float* labels, int64_t* indptr,
                           int32_t* keys, float* values);
 DllExport void MV_SvmFree(SvmHandler svm);
 
+/* ext: in-library self-tests of the native primitives (allocator, queues,
+ * async prefetcher, stream IO). Returns the number of failed checks. */
+DllExport int MV_RunNativeTests(void);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

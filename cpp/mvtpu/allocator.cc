@@ -1,0 +1,163 @@
+#include "mvtpu/allocator.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <new>
+
+#include "mvtpu/flags.h"
+#include "mvtpu/log.h"
+
+namespace mvtpu {
+
+namespace {
+
+char* AlignedAlloc(size_t bytes, size_t alignment) {
+  void* raw = nullptr;
+  if (alignment < sizeof(void*)) alignment = sizeof(void*);
+  if (posix_memalign(&raw, alignment, bytes) != 0) throw std::bad_alloc();
+  return static_cast<char*>(raw);
+}
+
+size_t SizeClass(size_t size) {
+  size_t cls = 32;
+  while (cls < size) cls <<= 1;
+  return cls;
+}
+
+}  // namespace
+
+struct SmartAllocator::Header {
+  FreeList* list;          // owning size-class list (for Free routing)
+  std::atomic<int> refs;
+};
+
+struct SmartAllocator::FreeList {
+  size_t size_class;
+  std::mutex mu;
+  // Singly-linked free blocks; the Header area of a pooled block stores the
+  // `next` pointer while it sits on the list.
+  char* head = nullptr;
+  size_t count = 0;
+};
+
+SmartAllocator::SmartAllocator(size_t alignment) : alignment_(alignment) {}
+
+SmartAllocator::~SmartAllocator() {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (auto& kv : pools_) {
+    FreeList* list = kv.second;
+    char* block = list->head;
+    while (block != nullptr) {
+      char* next;
+      std::memcpy(&next, block, sizeof(char*));
+      std::free(block);
+      block = next;
+    }
+    delete list;
+  }
+}
+
+char* SmartAllocator::Alloc(size_t size) {
+  const size_t header = (sizeof(Header) + alignment_ - 1) / alignment_ *
+                        alignment_;
+  const size_t cls = SizeClass(size + header);
+  FreeList* list;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = pools_.find(cls);
+    if (it == pools_.end()) {
+      list = new FreeList();
+      list->size_class = cls;
+      pools_[cls] = list;
+    } else {
+      list = it->second;
+    }
+  }
+  char* block = nullptr;
+  {
+    std::lock_guard<std::mutex> lock(list->mu);
+    if (list->head != nullptr) {
+      block = list->head;
+      std::memcpy(&list->head, block, sizeof(char*));
+      --list->count;
+    }
+  }
+  if (block == nullptr) block = AlignedAlloc(cls, alignment_);
+  auto* h = new (block) Header();
+  h->list = list;
+  h->refs.store(1, std::memory_order_relaxed);
+  allocated_.fetch_add(1, std::memory_order_relaxed);
+  return block + header;
+}
+
+void SmartAllocator::Refer(char* data) {
+  const size_t header = (sizeof(Header) + alignment_ - 1) / alignment_ *
+                        alignment_;
+  auto* h = reinterpret_cast<Header*>(data - header);
+  h->refs.fetch_add(1, std::memory_order_relaxed);
+}
+
+void SmartAllocator::Free(char* data) {
+  const size_t header = (sizeof(Header) + alignment_ - 1) / alignment_ *
+                        alignment_;
+  char* block = data - header;
+  auto* h = reinterpret_cast<Header*>(block);
+  if (h->refs.fetch_sub(1, std::memory_order_acq_rel) != 1) return;
+  FreeList* list = h->list;
+  h->~Header();
+  std::lock_guard<std::mutex> lock(list->mu);
+  std::memcpy(block, &list->head, sizeof(char*));
+  list->head = block;
+  ++list->count;
+  allocated_.fetch_sub(1, std::memory_order_relaxed);
+}
+
+size_t SmartAllocator::pooled_blocks() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  size_t total = 0;
+  for (auto& kv : pools_) {
+    std::lock_guard<std::mutex> l2(kv.second->mu);
+    total += kv.second->count;
+  }
+  return total;
+}
+
+char* PlainAllocator::Alloc(size_t size) {
+  const size_t header = (sizeof(std::atomic<int>) + alignment_ - 1) /
+                        alignment_ * alignment_;
+  char* block = AlignedAlloc(size + header, alignment_);
+  new (block) std::atomic<int>(1);
+  return block + header;
+}
+
+void PlainAllocator::Refer(char* data) {
+  const size_t header = (sizeof(std::atomic<int>) + alignment_ - 1) /
+                        alignment_ * alignment_;
+  reinterpret_cast<std::atomic<int>*>(data - header)
+      ->fetch_add(1, std::memory_order_relaxed);
+}
+
+void PlainAllocator::Free(char* data) {
+  const size_t header = (sizeof(std::atomic<int>) + alignment_ - 1) /
+                        alignment_ * alignment_;
+  char* block = data - header;
+  auto* refs = reinterpret_cast<std::atomic<int>*>(block);
+  if (refs->fetch_sub(1, std::memory_order_acq_rel) == 1) std::free(block);
+}
+
+Allocator* Allocator::Get() {
+  static Allocator* instance = [] {
+    Flags& flags = Flags::Get();
+    flags.DefineString("allocator_type", "smart");
+    flags.DefineInt("allocator_alignment", 16);
+    const size_t align =
+        static_cast<size_t>(flags.GetInt("allocator_alignment"));
+    if (flags.GetString("allocator_type") == "smart")
+      return static_cast<Allocator*>(new SmartAllocator(align));
+    return static_cast<Allocator*>(new PlainAllocator(align));
+  }();
+  return instance;
+}
+
+}  // namespace mvtpu

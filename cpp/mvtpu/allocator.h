@@ -1,0 +1,73 @@
+// Pooled host memory allocator with sharing refcounts.
+//
+// Native equivalent of the reference's Blob backing store (Multiverso
+// reference: include/multiverso/util/allocator.h:40, SmartAllocator
+// free-list pools src/util/allocator.cpp:32-131, plain fallback :133-150).
+// Blocks are drawn from power-of-two size-class free lists; each block
+// carries a hidden header {pool ptr, atomic refcount} so buffers can be
+// shared across pipeline stages (reader -> staging -> device upload) and
+// returned to the pool when the last holder frees. Selected via the
+// `allocator_type` flag ("smart" pooled | "plain" malloc), alignment via
+// `allocator_alignment` — the same knobs the reference registers.
+#ifndef MVTPU_ALLOCATOR_H_
+#define MVTPU_ALLOCATOR_H_
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <mutex>
+#include <unordered_map>
+
+namespace mvtpu {
+
+class Allocator {
+ public:
+  virtual ~Allocator() = default;
+  virtual char* Alloc(size_t size) = 0;
+  virtual void Free(char* data) = 0;
+  virtual void Refer(char* data) = 0;
+
+  // Process-wide instance chosen by the `allocator_type` flag on first use.
+  static Allocator* Get();
+};
+
+// Size-class pooled allocator. Thread-safe; freed blocks go back to their
+// class's free list rather than the OS.
+class SmartAllocator : public Allocator {
+ public:
+  explicit SmartAllocator(size_t alignment = 16);
+  ~SmartAllocator() override;
+
+  char* Alloc(size_t size) override;
+  void Free(char* data) override;
+  void Refer(char* data) override;
+
+  // Introspection (native self-tests / dashboards).
+  size_t allocated_blocks() const { return allocated_.load(); }
+  size_t pooled_blocks() const;
+
+ private:
+  struct Header;   // {free-list ptr, refcount}
+  struct FreeList;
+
+  size_t alignment_;
+  mutable std::mutex mu_;
+  std::unordered_map<size_t, FreeList*> pools_;  // size-class -> list
+  std::atomic<size_t> allocated_{0};
+};
+
+// Plain aligned malloc/free with the same refcount header (no pooling).
+class PlainAllocator : public Allocator {
+ public:
+  explicit PlainAllocator(size_t alignment = 16) : alignment_(alignment) {}
+  char* Alloc(size_t size) override;
+  void Free(char* data) override;
+  void Refer(char* data) override;
+
+ private:
+  size_t alignment_;
+};
+
+}  // namespace mvtpu
+
+#endif  // MVTPU_ALLOCATOR_H_

@@ -1,0 +1,77 @@
+// Double-buffered background prefetcher.
+//
+// Native equivalent of the reference's ASyncBuffer (Multiverso reference:
+// include/multiverso/util/async_buffer.h:11-116): a background thread runs
+// the user fill action into the non-ready buffer; Get() waits for the
+// prefetch, swaps buffers, and immediately triggers the next fill. Used to
+// overlap host-side data preparation with device steps (the same
+// compute/IO overlap the reference uses between parameter pulls and
+// training, LR/src/model/ps_model.cpp:236).
+#ifndef MVTPU_ASYNC_BUFFER_H_
+#define MVTPU_ASYNC_BUFFER_H_
+
+#include <functional>
+#include <thread>
+#include <utility>
+
+#include "mvtpu/common.h"
+
+namespace mvtpu {
+
+template <typename BufferT>
+class ASyncBuffer {
+ public:
+  using Fill = std::function<void(BufferT* buffer)>;
+
+  // Both buffers are owned by the caller and must outlive this object.
+  ASyncBuffer(BufferT* buffer_a, BufferT* buffer_b, Fill fill)
+      : buffers_{buffer_a, buffer_b}, fill_(std::move(fill)) {
+    ready_.Reset(1);
+    worker_ = std::thread(&ASyncBuffer::Loop, this);
+    Trigger(0);
+  }
+
+  ~ASyncBuffer() { Join(); }
+
+  // Waits for the in-flight prefetch, returns its buffer, and starts
+  // prefetching into the other one.
+  BufferT* Get() {
+    ready_.Wait();
+    BufferT* out = buffers_[current_];
+    current_ ^= 1;
+    ready_.Reset(1);
+    Trigger(current_);
+    return out;
+  }
+
+  // Stops the background thread (idempotent). Restartable is not needed —
+  // construct a new instance, matching the reference's Join semantics.
+  void Join() {
+    if (worker_.joinable()) {
+      jobs_.Exit();
+      worker_.join();
+    }
+  }
+
+ private:
+  void Trigger(int slot) { jobs_.Push(slot); }
+
+  void Loop() {
+    int slot;
+    while (jobs_.Pop(&slot)) {
+      fill_(buffers_[slot]);
+      ready_.Notify();
+    }
+  }
+
+  BufferT* buffers_[2];
+  Fill fill_;
+  int current_ = 0;
+  Waiter ready_;
+  MtQueue<int> jobs_;
+  std::thread worker_;
+};
+
+}  // namespace mvtpu
+
+#endif  // MVTPU_ASYNC_BUFFER_H_

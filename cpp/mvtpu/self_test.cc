@@ -1,0 +1,157 @@
+// In-library self-tests for the host-runtime primitives, reachable from the
+// C ABI (MV_RunNativeTests) so the Python test suite can exercise the
+// native allocator / queue / prefetcher / stream layers through ctypes —
+// the same single-process testing stance as the rest of the framework
+// (SURVEY.md §4).
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "mvtpu/allocator.h"
+#include "mvtpu/async_buffer.h"
+#include "mvtpu/common.h"
+#include "mvtpu/log.h"
+#include "mvtpu/stream.h"
+
+namespace mvtpu {
+namespace {
+
+int failures = 0;
+
+#define ST_CHECK(cond)                                              \
+  do {                                                              \
+    if (!(cond)) {                                                  \
+      Log::Error("self_test failed at %s:%d: %s", __FILE__,         \
+                 __LINE__, #cond);                                  \
+      ++failures;                                                   \
+    }                                                               \
+  } while (0)
+
+void TestAllocator() {
+  SmartAllocator alloc(16);
+  char* a = alloc.Alloc(100);
+  std::memset(a, 7, 100);
+  ST_CHECK(reinterpret_cast<uintptr_t>(a) % 16 == 0);
+  ST_CHECK(alloc.allocated_blocks() == 1);
+  alloc.Refer(a);
+  alloc.Free(a);  // still shared
+  ST_CHECK(alloc.allocated_blocks() == 1);
+  alloc.Free(a);  // back to pool
+  ST_CHECK(alloc.allocated_blocks() == 0);
+  ST_CHECK(alloc.pooled_blocks() == 1);
+  char* b = alloc.Alloc(90);  // same size class -> reuses pooled block
+  ST_CHECK(b == a);
+  ST_CHECK(alloc.pooled_blocks() == 0);
+  char* c = alloc.Alloc(5000);  // different class
+  ST_CHECK(c != nullptr);
+  alloc.Free(b);
+  alloc.Free(c);
+
+  // concurrent alloc/free hammering
+  std::atomic<bool> ok{true};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; ++t) {
+    threads.emplace_back([&alloc, &ok] {
+      for (int i = 0; i < 1000; ++i) {
+        char* p = alloc.Alloc(64 + (i % 5) * 64);
+        if (p == nullptr) { ok = false; continue; }
+        p[0] = 1;
+        alloc.Free(p);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  ST_CHECK(ok.load());
+  ST_CHECK(alloc.allocated_blocks() == 0);
+
+  PlainAllocator plain(32);
+  char* p = plain.Alloc(10);
+  ST_CHECK(reinterpret_cast<uintptr_t>(p) % 32 == 0);
+  plain.Refer(p);
+  plain.Free(p);
+  plain.Free(p);
+}
+
+void TestQueueWaiter() {
+  MtQueue<int> q;
+  std::vector<int> got;
+  std::thread consumer([&q, &got] {
+    int v;
+    while (q.Pop(&v)) got.push_back(v);
+  });
+  for (int i = 0; i < 100; ++i) q.Push(i);
+  while (q.Size() > 0) std::this_thread::yield();
+  q.Exit();
+  consumer.join();
+  ST_CHECK(got.size() == 100);
+
+  Waiter w;
+  w.Reset(2);
+  std::thread t1([&w] { w.Notify(); });
+  std::thread t2([&w] { w.Notify(); });
+  w.Wait();
+  t1.join();
+  t2.join();
+}
+
+void TestAsyncBuffer() {
+  std::vector<int> buf_a(4), buf_b(4);
+  std::atomic<int> fills{0};
+  {
+    ASyncBuffer<std::vector<int>> prefetcher(
+        &buf_a, &buf_b, [&fills](std::vector<int>* buf) {
+          const int n = fills.fetch_add(1);
+          for (auto& v : *buf) v = n;
+        });
+    std::vector<int>* first = prefetcher.Get();
+    ST_CHECK((*first)[0] == 0);           // first prefetch
+    std::vector<int>* second = prefetcher.Get();
+    ST_CHECK((*second)[0] == 1);          // refilled while we "worked"
+    ST_CHECK(first != second);            // double buffering alternates
+    std::vector<int>* third = prefetcher.Get();
+    ST_CHECK(third == first);
+    ST_CHECK((*third)[0] == 2);
+  }
+}
+
+void TestStream() {
+  const char* path = "/tmp/mvtpu_selftest_stream.bin";
+  {
+    auto out = CreateStream(std::string("file://") + path, "w");
+    ST_CHECK(out != nullptr);
+    const char payload[] = "line one\nline two\r\nlast";
+    out->Write(payload, sizeof(payload) - 1);
+  }
+  {
+    auto in = CreateStream(path, "r");  // bare path = file scheme
+    ST_CHECK(in != nullptr);
+    TextReader reader(std::move(in), 8);  // tiny buffer: cross-refill lines
+    std::string line;
+    ST_CHECK(reader.GetLine(&line) && line == "line one");
+    ST_CHECK(reader.GetLine(&line) && line == "line two");
+    ST_CHECK(reader.GetLine(&line) && line == "last");
+    ST_CHECK(!reader.GetLine(&line));
+  }
+  std::remove(path);
+  ST_CHECK(CreateStream("hdfs://nn/path", "r") == nullptr);
+
+  const URI u = URI::Parse("hdfs://namenode:9000/a/b");
+  ST_CHECK(u.scheme == "hdfs" && u.host == "namenode:9000" &&
+           u.path == "/a/b");
+}
+
+}  // namespace
+
+int RunNativeTests() {
+  failures = 0;
+  TestAllocator();
+  TestQueueWaiter();
+  TestAsyncBuffer();
+  TestStream();
+  return failures;
+}
+
+}  // namespace mvtpu

@@ -1,0 +1,103 @@
+#include "mvtpu/stream.h"
+
+#include <cstring>
+
+#include "mvtpu/log.h"
+
+namespace mvtpu {
+
+URI URI::Parse(const std::string& uri) {
+  URI out;
+  const size_t sep = uri.find("://");
+  if (sep == std::string::npos) {
+    out.path = uri;
+    return out;
+  }
+  out.scheme = uri.substr(0, sep);
+  const std::string rest = uri.substr(sep + 3);
+  if (out.scheme == "file") {
+    out.path = rest;
+    return out;
+  }
+  const size_t slash = rest.find('/');
+  if (slash == std::string::npos) {
+    out.host = rest;
+  } else {
+    out.host = rest.substr(0, slash);
+    out.path = rest.substr(slash);
+  }
+  return out;
+}
+
+LocalStream::LocalStream(const std::string& path, const char* mode) {
+  std::string m(mode);
+  if (m.find('b') == std::string::npos) m += 'b';
+  file_ = std::fopen(path.c_str(), m.c_str());
+  if (file_ == nullptr)
+    Log::Error("LocalStream: cannot open %s (mode %s)", path.c_str(), mode);
+}
+
+LocalStream::~LocalStream() {
+  if (file_ != nullptr) std::fclose(file_);
+}
+
+size_t LocalStream::Read(void* buf, size_t size) {
+  if (file_ == nullptr) return 0;
+  return std::fread(buf, 1, size, file_);
+}
+
+size_t LocalStream::Write(const void* buf, size_t size) {
+  if (file_ == nullptr) return 0;
+  return std::fwrite(buf, 1, size, file_);
+}
+
+void LocalStream::Flush() {
+  if (file_ != nullptr) std::fflush(file_);
+}
+
+std::unique_ptr<Stream> CreateStream(const std::string& uri,
+                                     const char* mode) {
+  const URI parsed = URI::Parse(uri);
+  if (parsed.scheme.empty() || parsed.scheme == "file") {
+    auto stream = std::make_unique<LocalStream>(parsed.path, mode);
+    if (!stream->Good()) return nullptr;
+    return stream;
+  }
+  Log::Error("CreateStream: scheme '%s' not supported in the native layer "
+           "(route through the Python IO layer)", parsed.scheme.c_str());
+  return nullptr;
+}
+
+TextReader::TextReader(std::unique_ptr<Stream> stream, size_t buf_size)
+    : stream_(std::move(stream)), buf_(buf_size) {}
+
+bool TextReader::GetLine(std::string* line) {
+  line->clear();
+  for (;;) {
+    if (pos_ == len_) {
+      if (eof_) break;
+      len_ = stream_ ? stream_->Read(buf_.data(), buf_.size()) : 0;
+      pos_ = 0;
+      if (len_ == 0) {
+        eof_ = true;
+        break;
+      }
+    }
+    const char* start = buf_.data() + pos_;
+    const char* nl = static_cast<const char*>(
+        std::memchr(start, '\n', len_ - pos_));
+    if (nl == nullptr) {
+      line->append(start, len_ - pos_);
+      pos_ = len_;
+      continue;
+    }
+    line->append(start, static_cast<size_t>(nl - start));
+    pos_ += static_cast<size_t>(nl - start) + 1;
+    if (!line->empty() && line->back() == '\r') line->pop_back();
+    return true;
+  }
+  if (!line->empty() && line->back() == '\r') line->pop_back();
+  return !line->empty();
+}
+
+}  // namespace mvtpu

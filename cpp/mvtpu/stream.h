@@ -1,0 +1,74 @@
+// URI-dispatched binary streams + buffered text reader.
+//
+// Native form of the reference IO layer (Multiverso reference:
+// include/multiverso/io/io.h:24-130 — URI parse, StreamFactory, TextReader;
+// local file backend include/multiverso/io/local_stream.h:13). Schemes:
+// "file://" (and bare paths) open local files; other schemes (hdfs://) are
+// gated — CreateStream returns nullptr and logs, since the TPU deployment
+// reads from local/NFS mounts and cloud storage goes through the Python
+// layer. Checkpoint Store/Load and the native data readers sit on top.
+#ifndef MVTPU_STREAM_H_
+#define MVTPU_STREAM_H_
+
+#include <cstddef>
+#include <cstdio>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace mvtpu {
+
+struct URI {
+  std::string scheme;  // empty or "file", "hdfs", ...
+  std::string host;
+  std::string path;
+
+  static URI Parse(const std::string& uri);
+};
+
+class Stream {
+ public:
+  virtual ~Stream() = default;
+  virtual size_t Read(void* buf, size_t size) = 0;
+  virtual size_t Write(const void* buf, size_t size) = 0;
+  virtual bool Good() const = 0;
+  virtual void Flush() = 0;
+};
+
+class LocalStream : public Stream {
+ public:
+  LocalStream(const std::string& path, const char* mode);
+  ~LocalStream() override;
+  size_t Read(void* buf, size_t size) override;
+  size_t Write(const void* buf, size_t size) override;
+  bool Good() const override { return file_ != nullptr; }
+  void Flush() override;
+
+ private:
+  std::FILE* file_;
+};
+
+// mode: "r" | "w" | "a" (binary). Returns nullptr for unsupported schemes
+// or open failure.
+std::unique_ptr<Stream> CreateStream(const std::string& uri, const char* mode);
+
+// Buffered line reader over a Stream (reference TextReader,
+// include/multiverso/io/io.h:114).
+class TextReader {
+ public:
+  explicit TextReader(std::unique_ptr<Stream> stream,
+                      size_t buf_size = 1 << 16);
+  // Returns false at EOF. Strips the trailing newline (and \r).
+  bool GetLine(std::string* line);
+
+ private:
+  std::unique_ptr<Stream> stream_;
+  std::vector<char> buf_;
+  size_t pos_ = 0;
+  size_t len_ = 0;
+  bool eof_ = false;
+};
+
+}  // namespace mvtpu
+
+#endif  // MVTPU_STREAM_H_

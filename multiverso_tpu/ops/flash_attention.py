@@ -192,7 +192,11 @@ def _fa_call(q, k, v, q_base, k_base, *, causal: bool, scale: float,
         ],
         interpret=interpret,
     )(offs, qt, kt, vt)
-    out = jnp.transpose(out[:, :sq, :d], (1, 0, 2)).astype(q.dtype)
+    out = jnp.transpose(out[:, :sq, :d], (1, 0, 2))
+    if normalize:
+        # normalized attention matches the input dtype; un-normalized
+        # partials stay f32 so ring-step merges don't accumulate rounding
+        out = out.astype(q.dtype)
     m = m[:, :, 0, :].reshape(h, sq_p)[:, :sq]
     l = l[:, :, 0, :].reshape(h, sq_p)[:, :sq]
     return out, m, l

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..topology import SEQ_AXIS
+from .flash_attention import flash_attention_partial, merge_partials
 
 shard_map = getattr(jax, "shard_map", None)
 if shard_map is None:  # pragma: no cover — jax < 0.8
@@ -102,8 +103,6 @@ def ring_attention(
             # ring position (my_idx - step) mod n
             src = jnp.mod(my_idx - step, n_blocks)
             if impl == "pallas":
-                from .flash_attention import (flash_attention_partial,
-                                              merge_partials)
                 acc_b, m_b, l_b = flash_attention_partial(
                     q_blk, k_cur, v_cur, my_idx * block, src * block,
                     causal=causal, scale=scale)

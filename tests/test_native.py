@@ -33,6 +33,12 @@ def _handler():
     return ctypes.c_void_p()
 
 
+def test_native_primitives_self_test(native_lib):
+    """Allocator / MtQueue / Waiter / ASyncBuffer / Stream self-tests
+    (cpp/mvtpu/self_test.cc) run inside the library; 0 = all passed."""
+    assert native_lib.MV_RunNativeTests() == 0
+
+
 def test_c_api_array_local_store(native_lib):
     lib = native_lib
     lib.MV_ClearBridge()
