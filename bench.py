@@ -47,11 +47,17 @@ def main() -> int:
 
     mv.init(["bench", "-log_level=error"])
     dictionary = Dictionary.build(corpus, min_count=1)
+    # TPU-native settings: bf16 embedding tables (f32 grad accumulation in
+    # the step) and 2.5x candidate oversampling so the window/subsample
+    # rejection tests don't waste gather/scatter slots.
     cfg = Word2VecConfig(vocab_size=dictionary.vocab_size, embedding_size=256,
-                         window=5, negative=5, init_lr=0.025, batch_size=32768)
+                         window=5, negative=5, init_lr=0.025, batch_size=32768,
+                         oversample=2.5)
+    import jax.numpy as jnp
     w_in = mv.create_table("matrix", dictionary.vocab_size, cfg.embedding_size,
-                           init_value="random")
-    w_out = mv.create_table("matrix", dictionary.vocab_size, cfg.embedding_size)
+                           init_value="random", dtype=jnp.bfloat16)
+    w_out = mv.create_table("matrix", dictionary.vocab_size, cfg.embedding_size,
+                            dtype=jnp.bfloat16)
     model = Word2Vec(cfg, w_in, w_out,
                      counts=np.asarray(dictionary.counts, np.float64))
     model.total_words = 10 ** 9

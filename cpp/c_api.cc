@@ -49,6 +49,10 @@ void RegisterCoreFlags() {
   flags.DefineInt("num_workers", 1);
   flags.DefineInt("omp_threads", 4);
   flags.DefineString("log_level", "info");
+  // registered before ParseCmdFlags so -allocator_* CLI values are consumed
+  // (Allocator::Get() re-Defines them as a no-op fallback for lib-only use)
+  flags.DefineString("allocator_type", "smart");
+  flags.DefineInt("allocator_alignment", 16);
 }
 
 }  // namespace

@@ -3,6 +3,8 @@
 // native allocator / queue / prefetcher / stream layers through ctypes —
 // the same single-process testing stance as the rest of the framework
 // (SURVEY.md §4).
+#include <unistd.h>
+
 #include <atomic>
 #include <cstdio>
 #include <cstring>
@@ -118,7 +120,10 @@ void TestAsyncBuffer() {
 }
 
 void TestStream() {
-  const char* path = "/tmp/mvtpu_selftest_stream.bin";
+  // per-process path: concurrent test runners must not share the file
+  const std::string path_s = "/tmp/mvtpu_selftest_stream." +
+                             std::to_string(::getpid()) + ".bin";
+  const char* path = path_s.c_str();
   {
     auto out = CreateStream(std::string("file://") + path, "w");
     ST_CHECK(out != nullptr);

@@ -53,6 +53,13 @@ class Word2VecConfig:
     steps_per_call: int = 1      # batches fused into one dispatch (lax.scan)
     max_code_length: int = 40    # huffman path pad (HS)
     seed: int = 7
+    # Device-sampler candidate oversampling (corpus path only). Window /
+    # sentence / subsampling tests reject ~half the sampled pairs; with
+    # oversample > 1 the sampler draws ``oversample * batch_size`` cheap
+    # int candidates and compacts the survivors into a dense batch, so the
+    # expensive per-row gather/scatter work runs at ~full utilisation.
+    # 0 disables (every candidate slot trains with a validity mask).
+    oversample: float = 0.0
 
 
 def build_unigram_alias(counts: np.ndarray, power: float = 0.75
@@ -85,12 +92,21 @@ def build_unigram_alias(counts: np.ndarray, power: float = 0.75
 
 def sample_negatives(rng_key, thresh: jax.Array, alias: jax.Array,
                      shape: Tuple[int, ...]) -> jax.Array:
-    """Draw indices from the alias table on device."""
+    """Draw indices from the alias table on device.
+
+    thresh/alias are packed into one [V, 2] table so the draw costs a single
+    2-wide row gather instead of two scalar gathers (scalar gathers are the
+    slow path on TPU).
+    """
     n = thresh.shape[0]
+    packed = jnp.stack(
+        [jax.lax.bitcast_convert_type(thresh, jnp.int32), alias], axis=1)
     k1, k2 = jax.random.split(rng_key)
     idx = jax.random.randint(k1, shape, 0, n)
     u = jax.random.uniform(k2, shape)
-    return jnp.where(u < thresh[idx], idx, alias[idx])
+    row = jnp.take(packed, idx, axis=0)                     # [..., 2]
+    t = jax.lax.bitcast_convert_type(row[..., 0], jnp.float32)
+    return jnp.where(u < t, idx, row[..., 1])
 
 
 class Word2Vec:
@@ -171,41 +187,50 @@ class Word2Vec:
         emb_sharding = self.input_table.sharding
 
         def apply_sgd(w, rows, grads, lr):
-            return w.at[rows].add(-lr * grads.astype(w.dtype))
+            return w.at[rows].add((-lr * grads).astype(w.dtype))
 
         def apply_adagrad(w, g_acc, rows, grads, lr):
             g_rows = jnp.take(g_acc, rows, axis=0) + grads * grads
             g_acc = g_acc.at[rows].add(grads * grads)
             scale = lr / jnp.sqrt(g_rows + _ADAGRAD_EPS)
-            return w.at[rows].add(-scale * grads.astype(w.dtype)), g_acc
+            return w.at[rows].add((-scale * grads).astype(w.dtype)), g_acc
 
         D = cfg.embedding_size
 
-        def objective_grads(h, w_out, target_word, ex_mask, key):
+        def objective_grads(h, w_out, target_word, ex_mask, key, negs=None):
             """Shared output-side objectives on hidden vector ``h`` [B, D].
 
             Negative sampling and hierarchical softmax are ADDITIVE when both
             are enabled (matching the reference trainer, which runs both
             branches per sample when hs=1 and negative>0). Returns the summed
             loss, grad wrt h, and the (rows, grads) scatter sets for w_out.
+            ``negs`` lets the corpus path pass bulk-predrawn negatives
+            (hoisting the alias draws out of the scan body).
             """
             loss = 0.0
-            grad_h = jnp.zeros_like(h)
+            # f32 accumulation regardless of table dtype (bf16 tables keep
+            # the MXU/HBM win; grads stay f32 until the scatter cast)
+            grad_h = jnp.zeros(h.shape, jnp.float32)
             scatters = []
             if cfg.negative > 0:
-                key, sub = jax.random.split(key)
-                negs = sample_negatives(sub, self._thresh, self._alias,
-                                        (h.shape[0], cfg.negative))
+                if negs is None:
+                    key, sub = jax.random.split(key)
+                    negs = sample_negatives(sub, self._thresh, self._alias,
+                                            (h.shape[0], cfg.negative))
                 targets = jnp.concatenate([target_word[:, None], negs], axis=1)
                 labels = jnp.concatenate(
                     [jnp.ones_like(target_word[:, None], jnp.float32),
                      jnp.zeros(negs.shape, jnp.float32)], axis=1)
                 u = jnp.take(w_out, targets, axis=0)             # [B, T, D]
-                scores = jnp.clip(jnp.einsum("bd,btd->bt", h, u), -30.0, 30.0)
+                scores = jnp.clip(
+                    jnp.einsum("bd,btd->bt", h, u,
+                               preferred_element_type=jnp.float32),
+                    -30.0, 30.0)
                 g = (jax.nn.sigmoid(scores) - labels) * ex_mask[:, None]
                 pair_loss = jax.nn.softplus(scores) - labels * scores
                 loss = loss + (pair_loss.sum(1) * ex_mask).sum()
-                grad_h = grad_h + jnp.einsum("bt,btd->bd", g, u)
+                grad_h = grad_h + jnp.einsum(
+                    "bt,btd->bd", g, u, preferred_element_type=jnp.float32)
                 scatters.append((targets.reshape(-1),
                                  (g[:, :, None] * h[:, None, :]).reshape(-1, D)))
             if cfg.hs:
@@ -214,11 +239,15 @@ class Word2Vec:
                 pmask = jnp.take(self._path_mask, target_word, axis=0)
                 labels = (1.0 - codes)
                 u = jnp.take(w_out, nodes, axis=0)
-                scores = jnp.clip(jnp.einsum("bd,bld->bl", h, u), -30.0, 30.0)
+                scores = jnp.clip(
+                    jnp.einsum("bd,bld->bl", h, u,
+                               preferred_element_type=jnp.float32),
+                    -30.0, 30.0)
                 g = (jax.nn.sigmoid(scores) - labels) * pmask * ex_mask[:, None]
                 path_loss = (jax.nn.softplus(scores) - labels * scores) * pmask
                 loss = loss + (path_loss.sum(1) * ex_mask).sum()
-                grad_h = grad_h + jnp.einsum("bl,bld->bd", g, u)
+                grad_h = grad_h + jnp.einsum(
+                    "bl,bld->bd", g, u, preferred_element_type=jnp.float32)
                 scatters.append((nodes.reshape(-1),
                                  (g[:, :, None] * h[:, None, :]).reshape(-1, D)))
             loss = loss / jnp.maximum(ex_mask.sum(), 1)
@@ -238,23 +267,25 @@ class Word2Vec:
 
         if not cfg.cbow:
             # skip-gram: input row = center word; target = context word
-            def step(w_in, w_out, g_in, g_out, centers, contexts, mask, lr, key):
+            def step(w_in, w_out, g_in, g_out, centers, contexts, mask, lr,
+                     key, negs=None):
                 h = jnp.take(w_in, centers, axis=0)
                 loss, grad_h, scatters, key = objective_grads(
-                    h, w_out, contexts, mask, key)
+                    h, w_out, contexts, mask, key, negs)
                 w_in, w_out, g_in, g_out = apply_updates(
                     w_in, w_out, g_in, g_out, centers, grad_h, scatters, lr)
                 return w_in, w_out, g_in, g_out, loss, key
         else:
             # CBOW: input = mean of context window rows; target = center word
             # (reference TrainSample CBOW path; contexts [B, C] with cmask)
-            def step(w_in, w_out, g_in, g_out, centers, contexts, cmask, lr, key):
+            def step(w_in, w_out, g_in, g_out, centers, contexts, cmask, lr,
+                     key, negs=None):
                 rows = jnp.take(w_in, contexts, axis=0)          # [B, C, D]
                 counts = jnp.maximum(cmask.sum(axis=1), 1.0)     # [B]
                 h = jnp.einsum("bcd,bc->bd", rows, cmask) / counts[:, None]
                 ex_mask = (cmask.sum(axis=1) > 0).astype(jnp.float32)
                 loss, grad_h, scatters, key = objective_grads(
-                    h, w_out, centers, ex_mask, key)
+                    h, w_out, centers, ex_mask, key, negs)
                 # d h / d row_c = cmask_c / count
                 in_grads = (grad_h[:, None, :]
                             * (cmask / counts[:, None])[:, :, None])
@@ -304,7 +335,24 @@ class Word2Vec:
         self._state_shardings = state_shardings
         return jitted
 
-    def _build_corpus_step(self, n_steps: int):
+    def _candidate_batch(self, n: int) -> int:
+        """Candidate slab length M for a corpus chunk of ``n`` positions.
+
+        Single source of truth for the oversample formula — the device
+        sampler and the host-side stream-position bookkeeping must agree.
+        Clamped so ``ext`` slicing (n >= M + 2W) stays in bounds.
+        """
+        cfg = self.config
+        B, W = cfg.batch_size, cfg.window
+        if n < B + 2 * W:
+            Log.fatal(f"corpus chunk ({n} positions) smaller than "
+                      f"batch_size + 2*window ({B + 2 * W}); lower batch_size "
+                      "or load a larger chunk")
+        M = (max(B, int(round(B * cfg.oversample)))
+             if cfg.oversample > 1 else B)
+        return min(M, n - 2 * W)
+
+    def _build_corpus_step(self, n_steps: int, M: int):
         """Fused sample+train over a device-resident corpus chunk.
 
         The host pipeline ships every batch over PCIe/DCN; here the corpus
@@ -319,60 +367,148 @@ class Word2Vec:
         W, B = cfg.window, cfg.batch_size
         step = self._raw_step
 
-        def fused(w_in, w_out, g_in, g_out, corpus, sents, discard, lr, key):
+        # M candidates per step (cheap int-only sampling may overdraw; the
+        # row gather/scatter work is always on exactly B slots)
+        S = n_steps
+
+        def compact_one(ok, n_valid, *arrays):
+            """Pack the ``ok`` rows of each [M, ...] array into [B, ...].
+
+            Linear-time alternative to sorting (TPU sorts are slow): each
+            surviving row's destination is its prefix-count rank; overflow
+            and rejected rows scatter out of bounds and are dropped.
+            """
+            rank = jnp.cumsum(ok.astype(jnp.int32)) - 1
+            dest = jnp.where(ok & (rank < B), rank, B)
+            packed = tuple(
+                jnp.zeros((B,) + a.shape[1:], a.dtype).at[dest].set(
+                    a, mode="drop")
+                for a in arrays)
+            return packed + (jnp.arange(B) < n_valid,)
+
+        def fused(w_in, w_out, g_in, g_out, corpus, sents, discard, lr, key,
+                  start0):
+            """Sequential corpus streaming (the reference reads sentences in
+            order — ``WE/src/reader.cpp``): each step consumes the next M
+            corpus positions as centers, so every word lookup is a contiguous
+            slice instead of a scalar gather. The per-pair window offset is
+            resolved by selecting among the 2W statically-shifted copies of
+            the slab — pure vector ops, no gathers.
+            """
             n = corpus.shape[0]
+            # wrap-around extension: any start in [0, n) can slice M + 2W
+            ext_ids = jnp.concatenate([corpus[-W:], corpus, corpus[:M + W]])
+            ext_sents = jnp.concatenate([sents[-W:], sents, sents[:M + W]])
+            # per-position discard prob: ONE O(n) gather per fused call,
+            # amortized over all S batches
+            dpos = jnp.take(discard, corpus, axis=0)
+            ext_disc = jnp.concatenate([dpos[-W:], dpos, dpos[:M + W]])
 
-            def sample_sg(key):
-                key, k1, k2, k3, k4, k5, k6 = jax.random.split(key, 7)
-                pos = jax.random.randint(k1, (B,), 0, n)
-                shrink = jax.random.randint(k2, (B,), 1, W + 1)
-                dmag = jnp.minimum(jax.random.randint(k3, (B,), 1, W + 1),
+            # ---- bulk RNG: ONE vectorized draw for all S batches ----
+            key, k1, k2, k3, k4, k5 = jax.random.split(key, 6)
+            shrink = jax.random.randint(k1, (S, M), 1, W + 1)
+            if not cfg.cbow:
+                dmag = jnp.minimum(jax.random.randint(k2, (S, M), 1, W + 1),
                                    shrink)
-                sign = jnp.where(jax.random.bernoulli(k4, 0.5, (B,)), 1, -1)
-                ctx = pos + sign * dmag
-                in_range = (ctx >= 0) & (ctx < n)
-                ctx_c = jnp.clip(ctx, 0, n - 1)
-                valid = in_range & (sents[pos] == sents[ctx_c])
-                centers = corpus[pos]
-                contexts = corpus[ctx_c]
-                keep = ((jax.random.uniform(k5, (B,)) >= discard[centers])
-                        & (jax.random.uniform(k6, (B,)) >= discard[contexts]))
-                mask = (valid & keep).astype(jnp.float32)
-                return key, centers, contexts, mask, mask.sum()
+                sign = jnp.where(jax.random.bernoulli(k3, 0.5, (S, M)), 1, -1)
+                # window offset -W..W (excl 0) → shifted-copy index 0..2W-1
+                dsel = jnp.where(sign > 0, W + dmag - 1, W - dmag)
+                u_ctx = jax.random.uniform(k5, (S, M))
+            else:
+                dsel = None
+                u_ctx = jax.random.uniform(k5, (S, M, 2 * W))
+            u_center = jax.random.uniform(k4, (S, M))
+            negs = None
+            if cfg.negative > 0:
+                key, kn = jax.random.split(key)
+                negs = sample_negatives(kn, self._thresh, self._alias,
+                                        (S, B, cfg.negative))
 
-            def sample_cbow(key):
-                key, k1, k2, k3, k4 = jax.random.split(key, 5)
-                pos = jax.random.randint(k1, (B,), 0, n)
-                shrink = jax.random.randint(k2, (B,), 1, W + 1)
-                offsets = jnp.concatenate(
-                    [jnp.arange(-W, 0), jnp.arange(1, W + 1)])    # [2W]
-                ctx = pos[:, None] + offsets[None, :]             # [B, 2W]
-                in_range = (ctx >= 0) & (ctx < n)
-                ctx_c = jnp.clip(ctx, 0, n - 1)
-                in_window = jnp.abs(offsets)[None, :] <= shrink[:, None]
-                valid = in_range & in_window & (
-                    sents[ctx_c] == sents[pos][:, None])
-                centers = corpus[pos]
-                contexts = corpus[ctx_c]
-                keep = ((jax.random.uniform(k3, (B,)) >= discard[centers])
-                        [:, None]
-                        & (jax.random.uniform(k4, (B, 2 * W))
-                           >= discard[contexts]))
-                cmask = (valid & keep).astype(jnp.float32)
-                examples = (cmask.sum(axis=1) > 0).astype(jnp.float32).sum()
-                return key, centers, contexts, cmask, examples
+            starts = (start0 + jnp.arange(S, dtype=jnp.int32) * M) % n
 
-            sampler = sample_cbow if cfg.cbow else sample_sg
+            offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
 
-            def body(carry, _):
+            def slab_views(start):
+                """[2W+1 views of the slab] — static slices of one dynamic
+                slice, so the only data movement is contiguous."""
+                buf = jax.lax.dynamic_slice(ext_ids, (start,), (M + 2 * W,))
+                sbuf = jax.lax.dynamic_slice(ext_sents, (start,),
+                                             (M + 2 * W,))
+                dbuf = jax.lax.dynamic_slice(ext_disc, (start,),
+                                             (M + 2 * W,))
+                ctr = (buf[W:W + M], sbuf[W:W + M], dbuf[W:W + M])
+                shifted = [(buf[W + d:W + d + M], sbuf[W + d:W + d + M],
+                            dbuf[W + d:W + d + M]) for d in offsets]
+                return ctr, shifted
+
+            def select(shifted_vals, dsel_row):
+                """contexts[i] = shifted[dsel[i]][i] via masked sum (2W
+                vector multiply-adds, no gather)."""
+                out = jnp.zeros_like(shifted_vals[0])
+                for j, v in enumerate(shifted_vals):
+                    out = jnp.where(dsel_row == j, v, out)
+                return out
+
+            def sample_sg(start, dsel, u_center, u_ctx):
+                (centers, csent, cdisc), shifted = slab_views(start)
+                contexts = select([s[0] for s in shifted], dsel)
+                xsent = select([s[1] for s in shifted], dsel)
+                xdisc = select([s[2] for s in shifted], dsel)
+                valid = (xsent == csent)
+                keep = (u_center >= cdisc) & (u_ctx >= xdisc)
+                ok = valid & keep
+                if M > B:
+                    n_valid = jnp.minimum(ok.sum(), B)
+                    centers, contexts, ok = compact_one(
+                        ok, n_valid, centers, contexts)
+                return centers, contexts, ok.astype(jnp.float32)
+
+            def sample_cbow(start, shrink, u_center, u_ctx):
+                (centers, csent, cdisc), shifted = slab_views(start)
+                contexts = jnp.stack([s[0] for s in shifted], axis=1)
+                xsent = jnp.stack([s[1] for s in shifted], axis=1)
+                xdisc = jnp.stack([s[2] for s in shifted], axis=1)
+                in_window = (jnp.abs(offsets)[None, :]
+                             <= shrink[:, None])              # [M, 2W]
+                valid = in_window & (xsent == csent[:, None])
+                keep = (u_center >= cdisc)[:, None] & (u_ctx >= xdisc)
+                ok = valid & keep
+                if M > B:
+                    ex_ok = ok.any(axis=1)
+                    n_valid = jnp.minimum(ex_ok.sum(), B)
+                    centers, contexts, ok, ex_packed = compact_one(
+                        ex_ok, n_valid, centers, contexts, ok)
+                    ok = ok & ex_packed[:, None]
+                return centers, contexts, ok.astype(jnp.float32)
+
+            def body(carry, xs):
                 w_in, w_out, g_in, g_out, key = carry
-                key, centers, contexts, mask, count = sampler(key)
+                if cfg.cbow:
+                    start, shrink_r, u_c, u_x, nn = xs
+                    c, t, m = sample_cbow(start, shrink_r, u_c, u_x)
+                    count = (m.sum(axis=1) > 0).astype(jnp.float32).sum()
+                else:
+                    start, dsel_r, u_c, u_x, nn = xs
+                    c, t, m = sample_sg(start, dsel_r, u_c, u_x)
+                    count = m.sum()
                 w_in, w_out, g_in, g_out, loss, key = step(
-                    w_in, w_out, g_in, g_out, centers, contexts, mask, lr, key)
+                    w_in, w_out, g_in, g_out, c, t, m, lr, key, nn)
                 return (w_in, w_out, g_in, g_out, key), (loss, count)
 
+            dummy_negs = (negs if negs is not None
+                          else jnp.zeros((S, 1), jnp.int32))
+            if cfg.cbow:
+                xs = (starts, shrink, u_center, u_ctx, dummy_negs)
+            else:
+                xs = (starts, dsel, u_center, u_ctx, dummy_negs)
+
+            def body_wrap(carry, xs):
+                if cfg.negative <= 0:
+                    xs = xs[:-1] + (None,)
+                return body(carry, xs)
+
             (w_in, w_out, g_in, g_out, key), (losses, counts) = jax.lax.scan(
-                body, (w_in, w_out, g_in, g_out, key), None, length=n_steps)
+                body_wrap, (w_in, w_out, g_in, g_out, key), xs)
             return (w_in, w_out, g_in, g_out, losses.mean(), counts.sum(),
                     key)
 
@@ -380,7 +516,7 @@ class Word2Vec:
             fused,
             donate_argnums=(0, 1, 2, 3),
             in_shardings=self._state_shardings
-            + (None, None, None, None, self._key_sharding),
+            + (None, None, None, None, self._key_sharding, None),
             out_shardings=self._state_shardings
             + (None, None, self._key_sharding),
         )
@@ -450,22 +586,26 @@ class Word2Vec:
         """
         if not hasattr(self, "_corpus"):
             Log.fatal("call load_corpus_chunk() before train_device_steps()")
-        fused = getattr(self, "_fused_cache", {}).get(n_steps)
+        n = int(self._corpus.shape[0])
+        M = self._candidate_batch(n)
+        fused = getattr(self, "_fused_cache", {}).get((n_steps, M))
         if fused is None:
             if not hasattr(self, "_fused_cache"):
                 self._fused_cache = {}
-            fused = self._build_corpus_step(n_steps)
-            self._fused_cache[n_steps] = fused
+            fused = self._build_corpus_step(n_steps, M)
+            self._fused_cache[(n_steps, M)] = fused
         cfg = self.config
         lr = jnp.float32(self.current_lr())
         g_in = self._g_in if cfg.use_adagrad else None
         g_out = self._g_out if cfg.use_adagrad else None
+        start0 = getattr(self, "_stream_pos", 0) % n
+        self._stream_pos = (start0 + n_steps * M) % n
         with self.input_table._lock, self.output_table._lock:
             (self.input_table._data, self.output_table._data,
              g_in, g_out, loss, count, self._key) = fused(
                 self.input_table._data, self.output_table._data,
                 g_in, g_out, self._corpus, self._sents, self._discard,
-                lr, self._key)
+                lr, self._key, jnp.int32(start0))
         if cfg.use_adagrad:
             self._g_in, self._g_out = g_in, g_out
         # lr decay bookkeeping: count is async; approximate with the

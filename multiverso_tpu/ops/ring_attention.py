@@ -41,7 +41,8 @@ def _block_attn(q, k, v, q_pos, k_pos, causal, scale, m, l, acc):
     q: [sq, h, d]; k/v: [sk, h, d]; positions: [sq], [sk].
     m/l: [h, sq] running max / normaliser; acc: [sq, h, d].
     """
-    scores = jnp.einsum("qhd,khd->hqk", q, k) * scale  # [h, sq, sk]
+    scores = jnp.einsum("qhd,khd->hqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
         mask = (k_pos[None, :] <= q_pos[:, None])[None, :, :]
         scores = jnp.where(mask, scores, _NEG_INF)
@@ -93,9 +94,12 @@ def ring_attention(
         my_idx = jax.lax.axis_index(axis)
         h = q_blk.shape[1]
         q_pos = my_idx * block + jnp.arange(block)
-        m0 = jnp.full((h, block), _NEG_INF, q_blk.dtype)
-        l0 = jnp.zeros((h, block), q_blk.dtype)
-        acc0 = jnp.zeros_like(q_blk)
+        # f32 carry regardless of input dtype: both impls produce f32
+        # un-normalized partials (bf16 inputs would hit a fori_loop carry
+        # dtype mismatch otherwise); cast back to q.dtype at the end
+        m0 = jnp.full((h, block), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((h, block), jnp.float32)
+        acc0 = jnp.zeros(q_blk.shape, jnp.float32)
 
         def body(step, carry):
             m, l, acc, k_cur, v_cur = carry
