@@ -24,7 +24,8 @@ import numpy as np
 from ..io.stream import TextReader, open_stream
 from ..log import Log
 from ..models.logreg import FTRLLogReg, LogReg, LogRegConfig, SparseLogReg
-from ..parallel import prefetch_iterator
+from ..parallel import PipelinedGetter, prefetch_iterator
+from .lr_reader import AsyncSampleReader, batched, parse_default, sample_iterator
 
 
 def parse_config(path: str) -> dict:
@@ -44,13 +45,14 @@ def config_from_dict(d: dict) -> LogRegConfig:
     cfg = LogRegConfig()
     casts = {
         "input_size": int, "output_size": int, "minibatch_size": int,
-        "sync_frequency": int, "learning_rate": float,
+        "sync_frequency": int, "read_buffer_size": int,
+        "learning_rate": float,
         "learning_rate_coef": float, "regular_coef": float,
         "ftrl_alpha": float, "ftrl_beta": float,
         "ftrl_lambda1": float, "ftrl_lambda2": float,
     }
     for key, value in d.items():
-        if key in ("objective_type", "regular_type"):
+        if key in ("objective_type", "regular_type", "reader_type"):
             setattr(cfg, key, value)
         elif key in ("sparse", "pipeline"):
             setattr(cfg, key, value.lower() in ("1", "true", "yes"))
@@ -59,37 +61,23 @@ def config_from_dict(d: dict) -> LogRegConfig:
     return cfg
 
 
-def parse_sample(line: str, sparse: bool, input_size: int
-                 ) -> Tuple[float, np.ndarray, np.ndarray]:
-    """libsvm ``label k:v k:v`` (sparse) or ``label v v v`` (dense) —
-    reference ``SampleReader::ParseLine`` (``LR/src/reader.cpp:169``)."""
-    parts = line.split()
-    label = float(parts[0])
-    if sparse:
-        keys, vals = [], []
-        for tok in parts[1:]:
-            k, _, v = tok.partition(":")
-            keys.append(int(k))
-            vals.append(float(v) if v else 1.0)
-        return label, np.asarray(keys, np.int64), np.asarray(vals, np.float64)
-    vals = np.zeros(input_size, np.float32)
-    dense = [float(t) for t in parts[1:]]
-    vals[: len(dense)] = dense
-    return label, np.arange(len(dense), dtype=np.int64), vals
+# libsvm/dense text parsing lives in lr_reader; kept under the old name.
+parse_sample = parse_default
 
 
-def iter_samples(path: str, sparse: bool, input_size: int):
-    with TextReader(path) as reader:
-        for line in reader:
-            if line.strip():
-                yield parse_sample(line, sparse, input_size)
+def iter_samples(path: str, sparse: bool, input_size: int,
+                 reader_type: str = "default"):
+    """Reader-factory front door (``SampleReader::Get``,
+    ``LR/src/reader.cpp:212``); see :mod:`.lr_reader` for the variants."""
+    yield from sample_iterator(reader_type, path, sparse, input_size)
 
 
 def iter_dense_minibatches(path: str, cfg: LogRegConfig
                            ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Fixed-size [B, input] / [B, output] batches for the dense jitted path."""
     xs, ys = [], []
-    for label, _, values in iter_samples(path, False, cfg.input_size):
+    for label, _, values in iter_samples(path, False, cfg.input_size,
+                                         cfg.reader_type):
         xs.append(values)
         if cfg.output_size == 1:
             ys.append([label])
@@ -137,21 +125,60 @@ def train_file(model, cfg: LogRegConfig, path: str, epochs: int = 1,
                              float(loss))
             loss = float(loss)
         elif isinstance(model, SparseLogReg):
-            batch: List = []
-            samples = iter_samples(path, True, cfg.input_size)
-            if cfg.pipeline:
-                samples = prefetch_iterator(samples, depth=4 * cfg.minibatch_size)
-            for label, keys, values in samples:
-                batch.append((keys, values, label))
-                if len(batch) == cfg.minibatch_size:
-                    loss = model.train_minibatch(batch)
-                    batch = []
-            if batch:
-                loss = model.train_minibatch(batch)
+            loss = _train_sparse_epoch(model, cfg, path)
         else:  # FTRL: per-sample proximal updates
-            for label, keys, values in iter_samples(path, True, cfg.input_size):
+            for label, keys, values in iter_samples(
+                    path, True, cfg.input_size, cfg.reader_type):
                 loss = model.train_sample(keys, values, label)
     return float(loss)
+
+
+def _train_sparse_epoch(model: SparseLogReg, cfg: LogRegConfig, path: str
+                        ) -> float:
+    """One epoch of the sparse PS path.
+
+    ``pipeline=true`` runs the reference's double-buffered pull
+    (``PSModel::GetPipelineTable``, ``LR/src/model/ps_model.cpp:236``): the
+    async reader publishes each sync window's keyset ahead of time, a
+    background getter pulls those rows while the current window trains, and
+    the result lands in the model cache at the window boundary.
+    """
+    loss = 0.0
+    samples = sample_iterator(cfg.reader_type, path, True, cfg.input_size)
+    sync_every = max(cfg.sync_frequency, 1)
+    if not cfg.pipeline:
+        for batch in batched(samples, cfg.minibatch_size):
+            loss = model.train_minibatch(
+                [(keys, values, label) for label, keys, values in batch])
+        return loss
+    window = cfg.minibatch_size * sync_every
+    reader = AsyncSampleReader(
+        samples, window_size=window, bias_key=model.bias_key,
+        # At the start of window j the consumer blocks on keyset j+1, which
+        # the loader publishes only after parsing 2*window samples past the
+        # consumer's position — the ring must hold that much.
+        buffer_samples=max(cfg.read_buffer_size, 2 * window))
+    getter = PipelinedGetter(lambda ks: (ks, model.table.get_keys(ks)))
+    in_flight = False
+    first = reader.next_keyset()
+    if first is not None:
+        getter.prime(first)
+        in_flight = True
+    try:
+        for batch in batched(reader, cfg.minibatch_size):
+            # Align on the model's persistent step counter, not a per-epoch
+            # index: partial trailing batches advance it by one like full
+            # ones, and its window phase carries across train_file calls.
+            if in_flight and model.steps % sync_every == 0:
+                nxt = reader.next_keyset()
+                pulled = getter.get(nxt)
+                in_flight = nxt is not None
+                model.load_cache(*pulled)
+            loss = model.train_minibatch(
+                [(keys, values, label) for label, keys, values in batch])
+    finally:
+        reader.close()
+    return loss
 
 
 def test_file(model, cfg: LogRegConfig, path: str) -> float:
@@ -167,7 +194,8 @@ def test_file(model, cfg: LogRegConfig, path: str) -> float:
             total += x.shape[0]
         return correct / max(total, 1)
     correct = total = 0
-    for label, keys, values in iter_samples(path, True, cfg.input_size):
+    for label, keys, values in iter_samples(path, True, cfg.input_size,
+                                            cfg.reader_type):
         pred = model.predict_sample(keys, values)
         correct += int((pred > 0.5) == (label > 0.5))
         total += 1

@@ -40,6 +40,8 @@ class LogRegConfig:
     sparse: bool = False
     sync_frequency: int = 1
     pipeline: bool = False
+    reader_type: str = "default"      # default|weight|bsparse (LR/src/reader.cpp:212)
+    read_buffer_size: int = 4096      # async reader ring (LR/src/configure.h:31)
     # FTRL hyperparameters (LR/src/configure.h)
     ftrl_alpha: float = 0.1
     ftrl_beta: float = 1.0
@@ -228,6 +230,12 @@ class SparseLogReg:
         # (reference DoesNeedSync, ``LR/src/model/ps_model.cpp:172``); deltas
         # are pushed every minibatch and mirrored locally in between.
         self._w_cache: Dict[int, float] = {}
+        self._cache_fresh = False
+
+    @property
+    def steps(self) -> int:
+        """Minibatches trained so far; window phase = ``steps % sync_frequency``."""
+        return self._steps
 
     def current_lr(self) -> float:
         cfg = self.cfg
@@ -238,6 +246,19 @@ class SparseLogReg:
         for k, v in zip(keys, values):
             self._w_cache[int(k)] = float(v)
 
+    def load_cache(self, keys: np.ndarray, values: np.ndarray) -> None:
+        """Install a pipelined pull's result for the coming sync window.
+
+        The pipelined driver pulls the *next* window's keyset on a background
+        thread (reference ``PSModel::GetPipelineTable``,
+        ``LR/src/model/ps_model.cpp:236``) and hands it over here; the next
+        window-boundary refresh in :meth:`train_minibatch` is then skipped.
+        """
+        for k, v in zip(np.asarray(keys, np.int64).tolist(),
+                        np.asarray(values, np.float64).tolist()):
+            self._w_cache[int(k)] = float(v)
+        self._cache_fresh = True
+
     def train_minibatch(self, samples) -> float:
         """samples: list of (keys, values, label)."""
         all_keys = sorted({int(k) for keys, _, _ in samples for k in keys}
@@ -245,7 +266,13 @@ class SparseLogReg:
         key_arr = np.asarray(all_keys, np.int64)
         idx = {k: i for i, k in enumerate(all_keys)}
         sync_every = max(self.cfg.sync_frequency, 1)
-        if self._steps % sync_every == 0:
+        if self._steps % sync_every == 0 and self._cache_fresh:
+            self._cache_fresh = False  # window pre-pulled via load_cache
+            missing = np.asarray([k for k in all_keys
+                                  if k not in self._w_cache], np.int64)
+            if missing.size:
+                self._fetch_into_cache(missing)
+        elif self._steps % sync_every == 0:
             self._fetch_into_cache(key_arr)  # full refresh this window
         else:
             missing = np.asarray([k for k in all_keys

@@ -90,17 +90,19 @@ def build_unigram_alias(counts: np.ndarray, power: float = 0.75
     return thresh, alias
 
 
-def sample_negatives(rng_key, thresh: jax.Array, alias: jax.Array,
-                     shape: Tuple[int, ...]) -> jax.Array:
-    """Draw indices from the alias table on device.
-
-    thresh/alias are packed into one [V, 2] table so the draw costs a single
+def pack_alias_table(thresh: jax.Array, alias: jax.Array) -> jax.Array:
+    """Pack thresh/alias into one [V, 2] i32 table so a draw costs a single
     2-wide row gather instead of two scalar gathers (scalar gathers are the
-    slow path on TPU).
+    slow path on TPU).  Build once; :func:`sample_negatives` takes the result.
     """
-    n = thresh.shape[0]
-    packed = jnp.stack(
+    return jnp.stack(
         [jax.lax.bitcast_convert_type(thresh, jnp.int32), alias], axis=1)
+
+
+def sample_negatives(rng_key, packed: jax.Array,
+                     shape: Tuple[int, ...]) -> jax.Array:
+    """Draw indices from a packed alias table (:func:`pack_alias_table`)."""
+    n = packed.shape[0]
     k1, k2 = jax.random.split(rng_key)
     idx = jax.random.randint(k1, shape, 0, n)
     u = jax.random.uniform(k2, shape)
@@ -137,6 +139,7 @@ class Word2Vec:
             thresh, alias = build_unigram_alias(counts)
             self._thresh = jnp.asarray(thresh)
             self._alias = jnp.asarray(alias)
+            self._packed_alias = pack_alias_table(self._thresh, self._alias)
         if config.hs:
             if huffman is None:
                 Log.fatal("hierarchical softmax requires huffman codes")
@@ -215,7 +218,7 @@ class Word2Vec:
             if cfg.negative > 0:
                 if negs is None:
                     key, sub = jax.random.split(key)
-                    negs = sample_negatives(sub, self._thresh, self._alias,
+                    negs = sample_negatives(sub, self._packed_alias,
                                             (h.shape[0], cfg.negative))
                 targets = jnp.concatenate([target_word[:, None], negs], axis=1)
                 labels = jnp.concatenate(
@@ -421,7 +424,7 @@ class Word2Vec:
             negs = None
             if cfg.negative > 0:
                 key, kn = jax.random.split(key)
-                negs = sample_negatives(kn, self._thresh, self._alias,
+                negs = sample_negatives(kn, self._packed_alias,
                                         (S, B, cfg.negative))
 
             starts = (start0 + jnp.arange(S, dtype=jnp.int32) * M) % n

@@ -1,0 +1,157 @@
+"""LogReg reader-family tests (reference: LR/src/reader.{h,cpp} variants)."""
+
+import numpy as np
+import pytest
+
+
+def test_parse_weighted():
+    from multiverso_tpu.apps.lr_reader import parse_weighted
+
+    label, keys, vals = parse_weighted("1:2.5 3:0.5 7:2.0", True, 10)
+    assert label == 1.0
+    np.testing.assert_array_equal(keys, [3, 7])
+    np.testing.assert_allclose(vals, [1.25, 5.0])  # scaled by weight
+
+    label, keys, vals = parse_weighted("0:0.5 0.2 0.4", False, 3)
+    assert label == 0.0
+    np.testing.assert_allclose(vals, [0.1, 0.2, 0.0])
+
+    # weightless lines behave like the default reader
+    label, _, vals = parse_weighted("1 3:0.5", True, 10)
+    np.testing.assert_allclose(vals, [0.5])
+
+
+def test_bsparse_round_trip(tmp_path):
+    from multiverso_tpu.apps.lr_reader import iter_bsparse, write_bsparse
+
+    path = str(tmp_path / "data.bsparse")
+    rng = np.random.default_rng(0)
+    samples = []
+    for _ in range(100):
+        nkeys = int(rng.integers(1, 12))
+        keys = np.sort(rng.choice(1000, nkeys, replace=False)).astype(np.int64)
+        weight = float(rng.standard_normal())
+        samples.append((float(rng.integers(0, 2)), keys,
+                        np.full(nkeys, weight, np.float64)))
+    assert write_bsparse(path, samples) == 100
+
+    out = list(iter_bsparse(path, chunk_size=64))  # tiny chunks: refill path
+    assert len(out) == 100
+    for (l0, k0, v0), (l1, k1, v1) in zip(samples, out):
+        assert l0 == l1
+        np.testing.assert_array_equal(k0, k1)
+        np.testing.assert_allclose(v0, v1)
+
+
+def test_bsparse_truncated_raises(tmp_path):
+    from multiverso_tpu.apps.lr_reader import iter_bsparse, write_bsparse
+
+    path = str(tmp_path / "data.bsparse")
+    write_bsparse(path, [(1.0, np.arange(8, dtype=np.int64),
+                          np.ones(8))])
+    blob = open(path, "rb").read()
+    trunc = str(tmp_path / "trunc.bsparse")
+    with open(trunc, "wb") as f:
+        f.write(blob[:-4])
+    with pytest.raises(EOFError):
+        list(iter_bsparse(trunc))
+
+
+def test_sample_iterator_factory(tmp_path):
+    from multiverso_tpu.apps.lr_reader import sample_iterator, write_bsparse
+
+    a = tmp_path / "a.txt"
+    b = tmp_path / "b.txt"
+    a.write_text("1 3:0.5\n")
+    b.write_text("0 7:2.0\n")
+    # comma-separated multi-file list, read in order
+    out = list(sample_iterator("default", f"{a},{b}", True, 10))
+    assert [s[0] for s in out] == [1.0, 0.0]
+
+    out = list(sample_iterator("weight", f"{a}", True, 10))
+    assert out[0][0] == 1.0
+
+    bs = str(tmp_path / "c.bsparse")
+    write_bsparse(bs, out)
+    out2 = list(sample_iterator("bsparse", bs, True, 10))
+    np.testing.assert_array_equal(out2[0][1], out[0][1])
+
+
+def test_async_reader_keyset_windows():
+    from multiverso_tpu.apps.lr_reader import AsyncSampleReader
+
+    def gen():
+        for i in range(10):
+            yield float(i % 2), np.asarray([i, i + 100], np.int64), np.ones(2)
+
+    reader = AsyncSampleReader(gen(), window_size=4, bias_key=999)
+    seen = list(reader)
+    assert len(seen) == 10
+    ks1 = reader.next_keyset()
+    ks2 = reader.next_keyset()
+    ks3 = reader.next_keyset()
+    assert reader.next_keyset(timeout=0.5) is None
+    # windows of 4, 4, 2 samples; bias key in every keyset
+    np.testing.assert_array_equal(
+        ks1, sorted({0, 1, 2, 3, 100, 101, 102, 103, 999}))
+    np.testing.assert_array_equal(
+        ks2, sorted({4, 5, 6, 7, 104, 105, 106, 107, 999}))
+    np.testing.assert_array_equal(ks3, sorted({8, 9, 108, 109, 999}))
+
+
+def test_async_reader_propagates_errors():
+    from multiverso_tpu.apps.lr_reader import AsyncSampleReader
+
+    def gen():
+        yield 1.0, np.asarray([1], np.int64), np.ones(1)
+        raise ValueError("boom")
+
+    reader = AsyncSampleReader(gen(), window_size=4)
+    with pytest.raises(ValueError, match="boom"):
+        list(reader)
+
+
+def test_sparse_pipeline_end_to_end(mv_session, tmp_path):
+    """Pipelined sparse training (bsparse reader + keyset prefetch) learns."""
+    from multiverso_tpu.apps import logreg as app
+    from multiverso_tpu.models.logreg import LogRegConfig
+
+    rng = np.random.default_rng(3)
+    dim = 60
+    w = np.zeros(dim)
+    w[:8] = rng.standard_normal(8) * 2
+    lines = []
+    for _ in range(400):
+        keys = np.sort(rng.choice(dim, size=6, replace=False))
+        vals = rng.standard_normal(6)
+        label = int(w[keys] @ vals > 0)
+        lines.append(f"{label} " + " ".join(
+            f"{k}:{v:.5f}" for k, v in zip(keys, vals)))
+    train = tmp_path / "train.txt"
+    train.write_text("\n".join(lines) + "\n")
+
+    cfg = LogRegConfig(input_size=dim, sparse=True, pipeline=True,
+                       sync_frequency=2, minibatch_size=32,
+                       learning_rate=0.5, learning_rate_coef=0.001)
+    model = app.build_model(cfg)
+    for _ in range(12):
+        app.train_file(model, cfg, str(train), epochs=1, log_every=0)
+    acc = app.test_file(model, cfg, str(train))
+    assert acc > 0.85
+
+
+def test_weight_reader_end_to_end(mv_session, tmp_path):
+    """weight reader: zero-weight samples must not move the model."""
+    from multiverso_tpu.apps import logreg as app
+    from multiverso_tpu.models.logreg import LogRegConfig
+
+    # all-zero-weight samples -> zero feature values -> only bias learns
+    lines = ["1:0.0 1:5.0 2:5.0"] * 16
+    train = tmp_path / "train.txt"
+    train.write_text("\n".join(lines) + "\n")
+    cfg = LogRegConfig(input_size=4, sparse=True, reader_type="weight",
+                       minibatch_size=8, learning_rate=0.5)
+    model = app.build_model(cfg)
+    app.train_file(model, cfg, str(train), epochs=1, log_every=0)
+    weights = model.table.get_keys(np.asarray([1, 2], np.int64))
+    np.testing.assert_allclose(np.asarray(weights), 0.0, atol=1e-12)

@@ -27,11 +27,12 @@ def test_unigram_alias_distribution():
     # sampling matches p ~ counts^0.75 within tolerance
     import jax
 
-    from multiverso_tpu.models.word2vec import sample_negatives
+    from multiverso_tpu.models.word2vec import pack_alias_table, sample_negatives
     import jax.numpy as jnp
 
     samples = np.asarray(sample_negatives(
-        jax.random.PRNGKey(0), jnp.asarray(thresh), jnp.asarray(alias),
+        jax.random.PRNGKey(0),
+        pack_alias_table(jnp.asarray(thresh), jnp.asarray(alias)),
         (20000,)))
     freq = np.bincount(samples, minlength=3) / samples.size
     expect = counts ** 0.75
