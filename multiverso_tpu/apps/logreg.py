@@ -165,11 +165,13 @@ def _train_sparse_epoch(model: SparseLogReg, cfg: LogRegConfig, path: str
         getter.prime(first)
         in_flight = True
     try:
-        for batch in batched(reader, cfg.minibatch_size):
-            # Align on the model's persistent step counter, not a per-epoch
-            # index: partial trailing batches advance it by one like full
-            # ones, and its window phase carries across train_file calls.
-            if in_flight and model.steps % sync_every == 0:
+        for batch_idx, batch in enumerate(batched(reader, cfg.minibatch_size)):
+            # Align on the per-epoch batch index: the reader's keyset windows
+            # restart at sample 0 each epoch, so the boundary phase must
+            # restart with them (model.steps carries phase across epochs
+            # whenever an epoch's batch count is not a multiple of
+            # sync_frequency, which would misalign every later window).
+            if in_flight and batch_idx % sync_every == 0:
                 nxt = reader.next_keyset()
                 pulled = getter.get(nxt)
                 in_flight = nxt is not None

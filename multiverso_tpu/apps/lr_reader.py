@@ -44,21 +44,27 @@ Sample = Tuple[float, np.ndarray, np.ndarray]
 _BSPARSE_HEAD = struct.Struct("<qid")  # nkeys, label, weight
 
 
-def parse_default(line: str, sparse: bool, input_size: int) -> Sample:
-    """``label k:v ...`` / ``label v v ...`` (``LR/src/reader.cpp:169``)."""
-    parts = line.split()
-    label = float(parts[0])
+def _parse_features(parts: List[str], sparse: bool, input_size: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Feature tokens (already split) -> (keys, values)."""
     if sparse:
         keys, vals = [], []
-        for tok in parts[1:]:
+        for tok in parts:
             k, _, v = tok.partition(":")
             keys.append(int(k))
             vals.append(float(v) if v else 1.0)
-        return label, np.asarray(keys, np.int64), np.asarray(vals, np.float64)
+        return np.asarray(keys, np.int64), np.asarray(vals, np.float64)
     vals = np.zeros(input_size, np.float64)
-    dense = [float(t) for t in parts[1:]]
+    dense = [float(t) for t in parts]
     vals[: len(dense)] = dense
-    return label, np.arange(len(dense), dtype=np.int64), vals
+    return np.arange(len(dense), dtype=np.int64), vals
+
+
+def parse_default(line: str, sparse: bool, input_size: int) -> Sample:
+    """``label k:v ...`` / ``label v v ...`` (``LR/src/reader.cpp:169``)."""
+    parts = line.split()
+    keys, vals = _parse_features(parts[1:], sparse, input_size)
+    return float(parts[0]), keys, vals
 
 
 def parse_weighted(line: str, sparse: bool, input_size: int) -> Sample:
@@ -66,10 +72,9 @@ def parse_weighted(line: str, sparse: bool, input_size: int) -> Sample:
     (``WeightedSampleReader::ParseLine``, ``LR/src/reader.cpp:233``)."""
     parts = line.split()
     head, _, wtok = parts[0].partition(":")
-    label = float(head)
     weight = float(wtok) if wtok else 1.0
-    sample = parse_default(" ".join([head] + parts[1:]), sparse, input_size)
-    return label, sample[1], sample[2] * weight
+    keys, vals = _parse_features(parts[1:], sparse, input_size)
+    return float(head), keys, vals * weight
 
 
 def write_bsparse(path: str, samples: Iterable[Sample]) -> int:
@@ -103,6 +108,9 @@ def iter_bsparse(path: str, chunk_size: int = 1 << 20) -> Iterator[Sample]:
                 buf = buf[offset:] + stream.read(chunk_size)
                 offset = 0
                 if len(buf) < _BSPARSE_HEAD.size:
+                    if buf:
+                        raise EOFError(
+                            f"truncated bsparse record header in {path}")
                     return
             nkeys, label, weight = _BSPARSE_HEAD.unpack_from(buf, offset)
             offset += _BSPARSE_HEAD.size
@@ -213,9 +221,15 @@ class AsyncSampleReader:
                 return
             yield item
 
-    def next_keyset(self, timeout: Optional[float] = 30.0
+    def next_keyset(self, timeout: Optional[float] = None
                     ) -> Optional[np.ndarray]:
-        """Keyset for the next window, or None once the stream is drained."""
+        """Keyset for the next window; None only once the stream is drained.
+
+        Blocks while the producer is still parsing (by default without
+        limit — a slow source delays the pull, it doesn't disable it). With
+        ``timeout`` set, expiry raises :class:`TimeoutError` so a slow
+        producer is never mistaken for end-of-stream.
+        """
         while True:
             if self._error is not None:
                 raise self._error
@@ -227,7 +241,9 @@ class AsyncSampleReader:
                 if timeout is not None:
                     timeout -= 0.1
                     if timeout <= 0:
-                        return None
+                        raise TimeoutError(
+                            "next_keyset: producer still running after "
+                            "timeout")
 
     def close(self) -> None:
         self._stop.set()

@@ -136,10 +136,12 @@ class Word2Vec:
         if config.negative > 0:
             if counts is None:
                 Log.fatal("negative sampling requires vocab counts")
+            # Only the packed [V, 2] table is kept; the separate thresh/alias
+            # arrays would pin two extra vocab-sized device buffers for the
+            # model's lifetime.
             thresh, alias = build_unigram_alias(counts)
-            self._thresh = jnp.asarray(thresh)
-            self._alias = jnp.asarray(alias)
-            self._packed_alias = pack_alias_table(self._thresh, self._alias)
+            self._packed_alias = pack_alias_table(jnp.asarray(thresh),
+                                                  jnp.asarray(alias))
         if config.hs:
             if huffman is None:
                 Log.fatal("hierarchical softmax requires huffman codes")

@@ -3,6 +3,8 @@
 from .embedding import embedding_lookup, scatter_add_rows, segment_mean_rows
 from .flash_attention import (flash_attention, flash_attention_partial,
                               merge_partials)
+from .moe import (EXPERT_AXIS, init_moe_params, mlp_expert, moe_apply,
+                  top1_gating)
 from .ring_attention import reference_attention, ring_attention
 
 __all__ = [
@@ -12,6 +14,11 @@ __all__ = [
     "flash_attention",
     "flash_attention_partial",
     "merge_partials",
+    "EXPERT_AXIS",
+    "init_moe_params",
+    "mlp_expert",
+    "moe_apply",
+    "top1_gating",
     "reference_attention",
     "ring_attention",
 ]

@@ -8,6 +8,8 @@ from .allreduce_engine import AllreduceEngine
 from .async_buffer import ASyncBuffer, PipelinedGetter, prefetch_iterator
 from .collectives import (all_gather, allreduce, allreduce_replicated,
                           reduce_scatter, ring_shift)
+from .pipeline import (STAGE_AXIS, make_pipeline_mesh, microbatch,
+                       pipeline_apply, stack_stage_params)
 from .sync_step import make_sync_step
 
 __all__ = [
@@ -20,5 +22,10 @@ __all__ = [
     "allreduce_replicated",
     "reduce_scatter",
     "ring_shift",
+    "STAGE_AXIS",
+    "make_pipeline_mesh",
+    "microbatch",
+    "pipeline_apply",
+    "stack_stage_params",
     "make_sync_step",
 ]

@@ -1,0 +1,128 @@
+"""GPipe-style pipeline parallelism over a ``stage`` mesh axis.
+
+The reference framework has no pipeline parallelism (SURVEY §2.5 — the word
+"pipeline" there means compute/comm double-buffering: ``ASyncBuffer``
+``include/multiverso/util/async_buffer.h:11``, LogReg ``GetPipelineTable``
+``Applications/LogisticRegression/src/model/ps_model.cpp:236``). Our TPU-first
+design generalises the reference's storage-only model parallelism to real
+compute parallelism, and pipeline parallelism falls out of the mesh design:
+
+* stages are devices along a ``stage`` mesh axis;
+* activations flow stage -> stage over ICI via ``lax.ppermute``;
+* the GPipe microbatch schedule is a ``lax.scan`` inside ``shard_map`` —
+  tick ``t`` has stage ``s`` working on microbatch ``t - s`` (bubble at the
+  ramp-up/ramp-down edges);
+* the whole schedule is differentiable end-to-end: the transpose of
+  ``ppermute`` is the reverse ring, so reverse-mode AD derives the backward
+  pipeline schedule automatically.
+
+Constraints (the usual SPMD pipeline contract): every stage has the same
+activation shape and the same ``stage_fn`` signature; per-stage parameters are
+stacked on a leading ``n_stages`` dim and sharded over the ``stage`` axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import PartitionSpec as P
+
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:  # pragma: no cover — jax < 0.8
+    from jax.experimental.shard_map import shard_map
+
+STAGE_AXIS = "stage"
+
+
+def make_pipeline_mesh(n_stages: Optional[int] = None,
+                       devices: Optional[Sequence] = None):
+    """A 1-D mesh whose single axis is the pipeline ``stage`` axis."""
+    from ..topology import make_mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_stages is None:
+        n_stages = len(devices)
+    return make_mesh((n_stages,), axis_names=(STAGE_AXIS,),
+                     devices=devices[:n_stages])
+
+
+def stack_stage_params(per_stage_params: Sequence[Any]):
+    """Stack a list of per-stage parameter pytrees on a leading stage dim."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    params: Any,
+    xs: jax.Array,
+    mesh,
+    axis: str = STAGE_AXIS,
+) -> jax.Array:
+    """Apply ``f_{S-1}(...f_1(f_0(x)))`` pipelined over mesh axis ``axis``.
+
+    Args:
+      stage_fn: ``(stage_params, activation) -> activation``; activation
+        shape must be invariant across stages.
+      params: pytree whose leaves have leading dim ``n_stages``; sharded (or
+        shardable) over ``axis``.
+      xs: ``[n_micro, micro_batch, ...]`` microbatched input (replicated).
+      mesh: mesh containing ``axis``.
+
+    Returns ``[n_micro, micro_batch, ...]`` outputs, replicated across the
+    stage axis. Differentiable in ``params`` and ``xs``.
+    """
+    n_stages = int(mesh.shape[axis])
+    n_micro = int(xs.shape[0])
+    param_spec = jax.tree.map(
+        lambda leaf: P(axis, *(None,) * (np.ndim(leaf) - 1)), params)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(param_spec, P()), out_specs=P(),
+             check_vma=False)
+    def _pipelined(p_shard, xs_rep):
+        stage = jax.lax.axis_index(axis)
+        p_local = jax.tree.map(lambda leaf: leaf[0], p_shard)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        state0 = jnp.zeros_like(xs_rep[0])
+        out0 = jnp.zeros_like(xs_rep)
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Stage 0 feeds microbatch t (clamped; garbage after the last
+            # microbatch never survives long enough to be recorded).
+            feed = jax.lax.dynamic_index_in_dim(
+                xs_rep, jnp.clip(t, 0, n_micro - 1), keepdims=False)
+            inp = jnp.where(stage == 0, feed, state)
+            out = stage_fn(p_local, inp)
+            # The last stage records microbatch t-(n_stages-1) at tick t.
+            rec = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            recorded = jax.lax.dynamic_update_index_in_dim(
+                outputs, out.astype(outputs.dtype), rec, axis=0)
+            take = jnp.logical_and(stage == n_stages - 1,
+                                   t >= n_stages - 1)
+            outputs = jnp.where(take, recorded, outputs)
+            state = jax.lax.ppermute(out, axis, perm)
+            return (state, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state0, out0), jnp.arange(n_micro + n_stages - 1))
+        # Outputs are only valid on the last stage; a masked psum replicates
+        # them (and its transpose routes cotangents back in the bwd pass).
+        mask = (stage == n_stages - 1).astype(outputs.dtype)
+        return jax.lax.psum(outputs * mask, axis)
+
+    return _pipelined(params, xs)
+
+
+def microbatch(batch: jax.Array, n_micro: int) -> jax.Array:
+    """Split ``[B, ...]`` into ``[n_micro, B//n_micro, ...]``."""
+    if batch.shape[0] % n_micro != 0:
+        raise ValueError(
+            f"batch dim {batch.shape[0]} not divisible by n_micro={n_micro}")
+    return batch.reshape((n_micro, batch.shape[0] // n_micro) + batch.shape[1:])
