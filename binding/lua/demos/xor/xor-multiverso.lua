@@ -1,0 +1,89 @@
+-- XOR trained data-parallel through multiverso (reference demo:
+-- binding/lua/demos/xor/xor-multiverso.lua in the Multiverso reference).
+--
+-- A 2-2-1 MLP learns XOR with plain-Lua forward/backward (no torch needed);
+-- all weights live flattened in one ArrayTable and every worker pushes
+-- lr-scaled gradient deltas, pulling the merged model each step — the same
+-- delta-sync pattern as the Python param managers.
+--
+-- Run:  MV_NATIVE_LIB=cpp/libmultiverso_tpu.so luajit \
+--         -e "package.path='binding/lua/?.lua;binding/?.lua;'..package.path" \
+--         binding/lua/demos/xor/xor-multiverso.lua
+
+local mv = require 'multiverso'
+
+local inputs = { {0, 0}, {0, 1}, {1, 0}, {1, 1} }
+local targets = { 0, 1, 1, 0 }
+
+-- layout: w1[2][2] (p1..p4), b1[2] (p5..p6), w2[2] (p7..p8), b2 (p9)
+local N_PARAMS = 9
+local LR = 0.5
+local EPOCHS = 4000
+
+local function sigmoid(x) return 1.0 / (1.0 + math.exp(-x)) end
+
+local function forward(p, x)
+  local h = {}
+  for j = 1, 2 do
+    h[j] = sigmoid(p[(j - 1) * 2 + 1] * x[1] + p[(j - 1) * 2 + 2] * x[2]
+                   + p[4 + j])
+  end
+  local y = sigmoid(p[7] * h[1] + p[8] * h[2] + p[9])
+  return y, h
+end
+
+local function backward(p, x, h, y, t)
+  local g = {}
+  for i = 1, N_PARAMS do g[i] = 0 end
+  local dy = (y - t) * y * (1 - y)
+  g[7] = dy * h[1]
+  g[8] = dy * h[2]
+  g[9] = dy
+  for j = 1, 2 do
+    local dh = dy * p[6 + j] * h[j] * (1 - h[j])
+    g[(j - 1) * 2 + 1] = dh * x[1]
+    g[(j - 1) * 2 + 2] = dh * x[2]
+    g[4 + j] = dh
+  end
+  return g
+end
+
+mv.init()
+math.randomseed(42 + mv.worker_id())
+
+-- MULTIVERSO: shared model table; init_value averages across workers
+local init = {}
+for i = 1, N_PARAMS do init[i] = (math.random() - 0.5) * 2 end
+local table_handler = mv.ArrayTableHandler:new(N_PARAMS, init)
+mv.barrier()
+
+for epoch = 1, EPOCHS do
+  -- MULTIVERSO: pull the merged model
+  local p = table_handler:get()
+  local delta = {}
+  for i = 1, N_PARAMS do delta[i] = 0 end
+  -- each worker takes a strided share of the 4 samples
+  for s = 1 + mv.worker_id(), 4, mv.num_workers() do
+    local y, h = forward(p, inputs[s])
+    local g = backward(p, inputs[s], h, y, targets[s])
+    for i = 1, N_PARAMS do delta[i] = delta[i] - LR * g[i] end
+  end
+  -- MULTIVERSO: push the delta
+  table_handler:add(delta)
+end
+
+mv.barrier()
+local p = table_handler:get()
+local correct = 0
+for s = 1, 4 do
+  local y = forward(p, inputs[s])
+  local pred = y > 0.5 and 1 or 0
+  if pred == targets[s] then correct = correct + 1 end
+  if mv.worker_id() == 0 then
+    print(string.format('xor(%d,%d) -> %.3f (want %d)',
+                        inputs[s][1], inputs[s][2], y, targets[s]))
+  end
+end
+assert(correct == 4, 'xor demo failed to converge')
+if mv.worker_id() == 0 then print('xor demo: 4/4 correct') end
+mv.shutdown()

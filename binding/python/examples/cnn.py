@@ -1,0 +1,78 @@
+"""Data-parallel CNN training via the torch extension.
+
+Rebuild of the reference example (``binding/python/examples/theano/cnn.py``
+in the Multiverso reference) on torch (CPU) instead of Theano. The
+``MVTorchParamManager`` plays the role of the reference's
+``MVNetParamManager``: all module parameters live flattened in one
+ArrayTable; ``sync_all_param`` pushes the local delta and pulls the merged
+model (the reference lasagne_ext pattern,
+``theano_ext/lasagne_ext/param_manager.py:9-63``).
+"""
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+# MULTIVERSO: import the binding + torch extension
+import multiverso as mv
+from multiverso.torch_ext.param_manager import MVTorchParamManager
+
+from datasets import synthetic_images
+
+N_EPOCHS = 6
+BATCH = 32
+SYNC_EVERY = 4   # minibatches between syncs (reference sync_freq)
+
+
+class SmallCNN(nn.Module):
+    def __init__(self, n_classes=4):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 8, 3, padding=1)
+        self.conv2 = nn.Conv2d(8, 16, 3, padding=1)
+        self.fc = nn.Linear(16 * 3 * 3, n_classes)
+
+    def forward(self, x):
+        x = F.max_pool2d(F.relu(self.conv1(x)), 2)
+        x = F.max_pool2d(F.relu(self.conv2(x)), 2)
+        return self.fc(torch.flatten(x, 1))
+
+
+def main():
+    torch.manual_seed(0)
+    # MULTIVERSO: init
+    mv.init()
+    (train_x, train_y), (test_x, test_y) = synthetic_images()
+    model = SmallCNN()
+    # MULTIVERSO: register all params in one table
+    manager = MVTorchParamManager(model)
+    opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9)
+
+    n = train_x.shape[0]
+    for epoch in range(N_EPOCHS):
+        order = np.random.default_rng(epoch).permutation(n)
+        # MULTIVERSO: strided batch shard per worker
+        for i, start in enumerate(range(mv.worker_id() * BATCH,
+                                        n - BATCH + 1,
+                                        BATCH * mv.workers_num())):
+            idx = order[start:start + BATCH]
+            x = torch.from_numpy(train_x[idx])
+            y = torch.from_numpy(train_y[idx])
+            opt.zero_grad()
+            F.cross_entropy(model(x), y).backward()
+            opt.step()
+            # MULTIVERSO: delta-sync every SYNC_EVERY minibatches
+            if i % SYNC_EVERY == SYNC_EVERY - 1:
+                manager.sync_all_param()
+        with torch.no_grad():
+            preds = model(torch.from_numpy(test_x)).argmax(-1).numpy()
+        acc = float((preds == test_y).mean())
+        if mv.is_master_worker():
+            print(f"epoch {epoch}: test accuracy {acc:.3f}")
+    assert acc > 0.8, f"cnn example failed to converge: acc={acc}"
+    # MULTIVERSO: shutdown
+    mv.shutdown()
+
+
+if __name__ == "__main__":
+    main()

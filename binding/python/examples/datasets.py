@@ -1,0 +1,40 @@
+"""Synthetic datasets for the examples.
+
+The reference examples download MNIST/CIFAR (``load_data.py`` in the
+Multiverso reference binding); this environment has no egress, so the
+examples train on synthetic data with the same shapes and a learnable
+structure (linearly separable clusters / patterned images).
+"""
+
+import numpy as np
+
+
+def synthetic_classification(n_train=2048, n_test=512, n_features=20,
+                             n_classes=4, seed=0):
+    """Gaussian clusters around random class centroids."""
+    rng = np.random.default_rng(seed)
+    centroids = rng.standard_normal((n_classes, n_features)) * 3.0
+
+    def make(n):
+        y = rng.integers(0, n_classes, n)
+        x = centroids[y] + rng.standard_normal((n, n_features))
+        return x.astype(np.float32), y.astype(np.int64)
+
+    return make(n_train), make(n_test)
+
+
+def synthetic_images(n_train=1024, n_test=256, side=12, n_classes=4, seed=0):
+    """Tiny images whose class is a quadrant-intensity pattern."""
+    rng = np.random.default_rng(seed)
+
+    def make(n):
+        y = rng.integers(0, n_classes, n)
+        x = rng.standard_normal((n, 1, side, side)).astype(np.float32) * 0.3
+        half = side // 2
+        for i in range(n):
+            q = y[i]
+            r0, c0 = (q // 2) * half, (q % 2) * half
+            x[i, 0, r0:r0 + half, c0:c0 + half] += 1.5
+        return x, y.astype(np.int64)
+
+    return make(n_train), make(n_test)

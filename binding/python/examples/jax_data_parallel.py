@@ -1,0 +1,92 @@
+"""Data-parallel MLP with the jax extension's param manager.
+
+Counterpart of the reference Lasagne ResNet example
+(``binding/python/examples/lasagne/Deep_Residual_Learning_CIFAR-10.py`` in
+the Multiverso reference) at example scale: a jax/optax training loop where
+the whole parameter pytree syncs through one ArrayTable via
+``MVNetParamManager.sync_all_param`` (push delta, pull merged, scatter back).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# MULTIVERSO: binding + jax extension
+import multiverso as mv
+from multiverso.jax_ext.param_manager import MVNetParamManager
+
+from datasets import synthetic_classification
+
+N_EPOCHS = 15
+BATCH = 64
+SYNC_EVERY = 4
+
+
+def init_mlp(rng, sizes):
+    params = []
+    for fan_in, fan_out in zip(sizes[:-1], sizes[1:]):
+        params.append({
+            "w": jnp.asarray(
+                rng.standard_normal((fan_in, fan_out)) / np.sqrt(fan_in),
+                jnp.float32),
+            "b": jnp.zeros((fan_out,), jnp.float32),
+        })
+    return params
+
+
+def forward(params, x):
+    for layer in params[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    last = params[-1]
+    return x @ last["w"] + last["b"]
+
+
+def main():
+    # MULTIVERSO: init
+    mv.init()
+    rng = np.random.default_rng(0)
+    (train_x, train_y), (test_x, test_y) = synthetic_classification()
+    params = init_mlp(rng, [train_x.shape[1], 64, 32, 4])
+    # MULTIVERSO: the param manager flattens the pytree into one ArrayTable
+    manager = MVNetParamManager(params)
+    params = manager.params
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = forward(p, x)
+            return -jnp.mean(
+                jax.nn.log_softmax(logits)[jnp.arange(x.shape[0]), y])
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    n = train_x.shape[0]
+    for epoch in range(N_EPOCHS):
+        for i, start in enumerate(range(mv.worker_id() * BATCH,
+                                        n - BATCH + 1,
+                                        BATCH * mv.workers_num())):
+            x = jnp.asarray(train_x[start:start + BATCH])
+            y = jnp.asarray(train_y[start:start + BATCH])
+            params, opt_state, loss = step(params, opt_state, x, y)
+            # MULTIVERSO: push delta / pull merged every few batches
+            if i % SYNC_EVERY == SYNC_EVERY - 1:
+                manager.set_params(params)
+                manager.sync_all_param()
+                params = manager.params
+        acc = float(jnp.mean(
+            jnp.argmax(forward(params, jnp.asarray(test_x)), -1)
+            == jnp.asarray(test_y)))
+        if mv.is_master_worker():
+            print(f"epoch {epoch}: test accuracy {acc:.3f}")
+    assert acc > 0.9, f"mlp example failed to converge: acc={acc}"
+    # MULTIVERSO: shutdown
+    mv.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Data-parallel logistic regression via the `multiverso` binding.
+
+Rebuild of the reference example
+(``binding/python/examples/theano/logistic_regression.py`` in the Multiverso
+reference) on JAX instead of Theano. Lines marked ``# MULTIVERSO:`` are the
+complete diff against a single-process script — the same annotation style the
+reference uses to show how little changes.
+
+Run single-process, or data-parallel with one process per worker:
+
+    python logistic_regression.py
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# MULTIVERSO: import multiverso
+import multiverso as mv
+
+from datasets import synthetic_classification
+
+N_EPOCHS = 20
+BATCH = 64
+LR = 0.5
+N_FEATURES = 20
+N_CLASSES = 4
+
+
+def main():
+    # MULTIVERSO: initialise the framework (sync=False -> async PS mode)
+    mv.init()
+    worker_id = mv.worker_id()
+    workers_num = mv.workers_num()
+
+    (train_x, train_y), (test_x, test_y) = synthetic_classification(
+        n_features=N_FEATURES, n_classes=N_CLASSES)
+
+    w = jnp.zeros((N_FEATURES, N_CLASSES), jnp.float32)
+    b = jnp.zeros((N_CLASSES,), jnp.float32)
+
+    # MULTIVERSO: one ArrayTable holds the flattened model; init_value
+    # divides by workers_num so the summed initial values equal the model.
+    flat0 = np.concatenate([np.ravel(w), np.ravel(b)]).astype(np.float32)
+    table = mv.ArrayTableHandler(flat0.size, init_value=flat0)
+    mv.barrier()
+
+    @jax.jit
+    def grads(w, b, x, y):
+        def loss_fn(w, b):
+            logits = x @ w + b
+            return -jnp.mean(
+                jax.nn.log_softmax(logits)[jnp.arange(x.shape[0]), y])
+        return jax.grad(loss_fn, argnums=(0, 1))(w, b)
+
+    n = train_x.shape[0]
+    for epoch in range(N_EPOCHS):
+        # MULTIVERSO: each worker trains a strided shard of the batches
+        for start in range(worker_id * BATCH, n - BATCH + 1,
+                           BATCH * workers_num):
+            x = train_x[start:start + BATCH]
+            y = train_y[start:start + BATCH]
+            gw, gb = grads(w, b, x, y)
+            # MULTIVERSO: push -lr*grad as the delta, then pull the merged
+            # model back (the reference sharedvar mv_sync pattern).
+            delta = np.concatenate(
+                [np.ravel(gw), np.ravel(gb)]).astype(np.float32)
+            table.add(-LR * delta / workers_num)
+            merged = table.get()
+            w = jnp.asarray(merged[: w.size].reshape(w.shape))
+            b = jnp.asarray(merged[w.size:].reshape(b.shape))
+        acc = float(jnp.mean(
+            jnp.argmax(test_x @ w + b, axis=-1) == test_y))
+        # MULTIVERSO: only the master worker reports
+        if mv.is_master_worker():
+            print(f"epoch {epoch}: test accuracy {acc:.3f}")
+    assert acc > 0.9, f"logreg example failed to converge: acc={acc}"
+
+    # MULTIVERSO: shut down the framework
+    mv.shutdown()
+
+
+if __name__ == "__main__":
+    main()

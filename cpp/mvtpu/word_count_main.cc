@@ -1,0 +1,82 @@
+// Corpus word-count preprocessor.
+//
+// TPU-native rebuild of the reference WordEmbedding preprocessing tool
+// (Applications/WordEmbedding/preprocess/word_count.cpp in the Multiverso
+// reference): stream a whitespace-tokenised corpus, count occurrences, and
+// write "word<space>count" lines sorted by descending count — the input the
+// word2vec dictionary loader consumes. Uses the runtime's buffered stream
+// layer instead of raw stdio.
+//
+// Usage: mv_word_count <corpus> <output> [min_count]
+
+#include <algorithm>
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+#include "mvtpu/log.h"
+#include "mvtpu/stream.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <corpus> <output> [min_count]\n", argv[0]);
+    return 2;
+  }
+  const std::string corpus = argv[1];
+  const std::string output = argv[2];
+  const long long min_count = argc > 3 ? std::atoll(argv[3]) : 1;
+
+  auto in = mvtpu::CreateStream(corpus, "r");
+  if (!in) {
+    mvtpu::Log::Error("cannot open corpus %s", corpus.c_str());
+    return 1;
+  }
+  mvtpu::TextReader reader(std::move(in));
+  std::unordered_map<std::string, long long> counts;
+  long long total = 0;
+  std::string line;
+  while (reader.GetLine(&line)) {
+    size_t pos = 0;
+    while (pos < line.size()) {
+      while (pos < line.size() &&
+             std::isspace(static_cast<unsigned char>(line[pos])))
+        ++pos;
+      size_t end = pos;
+      while (end < line.size() &&
+             !std::isspace(static_cast<unsigned char>(line[end])))
+        ++end;
+      if (end > pos) {
+        ++counts[line.substr(pos, end - pos)];
+        ++total;
+      }
+      pos = end;
+    }
+  }
+
+  std::vector<std::pair<std::string, long long>> sorted(counts.begin(),
+                                                        counts.end());
+  std::sort(sorted.begin(), sorted.end(), [](const auto& a, const auto& b) {
+    return a.second != b.second ? a.second > b.second : a.first < b.first;
+  });
+
+  auto out = mvtpu::CreateStream(output, "w");
+  if (!out) {
+    mvtpu::Log::Error("cannot open output %s", output.c_str());
+    return 1;
+  }
+  long long kept = 0;
+  for (const auto& [word, count] : sorted) {
+    if (count < min_count) break;  // sorted desc: everything after is below
+    std::string rec = word + " " + std::to_string(count) + "\n";
+    out->Write(rec.data(), rec.size());
+    ++kept;
+  }
+  out->Flush();
+  mvtpu::Log::Info("word_count: %lld tokens, %zu distinct, %lld kept -> %s",
+                   total, sorted.size(), kept, output.c_str());
+  return 0;
+}

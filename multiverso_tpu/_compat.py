@@ -1,0 +1,13 @@
+"""Version-compat shims shared across modules."""
+
+from __future__ import annotations
+
+import jax
+
+# jax >= 0.8 exposes shard_map at the top level; older versions under
+# jax.experimental. One shim here instead of a copy per module.
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:  # pragma: no cover — jax < 0.8
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["shard_map"]

@@ -17,13 +17,18 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Optional
+import re
+import shutil
+import threading
+import time
+from typing import List, Optional
 
 from ..log import Log
 from ..runtime import Session
 from .stream import open_stream
 
 _MANIFEST = "manifest.json"
+_STEP_DIR = re.compile(r"^step_(\d+)$")
 
 
 def save(directory: str, session: Optional[Session] = None) -> None:
@@ -74,3 +79,98 @@ def restore(directory: str, session: Optional[Session] = None) -> None:
         with open_stream(os.path.join(directory, entry["file"]), "rb") as stream:
             table.load(stream)
     Log.info("checkpoint restored: %d table(s) <- %s", len(sess.tables), directory)
+
+
+def list_steps(root: str) -> List[int]:
+    """Completed checkpoint steps under ``root``, ascending."""
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        m = _STEP_DIR.match(name)
+        if m and os.path.exists(os.path.join(root, name, _MANIFEST)):
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def restore_latest(root: str, session: Optional[Session] = None
+                   ) -> Optional[int]:
+    """Restore the newest complete checkpoint under ``root``.
+
+    Returns the restored step, or None if no checkpoint exists (fresh
+    start). The failure-recovery entry point the reference never wired up
+    (SURVEY §5.3: crash recovery = checkpoint/resume driven by the app): a
+    restarted job calls this before training and resumes from wherever the
+    autosaver last landed.
+    """
+    steps = list_steps(root)
+    if not steps:
+        return None
+    restore(os.path.join(root, f"step_{steps[-1]}"), session)
+    return steps[-1]
+
+
+class Autosaver:
+    """Periodic checkpointing with retention — the automatic trigger the
+    reference reserved but never implemented (``Test/main.cpp:293-331``
+    comments; SURVEY §5.4 "not wired to any automatic trigger").
+
+    Call :meth:`step` from the training loop; every ``every_steps`` steps
+    (and/or ``every_seconds`` wall-clock) it writes ``root/step_N`` and
+    prunes to the ``keep`` newest. Writes are atomic at the directory level
+    (written to ``.tmp`` then renamed) so a crash mid-save never corrupts
+    the latest restorable checkpoint.
+    """
+
+    def __init__(self, root: str, every_steps: int = 0,
+                 every_seconds: float = 0.0, keep: int = 3,
+                 session: Optional[Session] = None) -> None:
+        if every_steps <= 0 and every_seconds <= 0:
+            Log.fatal("Autosaver needs every_steps and/or every_seconds > 0")
+        sess = session or Session.get()
+        if every_seconds > 0 and sess.started and sess.size > 1:
+            # save() is collective (barriers); a rank-local wall clock lets
+            # processes disagree on whether a save is due and deadlock.
+            Log.fatal("Autosaver: every_seconds is rank-local and unsafe in "
+                      "multi-process runs — use every_steps (deterministic "
+                      "across ranks)")
+        self._root = root
+        self._every_steps = every_steps
+        self._every_seconds = every_seconds
+        self._keep = max(keep, 1)
+        self._session = session
+        self._last_time = time.monotonic()
+        self._lock = threading.Lock()
+
+    def step(self, step: int) -> bool:
+        """Maybe checkpoint at ``step``; returns True if a save happened."""
+        due = (self._every_steps > 0 and step > 0
+               and step % self._every_steps == 0)
+        if not due and self._every_seconds > 0:
+            due = time.monotonic() - self._last_time >= self._every_seconds
+        if not due:
+            return False
+        self.save_now(step)
+        return True
+
+    def save_now(self, step: int) -> None:
+        with self._lock:
+            sess = self._session or Session.get()
+            final = os.path.join(self._root, f"step_{step}")
+            tmp = final + ".tmp"
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            save(tmp, sess)
+            if sess.rank == 0:
+                if os.path.isdir(final):
+                    shutil.rmtree(final)
+                os.replace(tmp, final)
+                self._prune()
+            sess.barrier()
+            self._last_time = time.monotonic()
+
+    def _prune(self) -> None:
+        steps = list_steps(self._root)
+        for old in steps[:-self._keep]:
+            shutil.rmtree(os.path.join(self._root, f"step_{old}"),
+                          ignore_errors=True)

@@ -32,9 +32,7 @@ import numpy as np
 
 from jax.sharding import PartitionSpec as P
 
-shard_map = getattr(jax, "shard_map", None)
-if shard_map is None:  # pragma: no cover — jax < 0.8
-    from jax.experimental.shard_map import shard_map
+from .._compat import shard_map
 
 EXPERT_AXIS = "expert"
 
@@ -56,13 +54,18 @@ def top1_gating(logits: jax.Array, capacity: int
     n_tokens, n_experts = logits.shape
     gates = jax.nn.softmax(logits, axis=-1)                     # [T, E]
     expert_idx = jnp.argmax(gates, axis=-1)                     # [T]
-    onehot = jax.nn.one_hot(expert_idx, n_experts,
-                            dtype=logits.dtype)                 # [T, E]
+    # Buffer positions are counters: keep them int32 regardless of the
+    # logits dtype — a bf16 cumsum loses integer exactness past 256 tokens
+    # and would pack multiple tokens into one slot.
+    onehot_i = jax.nn.one_hot(expert_idx, n_experts,
+                              dtype=jnp.int32)                  # [T, E]
+    onehot = onehot_i.astype(logits.dtype)
     # Position of each token within its expert's buffer (0-based).
-    position = jnp.cumsum(onehot, axis=0) * onehot - onehot     # [T, E]
-    keep = (position < capacity).astype(logits.dtype) * onehot  # [T, E]
+    position = jnp.cumsum(onehot_i, axis=0) * onehot_i - onehot_i  # [T, E]
+    keep = ((position < capacity) & (onehot_i > 0)).astype(
+        logits.dtype)                                           # [T, E]
     dispatch = keep[:, :, None] * jax.nn.one_hot(
-        position.astype(jnp.int32), capacity, dtype=logits.dtype)  # [T, E, C]
+        position, capacity, dtype=logits.dtype)                 # [T, E, C]
     gate_val = jnp.sum(gates * onehot, axis=-1)                 # [T]
     combine = dispatch * gate_val[:, None, None]                # [T, E, C]
     frac_routed = jnp.mean(onehot, axis=0)                      # [E]

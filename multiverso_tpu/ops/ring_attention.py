@@ -26,9 +26,7 @@ import numpy as np
 from ..topology import SEQ_AXIS
 from .flash_attention import flash_attention_partial, merge_partials
 
-shard_map = getattr(jax, "shard_map", None)
-if shard_map is None:  # pragma: no cover — jax < 0.8
-    from jax.experimental.shard_map import shard_map
+from .._compat import shard_map
 
 from jax.sharding import PartitionSpec as P
 

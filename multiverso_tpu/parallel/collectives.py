@@ -30,9 +30,7 @@ from ..topology import WORKER_AXIS
 
 from jax.sharding import PartitionSpec as P
 
-shard_map = getattr(jax, "shard_map", None)
-if shard_map is None:  # pragma: no cover — jax < 0.8
-    from jax.experimental.shard_map import shard_map
+from .._compat import shard_map
 
 
 def _mesh(mesh=None):

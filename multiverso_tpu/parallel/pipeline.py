@@ -32,9 +32,7 @@ import numpy as np
 
 from jax.sharding import PartitionSpec as P
 
-shard_map = getattr(jax, "shard_map", None)
-if shard_map is None:  # pragma: no cover — jax < 0.8
-    from jax.experimental.shard_map import shard_map
+from .._compat import shard_map
 
 STAGE_AXIS = "stage"
 
@@ -79,6 +77,11 @@ def pipeline_apply(
     """
     n_stages = int(mesh.shape[axis])
     n_micro = int(xs.shape[0])
+    for leaf in jax.tree.leaves(params):
+        if np.shape(leaf)[0] != n_stages:
+            raise ValueError(
+                f"params leading dim {np.shape(leaf)[0]} != mesh axis "
+                f"{axis}={n_stages}; stack exactly one param set per stage")
     param_spec = jax.tree.map(
         lambda leaf: P(axis, *(None,) * (np.ndim(leaf) - 1)), params)
 

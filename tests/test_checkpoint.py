@@ -56,3 +56,53 @@ def test_restore_type_mismatch_fatal(mv_session, tmp_path):
         json.dump(manifest, f)
     with pytest.raises(FatalError):
         checkpoint.restore(ckpt_dir)
+
+
+def test_autosaver_periodic_and_retention(mv_session, tmp_path):
+    import numpy as np
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+
+    arr = mv.create_table("array", 8)
+    root = str(tmp_path / "auto")
+    saver = checkpoint.Autosaver(root, every_steps=2, keep=2)
+
+    saved = []
+    for step in range(1, 9):
+        arr.add(np.ones(8, np.float32))
+        if saver.step(step):
+            saved.append(step)
+    assert saved == [2, 4, 6, 8]
+    # retention: only the `keep` newest survive
+    assert checkpoint.list_steps(root) == [6, 8]
+
+    # crash recovery: clobber the table, restore_latest resumes at step 8
+    arr.add(np.full(8, 100.0, np.float32))
+    step = checkpoint.restore_latest(root)
+    assert step == 8
+    np.testing.assert_allclose(arr.get(), np.full(8, 8.0))
+
+
+def test_restore_latest_fresh_start(mv_session, tmp_path):
+    from multiverso_tpu.io import checkpoint
+
+    assert checkpoint.restore_latest(str(tmp_path / "empty")) is None
+
+
+def test_autosaver_ignores_partial_tmp_dir(mv_session, tmp_path):
+    import numpy as np
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+
+    arr = mv.create_table("array", 4)
+    arr.add(np.ones(4, np.float32))
+    root = str(tmp_path / "auto")
+    saver = checkpoint.Autosaver(root, every_steps=1)
+    saver.step(1)
+    # a crashed mid-save leaves a .tmp dir; it must not be restorable
+    import os
+    os.makedirs(os.path.join(root, "step_99.tmp"), exist_ok=True)
+    assert checkpoint.list_steps(root) == [1]
+    assert checkpoint.restore_latest(root) == 1

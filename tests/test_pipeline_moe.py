@@ -155,3 +155,23 @@ class TestMoE:
         assert all(np.isfinite(np.asarray(l)).all()
                    for l in jax.tree.leaves(g_ep))
         assert np.isfinite(np.asarray(g_rw)).all()
+
+
+def test_pipeline_rejects_stage_count_mismatch():
+    mesh = make_pipeline_mesh(4)
+    rng = np.random.default_rng(6)
+    params = _make_stage_params(rng, 8, 4)   # 8 stacked stages, 4-stage mesh
+    xs = microbatch(jnp.asarray(rng.standard_normal((4, 4)), jnp.float32), 2)
+    with pytest.raises(ValueError, match="leading dim"):
+        pipeline_apply(_stage_fn, params, xs, mesh)
+
+
+def test_gating_positions_exact_in_bf16():
+    """Slot counters must stay int32: bf16 cumsum corrupts them past 256."""
+    n_tokens = 400
+    logits = jnp.zeros((n_tokens, 2), jnp.bfloat16).at[:, 0].set(10.0)
+    dispatch, _, _ = top1_gating(logits, capacity=n_tokens)
+    d = np.asarray(dispatch, np.float32)
+    assert d.sum() == n_tokens                      # nobody dropped
+    slots = d[:, 0].argmax(-1)
+    assert len(set(slots.tolist())) == n_tokens     # all slots distinct
