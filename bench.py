@@ -50,9 +50,12 @@ def main() -> int:
     # TPU-native settings: bf16 embedding tables (f32 grad accumulation in
     # the step) and 2.5x candidate oversampling so the window/subsample
     # rejection tests don't waste gather/scatter slots.
+    # larger per-dispatch batch + pre-drawn negative pool (contiguous-slice
+    # draws instead of random gathers) measured ~14% over batch 32768 with
+    # per-draw alias sampling on a single v5e chip
     cfg = Word2VecConfig(vocab_size=dictionary.vocab_size, embedding_size=256,
-                         window=5, negative=5, init_lr=0.025, batch_size=32768,
-                         oversample=2.5)
+                         window=5, negative=5, init_lr=0.025, batch_size=65536,
+                         oversample=2.5, neg_pool_size=1 << 22)
     import jax.numpy as jnp
     w_in = mv.create_table("matrix", dictionary.vocab_size, cfg.embedding_size,
                            init_value="random", dtype=jnp.bfloat16)

@@ -156,6 +156,11 @@ class Autosaver:
     def save_now(self, step: int) -> None:
         with self._lock:
             sess = self._session or Session.get()
+            if self._every_seconds > 0 and sess.size > 1:
+                # re-checked here: the session may not have been started
+                # when __init__ ran (lazy resolution)
+                Log.fatal("Autosaver: every_seconds is rank-local and "
+                          "unsafe in multi-process runs — use every_steps")
             final = os.path.join(self._root, f"step_{step}")
             tmp = final + ".tmp"
             if os.path.isdir(tmp):

@@ -78,10 +78,12 @@ def pipeline_apply(
     n_stages = int(mesh.shape[axis])
     n_micro = int(xs.shape[0])
     for leaf in jax.tree.leaves(params):
-        if np.shape(leaf)[0] != n_stages:
+        if np.ndim(leaf) == 0 or np.shape(leaf)[0] != n_stages:
             raise ValueError(
-                f"params leading dim {np.shape(leaf)[0]} != mesh axis "
-                f"{axis}={n_stages}; stack exactly one param set per stage")
+                f"params leaf has leading dim "
+                f"{np.shape(leaf)[0] if np.ndim(leaf) else 'none (scalar)'} "
+                f"!= mesh axis {axis}={n_stages}; stack exactly one param "
+                f"set per stage")
     param_spec = jax.tree.map(
         lambda leaf: P(axis, *(None,) * (np.ndim(leaf) - 1)), params)
 

@@ -232,3 +232,62 @@ def test_word2vec_sharded_tables(mv_session, tmp_path):
         assert result.words_trained > 0
     finally:
         mv.set_flag("mesh_shape", "")
+
+
+def test_negative_pool_distribution_and_slicing():
+    """Pool draws follow unigram^0.75 and slices differ across keys."""
+    import jax
+    import jax.numpy as jnp
+
+    from multiverso_tpu.models.word2vec import (build_negative_pool,
+                                                build_unigram_alias,
+                                                pool_negatives)
+
+    counts = np.array([100, 10, 1], np.float64)
+    thresh, alias = build_unigram_alias(counts)
+    pool = build_negative_pool(thresh, alias, 50000, seed=3)
+    freq = np.bincount(pool, minlength=3) / pool.size
+    expect = counts ** 0.75
+    expect /= expect.sum()
+    np.testing.assert_allclose(freq, expect, atol=0.02)
+
+    dev_pool = jnp.asarray(pool)
+    a = np.asarray(pool_negatives(jax.random.PRNGKey(0), dev_pool, (64, 5)))
+    b = np.asarray(pool_negatives(jax.random.PRNGKey(1), dev_pool, (64, 5)))
+    assert a.shape == (64, 5)
+    assert not np.array_equal(a, b)          # different offsets
+    assert set(np.unique(a)) <= {0, 1, 2}
+
+
+def test_train_device_steps_with_pool(tmp_path, mv_session):
+    """Fused corpus training with the pre-drawn pool stays finite and
+    counts pairs (the bench configuration's sampler path)."""
+    import jax.numpy as jnp
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.apps.wordembedding import (Dictionary, encode_corpus,
+                                                   subsample_probs)
+    from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
+
+    rng = np.random.default_rng(0)
+    lines = [" ".join(f"w{rng.integers(0, 20)}" for _ in range(30))
+             for _ in range(50)]
+    corpus = tmp_path / "c.txt"
+    corpus.write_text("\n".join(lines))
+    dictionary = Dictionary.build(str(corpus), min_count=1)
+    cfg = Word2VecConfig(vocab_size=dictionary.vocab_size, embedding_size=16,
+                         window=3, negative=3, batch_size=64,
+                         neg_pool_size=4096)
+    w_in = mv.create_table("matrix", dictionary.vocab_size, 16,
+                           init_value="random")
+    w_out = mv.create_table("matrix", dictionary.vocab_size, 16)
+    model = Word2Vec(cfg, w_in, w_out,
+                     counts=np.asarray(dictionary.counts, np.float64))
+    model.total_words = 10 ** 6
+    ids, sent_ids = encode_corpus(str(corpus), dictionary)
+    discard = subsample_probs(np.asarray(dictionary.counts, np.float64),
+                              1e-3).astype(np.float32)
+    model.load_corpus_chunk(ids, sent_ids, discard)
+    loss, count = model.train_device_steps(4)
+    assert np.isfinite(float(loss))
+    assert float(count) > 0
