@@ -147,6 +147,15 @@ class Autosaver:
         due = (self._every_steps > 0 and step > 0
                and step % self._every_steps == 0)
         if not due and self._every_seconds > 0:
+            sess = self._session or Session.get()
+            if sess.size > 1:
+                # checked here (not just __init__) because the session may
+                # start after construction; fails on the FIRST step, before
+                # rank-local clocks can disagree and deadlock the collective
+                # save. every_steps-triggered saves are deterministic and
+                # stay allowed.
+                Log.fatal("Autosaver: every_seconds is rank-local and "
+                          "unsafe in multi-process runs — use every_steps")
             due = time.monotonic() - self._last_time >= self._every_seconds
         if not due:
             return False
@@ -156,11 +165,6 @@ class Autosaver:
     def save_now(self, step: int) -> None:
         with self._lock:
             sess = self._session or Session.get()
-            if self._every_seconds > 0 and sess.size > 1:
-                # re-checked here: the session may not have been started
-                # when __init__ ran (lazy resolution)
-                Log.fatal("Autosaver: every_seconds is rank-local and "
-                          "unsafe in multi-process runs — use every_steps")
             final = os.path.join(self._root, f"step_{step}")
             tmp = final + ".tmp"
             if os.path.isdir(tmp):

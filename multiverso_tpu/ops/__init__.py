@@ -6,6 +6,7 @@ from .flash_attention import (flash_attention, flash_attention_partial,
 from .moe import (EXPERT_AXIS, init_moe_params, mlp_expert, moe_apply,
                   top1_gating)
 from .ring_attention import reference_attention, ring_attention
+from .ulysses import ulysses_attention
 
 __all__ = [
     "embedding_lookup",
@@ -21,4 +22,5 @@ __all__ = [
     "top1_gating",
     "reference_attention",
     "ring_attention",
+    "ulysses_attention",
 ]

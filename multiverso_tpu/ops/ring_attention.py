@@ -129,12 +129,16 @@ def ring_attention(
 
 def reference_attention(q, k, v, causal: bool = False,
                         scale: Optional[float] = None) -> jax.Array:
-    """Unsharded O(seq^2) attention — the correctness oracle for tests."""
+    """Unsharded O(seq^2) attention — the correctness oracle for tests, and
+    the local per-head computation of :func:`ops.ulysses_attention` (scores
+    and softmax accumulate in f32 regardless of input dtype)."""
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    scores = jnp.einsum("qhd,khd->hqk", q, k) * scale
+    scores = jnp.einsum("qhd,khd->hqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
         seq = q.shape[0]
         mask = jnp.tril(jnp.ones((seq, seq), bool))[None, :, :]
         scores = jnp.where(mask, scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("hqk,khd->qhd", probs, v)
+    return jnp.einsum("hqk,khd->qhd", probs.astype(v.dtype),
+                      v).astype(q.dtype)

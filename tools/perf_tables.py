@@ -6,12 +6,17 @@ matrix table, timed rounds of whole-table Get, %-sparse row Add, and Get
 again, printing per-op wall times and the Dashboard dump at the end.
 
 Usage:
-    python tools/perf_tables.py [dense|sparse] [-rows=1000000] [-cols=50]
-                                [-rounds=10] [-percent=1.0]
+    python tools/perf_tables.py [dense|sparse|device] [-rows=1000000]
+                                [-cols=50] [-rounds=10] [-percent=1.0]
 
 ``sparse`` adds only ``percent``%% of rows per round (the touched-row wire
-path); ``dense`` adds the whole table. Runs on whatever devices the process
-sees (one real TPU chip, or CPU with JAX_PLATFORMS=cpu).
+path); ``dense`` adds the whole table. Both move data host<->device every
+round, like the reference's user buffers. ``device`` times the jitted
+update/lookup programs on pre-staged device arrays — the table-update
+bandwidth the chip itself sustains, independent of the host link (on a
+tunneled/remote device the host path measures the tunnel, not the table).
+Runs on whatever devices the process sees (one real TPU chip, or CPU with
+JAX_PLATFORMS=cpu).
 """
 
 from __future__ import annotations
@@ -31,7 +36,7 @@ def main(argv) -> int:
     mode = "dense"
     args = []
     for a in argv[1:]:
-        if a in ("dense", "sparse"):
+        if a in ("dense", "sparse", "device"):
             mode = a
         else:
             args.append(a)
@@ -48,12 +53,15 @@ def main(argv) -> int:
 
     n_touch = max(1, int(rows * mv.get_flag("percent") / 100.0))
 
-    # warm up the jitted paths with the timed shapes (first compile is not
-    # the steady state; row ops bucket by id-set size, so warm with n_touch)
-    table.get()
+    # warm up the host-path jitted ops with the timed shapes (first compile
+    # is not the steady state; row ops bucket by id-set size, so warm with
+    # n_touch). The device mode warms its own programs inside pipelined()
+    # and must not pay host-link round trips here.
     if mode == "dense":
+        table.get()
         table.add(np.zeros((rows, cols), np.float32))
-    else:
+    elif mode == "sparse":
+        table.get()
         warm_ids = np.arange(n_touch, dtype=np.int32)
         table.add_rows(warm_ids, np.zeros((n_touch, cols), np.float32))
         table.get_rows(warm_ids)
@@ -72,6 +80,77 @@ def main(argv) -> int:
           f"mesh {dict(mv.session().mesh.shape)}")
 
     table_bytes = rows * cols * 4
+
+    if mode == "device":
+        import jax
+        import jax.numpy as jnp
+
+        from multiverso_tpu.tables import _rowops
+        from multiverso_tpu.tables.base import _option_scalars
+        from multiverso_tpu.updaters import AddOption
+
+        opt = _option_scalars(AddOption(), table.dtype)
+        delta_dev = jax.device_put(
+            rng.standard_normal((rows, cols)).astype(np.float32),
+            table.sharding)
+
+        def dev_add():
+            table._data, table._ustate = table._apply_fn(
+                table._data, table._ustate, delta_dev, *opt)
+
+        ids = rng.choice(rows, size=n_touch, replace=False).astype(np.int32)
+        size = _rowops.bucket_size(n_touch)
+        padded_ids, rmask = _rowops.pad_ids(ids, n_touch, size)
+        padded_vals = _rowops.pad_values(
+            rng.standard_normal((n_touch, cols)).astype(np.float32),
+            n_touch, size)
+        ids_dev = jnp.asarray(padded_ids)
+        vals_dev = jnp.asarray(padded_vals)
+        mask_dev = jnp.asarray(rmask)
+
+        def dev_add_rows():
+            table._data, table._ustate = table._row_apply(
+                table._data, table._ustate, ids_dev, vals_dev, mask_dev,
+                *opt)
+
+        last_gather = [None]
+
+        def dev_get_rows():
+            last_gather[0] = table._row_gather(table._data, ids_dev)
+
+        def drain():
+            """Force the queued chain: fetch a scalar that depends on the
+            final state (block_until_ready alone can return before a
+            remote/tunneled device has drained its dispatch queue)."""
+            src = (last_gather[0] if last_gather[0] is not None
+                   else table._data)
+            return float(jnp.sum(src[0]))
+
+        def pipelined(label, fn, op_bytes):
+            """Queue ``rounds`` dispatches, sync once: measures device
+            throughput with per-dispatch latency amortised (a remote/
+            tunneled device adds ~100ms per synchronous round trip)."""
+            fn()                         # compile
+            drain()
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                fn()
+            drain()
+            dt = (time.perf_counter() - t0) / rounds
+            print(f"{label:34s} {dt * 1e3:10.2f} ms/round "
+                  f"({op_bytes / 1e6 / dt:.0f} MB/s)")
+
+        touched_bytes = n_touch * cols * 4
+        print(f"touched rows per row-op: {n_touch}")
+        pipelined("device add (whole table)", dev_add, table_bytes)
+        pipelined(f"device add_rows ({mv.get_flag('percent')}% rows)",
+                  dev_add_rows, touched_bytes)
+        pipelined(f"device get_rows ({mv.get_flag('percent')}% rows)",
+                  dev_get_rows, touched_bytes)
+        Dashboard.display()
+        mv.shutdown()
+        return 0
+
     timed("get (whole table)", table.get, table_bytes)
 
     if mode == "dense":
