@@ -1,5 +1,7 @@
 """JAX/Flax parameter synchronisation (modern replacement for theano_ext)."""
 
-from .param_manager import MVNetParamManager, MVSharedArray
+from .param_manager import (MVNetParamManager, MVSharedArray, mv_shared,
+                            sync_all_mv_shared_vars)
 
-__all__ = ["MVNetParamManager", "MVSharedArray"]
+__all__ = ["MVNetParamManager", "MVSharedArray", "mv_shared",
+           "sync_all_mv_shared_vars"]

@@ -98,3 +98,23 @@ class MVSharedArray:
         self._last = self.tbh.get()
         self._value = self._last.reshape(self._shape).copy()
         return self._value
+
+
+# -- global registry (reference ``sharedvar.py:78-100``) ----------------------
+
+_all_mv_shared: list = []
+
+
+def mv_shared(value) -> MVSharedArray:
+    """Create an :class:`MVSharedArray` and register it for
+    :func:`sync_all_mv_shared_vars` (reference ``mv_shared``)."""
+    var = MVSharedArray(value)
+    _all_mv_shared.append(var)
+    return var
+
+
+def sync_all_mv_shared_vars() -> None:
+    """``mv_sync`` every registered shared array (reference
+    ``sync_all_mv_shared_vars``)."""
+    for var in _all_mv_shared:
+        var.mv_sync()

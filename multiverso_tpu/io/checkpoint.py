@@ -148,7 +148,7 @@ class Autosaver:
                and step % self._every_steps == 0)
         if not due and self._every_seconds > 0:
             sess = self._session or Session.get()
-            if sess.size > 1:
+            if sess.started and sess.size > 1:
                 # checked here (not just __init__) because the session may
                 # start after construction; fails on the FIRST step, before
                 # rank-local clocks can disagree and deadlock the collective

@@ -230,3 +230,24 @@ def test_torch_ext_param_manager(mv_session):
         np.testing.assert_allclose(after, before + 1.0, rtol=1e-5)
     finally:
         sys.path.remove(os.path.join(REPO, "binding", "python"))
+
+
+def test_jax_ext_shared_registry(mv_session):
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "binding", "python"))
+    try:
+        from multiverso.jax_ext import mv_shared, sync_all_mv_shared_vars
+        from multiverso.jax_ext import param_manager as pm
+
+        pm._all_mv_shared.clear()
+        a = mv_shared(np.zeros(4))
+        b = mv_shared(np.ones(2))
+        a.set_value(np.full(4, 2.0))
+        b.set_value(np.full(2, 5.0))
+        sync_all_mv_shared_vars()
+        np.testing.assert_allclose(a.get_value(), 2.0)
+        np.testing.assert_allclose(b.get_value(), 5.0)
+        pm._all_mv_shared.clear()
+    finally:
+        sys.path.remove(os.path.join(REPO, "binding", "python"))

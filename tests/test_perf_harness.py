@@ -1,0 +1,33 @@
+"""tools/perf_tables.py regression: all three modes run end-to-end.
+
+The device mode exercises table internals (`_apply_fn`/`_row_apply`/
+`_row_gather` staging); this test pins the harness so a table refactor
+cannot silently break it while the suite stays green.
+"""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(mode):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import sys, runpy; sys.argv = ['perf_tables', %r, '-rows=256', "
+        "'-cols=8', '-rounds=2', '-percent=5']; "
+        "runpy.run_path('tools/perf_tables.py', run_name='__main__')"
+        % mode
+    )
+    return subprocess.run([sys.executable, "-c", code], cwd=_REPO, env=env,
+                          capture_output=True, text=True, timeout=300)
+
+
+def test_all_modes_run():
+    for mode in ("dense", "sparse", "device"):
+        result = _run(mode)
+        assert result.returncode == 0, (mode, result.stderr[-2000:])
+        assert "ms/round" in result.stdout, (mode, result.stdout)
