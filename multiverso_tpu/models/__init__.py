@@ -1,6 +1,8 @@
-"""Model families: word2vec (skip-gram/CBOW) and logistic regression/FTRL."""
+"""Model families: word2vec (skip-gram/CBOW), logistic regression/FTRL,
+and the transformer LM parallelism showcase."""
 
 from .logreg import FTRLLogReg, LogReg, LogRegConfig, SparseLogReg
+from .transformer import TransformerConfig, TransformerLM
 from .word2vec import (HuffmanCodes, Word2Vec, Word2VecConfig,
                        build_huffman, build_unigram_alias)
 
@@ -9,6 +11,8 @@ __all__ = [
     "LogReg",
     "LogRegConfig",
     "SparseLogReg",
+    "TransformerConfig",
+    "TransformerLM",
     "HuffmanCodes",
     "Word2Vec",
     "Word2VecConfig",

@@ -1,0 +1,213 @@
+"""Transformer language model: the parallelism-showcase model family.
+
+The reference predates transformers (SURVEY §5.7) — its model families are
+word2vec and logistic regression, both reproduced in this package. This
+module is the framework's forward-looking flagship: a decoder-only LM whose
+training step composes the mesh axes the framework provides:
+
+* **dp** — the batch shards over the ``worker`` axis; the mean-loss gradient
+  becomes a ``psum`` over ICI (exactly the sync-PS contract,
+  ``parallel/sync_step.py``);
+* **tp** — attention/FFN weights shard over the ``server`` axis
+  (Megatron-style column/row splits expressed as ``NamedSharding``s; XLA
+  inserts the all-gathers/reduce-scatters);
+* layers are **stacked** on a leading dim and applied with ``lax.scan`` —
+  the same stacked layout ``parallel/pipeline.py`` consumes for pipeline
+  parallelism over a ``stage`` axis;
+* long-context attention is pluggable: the default local (full-sequence)
+  attention shares :func:`ops.reference_attention`'s math; for sequence
+  parallelism use :func:`ops.ring_attention` / :func:`ops.ulysses_attention`
+  over a ``seq`` axis mesh.
+
+Pre-LN, learned positions, tied input/output embeddings, SGD-with-momentum
+update inline in the jitted step (params never leave HBM; buffers donated).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..log import Log
+from ..topology import SERVER_AXIS, WORKER_AXIS
+
+
+@dataclass
+class TransformerConfig:
+    vocab_size: int
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq: int = 256
+    dtype: Any = jnp.float32
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    seed: int = 0
+
+
+def init_params(cfg: TransformerConfig,
+                rng: Optional[np.random.Generator] = None) -> Dict[str, Any]:
+    """Random parameter pytree; per-layer weights stacked on dim 0."""
+    rng = rng or np.random.default_rng(cfg.seed)
+    D, F, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    s = 1.0 / np.sqrt(D)
+    sf = 1.0 / np.sqrt(F)
+
+    def mk(shape, scale):
+        return jnp.asarray(rng.standard_normal(shape) * scale, cfg.dtype)
+
+    return {
+        "embed": mk((cfg.vocab_size, D), s),
+        "pos": mk((cfg.max_seq, D), 0.02),
+        "layers": {
+            "ln1_g": jnp.ones((L, D), cfg.dtype),
+            "ln2_g": jnp.ones((L, D), cfg.dtype),
+            # separate Q/K/V projections: a fused [D, 3D] column-sharded
+            # weight would put the Q/K/V split boundaries mid-shard for tp
+            # sizes not divisible by 3, forcing a reshard every layer
+            "w_q": mk((L, D, D), s),
+            "w_k": mk((L, D, D), s),
+            "w_v": mk((L, D, D), s),
+            "w_o": mk((L, D, D), s),
+            "w_ff1": mk((L, D, F), s),
+            "w_ff2": mk((L, F, D), sf),
+        },
+        "ln_f_g": jnp.ones((D,), cfg.dtype),
+    }
+
+
+def param_shardings(cfg: TransformerConfig, mesh,
+                    tp_axis: str = SERVER_AXIS) -> Dict[str, Any]:
+    """Tensor-parallel layout over ``tp_axis``.
+
+    Column-parallel ``w_qkv``/``w_ff1`` (output dim sharded), row-parallel
+    ``w_o``/``w_ff2`` (input dim sharded) — XLA propagates these into the
+    Megatron collective pattern. Embeddings shard by row like parameter
+    tables; norms replicate.
+    """
+    ns = lambda *spec: NamedSharding(mesh, P(*spec))
+    return {
+        "embed": ns(tp_axis, None),
+        "pos": ns(),
+        "layers": {
+            "ln1_g": ns(),
+            "ln2_g": ns(),
+            "w_q": ns(None, None, tp_axis),
+            "w_k": ns(None, None, tp_axis),
+            "w_v": ns(None, None, tp_axis),
+            "w_o": ns(None, tp_axis, None),
+            "w_ff1": ns(None, None, tp_axis),
+            "w_ff2": ns(None, tp_axis, None),
+        },
+        "ln_f_g": ns(),
+    }
+
+
+def _rmsnorm(x, g):
+    return x * jax.lax.rsqrt(
+        jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
+                 keepdims=True) + 1e-6).astype(x.dtype) * g
+
+
+def _attention(q, k, v, n_heads: int):
+    """Causal multi-head attention, [B, T, D] in/out.
+
+    The per-example computation IS :func:`ops.reference_attention` (vmapped
+    over batch) — one causal-attention implementation shared by the model,
+    the sequence-parallel ops, and the tests.
+    """
+    from ..ops.ring_attention import reference_attention
+
+    B, T, D = q.shape
+    dh = D // n_heads
+    split = lambda x: x.reshape(B, T, n_heads, dh)
+    out = jax.vmap(partial(reference_attention, causal=True))(
+        split(q), split(k), split(v))
+    return out.reshape(B, T, D)
+
+
+def forward(cfg: TransformerConfig, params: Dict[str, Any],
+            tokens: jax.Array) -> jax.Array:
+    """Logits [B, T, V] for token ids [B, T] (causal LM)."""
+    B, T = tokens.shape
+    h = jnp.take(params["embed"], tokens, axis=0) + params["pos"][:T]
+
+    def block(h, layer):
+        x = _rmsnorm(h, layer["ln1_g"])
+        h = h + _attention(x @ layer["w_q"], x @ layer["w_k"],
+                           x @ layer["w_v"], cfg.n_heads) @ layer["w_o"]
+        x = _rmsnorm(h, layer["ln2_g"])
+        h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+        return h, None
+
+    h, _ = jax.lax.scan(block, h, params["layers"])
+    h = _rmsnorm(h, params["ln_f_g"])
+    return jnp.einsum("btd,vd->btv", h, params["embed"],
+                      preferred_element_type=jnp.float32)
+
+
+def loss_fn(cfg: TransformerConfig, params: Dict[str, Any],
+            tokens: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy over [B, T] token ids."""
+    logits = forward(cfg, params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(
+        jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+
+class TransformerLM:
+    """Trainer over a (worker, server) mesh: dp batches, tp weights."""
+
+    def __init__(self, config: TransformerConfig, mesh=None,
+                 dp_axis: str = WORKER_AXIS, tp_axis: str = SERVER_AXIS):
+        from ..runtime import Session
+
+        self.config = config
+        self.mesh = mesh if mesh is not None else Session.get().mesh
+        if config.d_model % config.n_heads != 0:
+            Log.fatal("d_model must divide by n_heads")
+        self._shardings = param_shardings(config, self.mesh, tp_axis)
+        params = init_params(config)
+        self.params = jax.tree.map(jax.device_put, params, self._shardings)
+        self._momentum = jax.tree.map(
+            lambda p, s: jax.device_put(jnp.zeros_like(p), s),
+            self.params, self._shardings)
+        batch_sharding = NamedSharding(self.mesh, P(dp_axis, None))
+
+        cfg = config
+
+        def train_step(params, mom, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(cfg, p, tokens))(params)
+            mom = jax.tree.map(
+                lambda m, g: cfg.momentum * m + g.astype(m.dtype), mom, grads)
+            params = jax.tree.map(
+                lambda p, m: p - cfg.learning_rate * m.astype(p.dtype),
+                params, mom)
+            return params, mom, loss
+
+        self._step = jax.jit(
+            train_step,
+            in_shardings=(self._shardings, self._shardings, batch_sharding),
+            out_shardings=(self._shardings, self._shardings, None),
+            donate_argnums=(0, 1),
+        )
+
+    def train_batch(self, tokens: np.ndarray) -> jax.Array:
+        """One dp+tp step on [B, T] token ids; returns async scalar loss."""
+        self.params, self._momentum, loss = self._step(
+            self.params, self._momentum, jnp.asarray(tokens, jnp.int32))
+        return loss
+
+    def logits(self, tokens: np.ndarray) -> jax.Array:
+        return forward(self.config, self.params,
+                       jnp.asarray(tokens, jnp.int32))
