@@ -1,0 +1,9 @@
+// Standalone driver for the native self-tests, used by the sanitizer
+// builds (`make tsan` / `make asan`) — race/memory detection for the C++
+// runtime, which the reference never had (SURVEY §5.2: "no TSan/ASan build
+// configs").
+namespace mvtpu {
+int RunNativeTests();
+}
+
+int main() { return mvtpu::RunNativeTests(); }

@@ -68,6 +68,37 @@ class Dictionary:
             d.counts.append(count)
         return d
 
+    def save(self, path: str) -> None:
+        """Write ``word count`` lines (the ``mv_word_count`` tool's format,
+        reference ``WE/preprocess/word_count.cpp`` output consumed via
+        ``-read_vocab``)."""
+        with open(path, "w") as f:
+            for word, count in zip(self.words, self.counts):
+                f.write(f"{word} {count}\n")
+
+    @classmethod
+    def load(cls, path: str, min_count: int = 5) -> "Dictionary":
+        """Load a saved/preprocessed vocab file instead of re-counting the
+        corpus (reference ``-read_vocab``).
+
+        Note: a loaded dictionary has no native (C++) vocab handle, so
+        ``encode_corpus`` uses the Python encoder; ``Dictionary.build``
+        attaches the native tokeniser when the shared library is present.
+        """
+        d = cls(min_count)
+        with TextReader(path) as reader:
+            for line in reader:
+                parts = line.split()
+                if len(parts) != 2:
+                    continue
+                word, count = parts[0], int(parts[1])
+                if count < min_count:
+                    continue
+                d.word2id[word] = len(d.words)
+                d.words.append(word)
+                d.counts.append(count)
+        return d
+
     @property
     def vocab_size(self) -> int:
         return len(self.words)
@@ -430,18 +461,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     lr = opt("lr", 0.025, float)
     batch = opt("batch_size", 1024, int)
     adagrad = bool(opt("use_adagrad", 0, int))
+    read_vocab = opt("read_vocab", "")
+    save_vocab = opt("save_vocab", "")
     if not train_file:
         print("usage: wordembedding -train_file FILE [-output F] [-size N] "
               "[-window N] [-negative N] [-hs 0|1] [-cbow 0|1] [-epoch N] "
               "[-min_count N] [-sample F] [-lr F] [-batch_size N] "
-              "[-use_adagrad 0|1]")
+              "[-use_adagrad 0|1] [-read_vocab F] [-save_vocab F]")
         return 2
     mv.init(argv)
     cfg = Word2VecConfig(embedding_size=size, window=window, negative=negative,
                          hs=hs, cbow=cbow, init_lr=lr, batch_size=batch,
                          use_adagrad=adagrad)
+    dictionary = (Dictionary.load(read_vocab, min_count=min_count)
+                  if read_vocab else None)
+    if save_vocab:
+        if dictionary is None:
+            dictionary = Dictionary.build(train_file, min_count=min_count)
+        if mv.rank() == 0:   # same single-writer convention as save_embeddings
+            dictionary.save(save_vocab)
     train(train_file, output, cfg, epochs=epochs, min_count=min_count,
-          sample=sample)
+          sample=sample, dictionary=dictionary)
     mv.shutdown()
     return 0
 

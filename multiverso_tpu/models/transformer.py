@@ -88,10 +88,10 @@ def param_shardings(cfg: TransformerConfig, mesh,
                     tp_axis: str = SERVER_AXIS) -> Dict[str, Any]:
     """Tensor-parallel layout over ``tp_axis``.
 
-    Column-parallel ``w_qkv``/``w_ff1`` (output dim sharded), row-parallel
-    ``w_o``/``w_ff2`` (input dim sharded) — XLA propagates these into the
-    Megatron collective pattern. Embeddings shard by row like parameter
-    tables; norms replicate.
+    Column-parallel ``w_q``/``w_k``/``w_v``/``w_ff1`` (output dim sharded),
+    row-parallel ``w_o``/``w_ff2`` (input dim sharded) — XLA propagates
+    these into the Megatron collective pattern. Embeddings shard by row like
+    parameter tables; norms replicate.
     """
     ns = lambda *spec: NamedSharding(mesh, P(*spec))
     return {

@@ -291,3 +291,20 @@ def test_train_device_steps_with_pool(tmp_path, mv_session):
     loss, count = model.train_device_steps(4)
     assert np.isfinite(float(loss))
     assert float(count) > 0
+
+
+def test_dictionary_save_load_roundtrip(tmp_path):
+    from multiverso_tpu.apps.wordembedding import Dictionary
+
+    corpus = tmp_path / "c.txt"
+    corpus.write_text("a a a b b c\n" * 10)
+    d = Dictionary.build(str(corpus), min_count=1)
+    vocab_file = tmp_path / "vocab.txt"
+    d.save(str(vocab_file))
+    loaded = Dictionary.load(str(vocab_file), min_count=1)
+    assert loaded.words == d.words
+    assert loaded.counts == d.counts
+    assert loaded.word2id == d.word2id
+    # min_count filter applies at load (a=30, b=20, c=10)
+    filtered = Dictionary.load(str(vocab_file), min_count=25)
+    assert filtered.words == ["a"]
