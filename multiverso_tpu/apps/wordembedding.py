@@ -91,7 +91,10 @@ class Dictionary:
                 parts = line.split()
                 if len(parts) != 2:
                     continue
-                word, count = parts[0], int(parts[1])
+                try:
+                    word, count = parts[0], int(parts[1])
+                except ValueError:   # tolerate headers/foreign formats
+                    continue
                 if count < min_count:
                     continue
                 d.word2id[word] = len(d.words)

@@ -64,6 +64,27 @@ class Word2VecConfig:
     # (see build_negative_pool); the pool is grown to at least twice the
     # draws per fused call. 0 = exact per-draw alias sampling.
     neg_pool_size: int = 0
+    # group size G > 1 shares each K-negative draw across G consecutive
+    # pairs, cutting the dominant negative-row gather/scatter traffic by G
+    # (same objective in expectation; 0/1 = exact per-pair draws, the
+    # reference semantics). Requires batch_size % G == 0.
+    shared_negatives: int = 0
+    # normalize each row's summed batch gradient by the row's occurrence
+    # count before applying lr. The reference applies pairs SEQUENTIALLY
+    # (one lr-scaled update per pair); a batched scatter SUMS colliding
+    # pair grads, so hot (frequent) rows receive thousands-of-pairs-sized
+    # steps and TRAINING DIVERGES once batch_size is large relative to the
+    # vocabulary (e.g. 64k batch on a 5k vocab). Enable for large batches;
+    # off (reference-equivalent sum, matching sequential movement at small
+    # batch) by default.
+    row_mean_updates: bool = False
+    # with row_mean_updates: per-row update = mean-grad * min(count, cap).
+    # cap bounds how much a hot row can move per batch — rows with <= cap
+    # collisions keep the reference's sequential-sum movement exactly;
+    # hotter rows are clamped to cap pair-steps (the sigmoid saturation
+    # that self-limits the reference's sequential loop has no batched
+    # equivalent, so the cap plays that role). cap=1 -> pure mean.
+    row_update_cap: float = 8.0
 
 
 def build_unigram_alias(counts: np.ndarray, power: float = 0.75
@@ -162,6 +183,9 @@ class Word2Vec:
         if config.negative <= 0 and not config.hs:
             Log.fatal("word2vec needs an output objective: negative > 0 "
                       "and/or hs=True")
+        if (config.shared_negatives > 1
+                and config.batch_size % config.shared_negatives != 0):
+            Log.fatal("batch_size must divide by shared_negatives group")
         if config.negative > 0:
             if counts is None:
                 Log.fatal("negative sampling requires vocab counts")
@@ -247,27 +271,55 @@ class Word2Vec:
             # the MXU/HBM win; grads stay f32 until the scatter cast)
             grad_h = jnp.zeros(h.shape, jnp.float32)
             scatters = []
+            G = max(int(cfg.shared_negatives), 1)
             if cfg.negative > 0:
+                # ONE implementation for exact and group-shared sampling:
+                # G = 1 draws K negatives per pair (reference semantics);
+                # G > 1 shares each K-draw across G consecutive pairs,
+                # cutting the dominant [*, K, D] gather/scatter traffic by
+                # G (the step is HBM-bound on target rows — see bench; same
+                # objective in expectation).
+                B = h.shape[0]
                 if negs is None:
                     key, sub = jax.random.split(key)
                     negs = sample_negatives(sub, self._packed_alias,
-                                            (h.shape[0], cfg.negative))
-                targets = jnp.concatenate([target_word[:, None], negs], axis=1)
-                labels = jnp.concatenate(
-                    [jnp.ones_like(target_word[:, None], jnp.float32),
-                     jnp.zeros(negs.shape, jnp.float32)], axis=1)
-                u = jnp.take(w_out, targets, axis=0)             # [B, T, D]
-                scores = jnp.clip(
-                    jnp.einsum("bd,btd->bt", h, u,
+                                            (B // G, cfg.negative))
+                # positive pairs (always exact, per pair)
+                u_pos = jnp.take(w_out, target_word, axis=0)     # [B, D]
+                s_pos = jnp.clip(
+                    jnp.einsum("bd,bd->b", h, u_pos,
                                preferred_element_type=jnp.float32),
                     -30.0, 30.0)
-                g = (jax.nn.sigmoid(scores) - labels) * ex_mask[:, None]
-                pair_loss = jax.nn.softplus(scores) - labels * scores
-                loss = loss + (pair_loss.sum(1) * ex_mask).sum()
+                g_pos = (jax.nn.sigmoid(s_pos) - 1.0) * ex_mask
+                loss = loss + ((jax.nn.softplus(s_pos) - s_pos)
+                               * ex_mask).sum()
+                grad_h = grad_h + g_pos[:, None] * u_pos
+                scatters.append((target_word, g_pos[:, None] * h,
+                                 ex_mask))
+                # negatives: [B/G, K, D] rows (per-pair when G == 1)
+                u_neg = jnp.take(w_out, negs, axis=0)            # [B/G, K, D]
+                hg = h.reshape(B // G, G, D)
+                mg = ex_mask.reshape(B // G, G)
+                s_neg = jnp.clip(
+                    jnp.einsum("gbd,gkd->gbk", hg, u_neg,
+                               preferred_element_type=jnp.float32),
+                    -30.0, 30.0)
+                g_neg = jax.nn.sigmoid(s_neg) * mg[:, :, None]
+                loss = loss + (jax.nn.softplus(s_neg)
+                               * mg[:, :, None]).sum()
                 grad_h = grad_h + jnp.einsum(
-                    "bt,btd->bd", g, u, preferred_element_type=jnp.float32)
-                scatters.append((targets.reshape(-1),
-                                 (g[:, :, None] * h[:, None, :]).reshape(-1, D)))
+                    "gbk,gkd->gbd", g_neg, u_neg,
+                    preferred_element_type=jnp.float32).reshape(B, D)
+                # each negative slot's grad is summed over its group's valid
+                # pairs, so its occurrence weight is the valid-pair COUNT
+                # (a binary flag would under-divide hot rows by up to G)
+                occ_neg = jnp.broadcast_to(
+                    mg.sum(axis=1)[:, None],
+                    (B // G, cfg.negative)).reshape(-1)
+                scatters.append((negs.reshape(-1), jnp.einsum(
+                    "gbk,gbd->gkd", g_neg, hg,
+                    preferred_element_type=jnp.float32).reshape(-1, D),
+                    occ_neg))
             if cfg.hs:
                 nodes = jnp.take(self._paths, target_word, axis=0)   # [B, L]
                 codes = jnp.take(self._codes, target_word, axis=0)
@@ -284,19 +336,50 @@ class Word2Vec:
                 grad_h = grad_h + jnp.einsum(
                     "bl,bld->bd", g, u, preferred_element_type=jnp.float32)
                 scatters.append((nodes.reshape(-1),
-                                 (g[:, :, None] * h[:, None, :]).reshape(-1, D)))
+                                 (g[:, :, None] * h[:, None, :]).reshape(-1, D),
+                                 (pmask * ex_mask[:, None]).reshape(-1)))
             loss = loss / jnp.maximum(ex_mask.sum(), 1)
             return loss, grad_h, scatters, key
 
+        def _row_counts(sets):
+            """Per-row contribution counts summed over ALL scatter sets of
+            one table (a single joint count keeps the cap a per-table bound
+            — per-set counts would let a row move n_sets * cap pair-steps
+            when it appears in several sets, e.g. as positive target AND
+            shared negative)."""
+            counts = jnp.zeros((cfg.vocab_size,), jnp.float32)
+            for rows, occ in sets:
+                counts = counts.at[rows].add(occ, mode="drop")
+            return counts
+
+        def _row_scale(counts, rows, grads):
+            """Rescale a row's summed grads to ``mean * min(count, cap)``.
+
+            ``occ``/counts weight masked/padded slots as 0 (compaction's
+            row-0 filler doesn't dilute row 0; shared-negative slots carry
+            their group's valid-pair count). The counts pass is [N]+[V]-
+            sized — negligible next to the [N, D] grads themselves.
+            """
+            cap = max(float(cfg.row_update_cap), 1.0)
+            c = jnp.maximum(jnp.take(counts, rows, axis=0), 1.0)
+            return grads * (jnp.minimum(c, cap) / c)[:, None]
+
         def apply_updates(w_in, w_out, g_in, g_out, in_rows, in_grads,
-                          scatters, lr):
+                          in_occ, scatters, lr):
+            if cfg.row_mean_updates:
+                in_counts = _row_counts([(in_rows, in_occ)])
+                in_grads = _row_scale(in_counts, in_rows, in_grads)
+                out_counts = _row_counts(
+                    [(rows, occ) for rows, _, occ in scatters])
+                scatters = [(rows, _row_scale(out_counts, rows, grads), occ)
+                            for rows, grads, occ in scatters]
             if cfg.use_adagrad:
                 w_in, g_in = apply_adagrad(w_in, g_in, in_rows, in_grads, lr)
-                for rows, grads in scatters:
+                for rows, grads, _ in scatters:
                     w_out, g_out = apply_adagrad(w_out, g_out, rows, grads, lr)
             else:
                 w_in = apply_sgd(w_in, in_rows, in_grads, lr)
-                for rows, grads in scatters:
+                for rows, grads, _ in scatters:
                     w_out = apply_sgd(w_out, rows, grads, lr)
             return w_in, w_out, g_in, g_out
 
@@ -308,7 +391,8 @@ class Word2Vec:
                 loss, grad_h, scatters, key = objective_grads(
                     h, w_out, contexts, mask, key, negs)
                 w_in, w_out, g_in, g_out = apply_updates(
-                    w_in, w_out, g_in, g_out, centers, grad_h, scatters, lr)
+                    w_in, w_out, g_in, g_out, centers, grad_h, mask,
+                    scatters, lr)
                 return w_in, w_out, g_in, g_out, loss, key
         else:
             # CBOW: input = mean of context window rows; target = center word
@@ -326,7 +410,7 @@ class Word2Vec:
                             * (cmask / counts[:, None])[:, :, None])
                 w_in, w_out, g_in, g_out = apply_updates(
                     w_in, w_out, g_in, g_out, contexts.reshape(-1),
-                    in_grads.reshape(-1, D), scatters, lr)
+                    in_grads.reshape(-1, D), cmask.reshape(-1), scatters, lr)
                 return w_in, w_out, g_in, g_out, loss, key
 
         state_shardings = (emb_sharding, emb_sharding,
@@ -414,7 +498,9 @@ class Word2Vec:
         # M candidates per step (cheap int-only sampling may overdraw; the
         # row gather/scatter work is always on exactly B slots)
         S = n_steps
-        neg_pool = (self._ensure_neg_pool(S * B * cfg.negative)
+        G = max(int(cfg.shared_negatives), 1)
+        draws_per_call = S * (B // G) * cfg.negative
+        neg_pool = (self._ensure_neg_pool(draws_per_call)
                     if cfg.negative > 0 and cfg.neg_pool_size > 0 else None)
 
         def compact_one(ok, n_valid, *arrays):
@@ -467,12 +553,13 @@ class Word2Vec:
             negs = None
             if cfg.negative > 0:
                 key, kn = jax.random.split(key)
+                n_rows = B // G
                 if neg_pool is not None:
                     negs = pool_negatives(kn, neg_pool,
-                                          (S, B, cfg.negative))
+                                          (S, n_rows, cfg.negative))
                 else:
                     negs = sample_negatives(kn, self._packed_alias,
-                                            (S, B, cfg.negative))
+                                            (S, n_rows, cfg.negative))
 
             starts = (start0 + jnp.arange(S, dtype=jnp.int32) * M) % n
 

@@ -308,3 +308,72 @@ def test_dictionary_save_load_roundtrip(tmp_path):
     # min_count filter applies at load (a=30, b=20, c=10)
     filtered = Dictionary.load(str(vocab_file), min_count=25)
     assert filtered.words == ["a"]
+
+
+def test_row_mean_updates_stabilize_large_batch(mv_session):
+    """Summed scatter diverges when batch >> vocab; row-mean must not.
+
+    (The batched-sum failure mode: hot rows receive thousands of summed
+    pair grads at full lr — the reference never hits it because it applies
+    pairs sequentially.)
+    """
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
+
+    rng = np.random.default_rng(0)
+    vocab, dim, B = 16, 8, 2048   # batch 128x vocab: heavy collisions
+
+    def run(row_mean):
+        cfg = Word2VecConfig(vocab_size=vocab, embedding_size=dim,
+                             negative=3, batch_size=B,
+                             row_mean_updates=row_mean, seed=1)
+        w_in = mv.create_table("matrix", vocab, dim, init_value="random")
+        w_out = mv.create_table("matrix", vocab, dim)
+        model = Word2Vec(cfg, w_in, w_out, counts=np.ones(vocab))
+        loss = None
+        for _ in range(15):
+            loss = model.train_batch(
+                rng.integers(0, vocab, B).astype(np.int32),
+                rng.integers(0, vocab, B).astype(np.int32))
+        return float(loss)
+
+    stable = run(row_mean=True)
+    assert np.isfinite(stable) and stable < 10.0
+    unstable = run(row_mean=False)
+    assert not np.isfinite(unstable) or unstable > stable
+
+
+def test_shared_negatives_converges(mv_session):
+    """Group-shared negatives trains the same structure as exact draws."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
+
+    rng = np.random.default_rng(2)
+    vocab, dim, B = 32, 16, 256
+    cfg = Word2VecConfig(vocab_size=vocab, embedding_size=dim, negative=4,
+                         batch_size=B, shared_negatives=8,
+                         row_mean_updates=True, init_lr=0.1)
+    w_in = mv.create_table("matrix", vocab, dim, init_value="random")
+    w_out = mv.create_table("matrix", vocab, dim)
+    model = Word2Vec(cfg, w_in, w_out, counts=np.ones(vocab))
+    # pairs always (i, i+1 mod half): structure the model can learn
+    centers = (np.arange(B) % (vocab // 2)).astype(np.int32)
+    contexts = ((centers + 1) % (vocab // 2)).astype(np.int32)
+    first = float(model.train_batch(centers, contexts))
+    for _ in range(60):
+        last = float(model.train_batch(centers, contexts))
+    assert np.isfinite(last)
+    assert last < first * 0.8, (first, last)
+
+
+def test_shared_negatives_batch_divisibility(mv_session):
+    import multiverso_tpu as mv
+    from multiverso_tpu.log import FatalError
+    from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
+
+    cfg = Word2VecConfig(vocab_size=8, embedding_size=4, negative=2,
+                         batch_size=10, shared_negatives=4)
+    w_in = mv.create_table("matrix", 8, 4)
+    w_out = mv.create_table("matrix", 8, 4)
+    with pytest.raises(FatalError):
+        Word2Vec(cfg, w_in, w_out, counts=np.ones(8))
