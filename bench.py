@@ -41,6 +41,14 @@ def main() -> int:
                                                    subsample_probs)
     from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
 
+    # default = exact per-pair negative draws (reference semantics).
+    # `python bench.py -shared_negatives=8` reproduces the ~2x faster
+    # group-shared sampling mode documented in the README.
+    shared_neg = 0
+    for arg in sys.argv[1:]:
+        if arg.startswith("-shared_negatives="):
+            shared_neg = int(arg.split("=", 1)[1])
+
     corpus = "/tmp/mv_bench_corpus.txt"
     if not os.path.exists(corpus):
         make_corpus(corpus)
@@ -58,7 +66,7 @@ def main() -> int:
     cfg = Word2VecConfig(vocab_size=dictionary.vocab_size, embedding_size=256,
                          window=5, negative=5, init_lr=0.025, batch_size=65536,
                          oversample=2.5, neg_pool_size=1 << 22,
-                         row_mean_updates=True)
+                         row_mean_updates=True, shared_negatives=shared_neg)
     import jax.numpy as jnp
     w_in = mv.create_table("matrix", dictionary.vocab_size, cfg.embedding_size,
                            init_value="random", dtype=jnp.bfloat16)

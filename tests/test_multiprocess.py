@@ -1,0 +1,89 @@
+"""Real multi-process integration driver (SURVEY §4: "a small set of real
+multi-host drivers" alongside the single-process virtual-mesh tests).
+
+Launches two actual OS processes that join one JAX coordination service
+over localhost (the MV_COORDINATOR_ADDRESS control plane that replaces
+MPI_Init + rank-0 registration) and checks the cross-process contracts:
+
+* topology: both ranks agree on size and see each other;
+* barrier: rendezvous completes;
+* aggregate (model averaging): psum across processes;
+* sync table adds: the SyncServer invariant value == sum over workers.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    sys.path.insert(0, %r)
+    import multiverso_tpu as mv
+
+    rank = int(os.environ["MV_PROCESS_ID"])
+    mv.init(["worker", "-sync=true"])
+    assert mv.size() == 2, mv.size()
+    assert mv.rank() == rank, (mv.rank(), rank)
+    mv.barrier()
+
+    # model averaging: psum over DCN/ICI (MV_Aggregate)
+    agg = mv.aggregate(np.full(4, float(rank + 1), np.float32))
+    assert np.allclose(agg, 3.0), agg          # 1 + 2
+
+    # sync-mode whole-table add: every replica folds every worker's delta
+    t = mv.create_table("array", 16)
+    t.add(np.full(16, float(rank + 1), np.float32))
+    got = t.get()
+    assert np.allclose(got, 3.0), got          # SyncServer invariant
+
+    mv.barrier()
+    mv.shutdown()
+    print(f"RANK{rank}_OK", flush=True)
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_sync_contracts(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER % _REPO)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "MV_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "MV_NUM_PROCESSES": "2",
+            "MV_PROCESS_ID": str(rank),
+            # one CPU device per process keeps the mesh worker=2, server=1
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"rank {rank} timed out (coordination stalled)")
+        outs.append(out)
+    for rank, (proc, out) in enumerate(zip(procs, outs)):
+        assert proc.returncode == 0, f"rank {rank}:\n{out[-3000:]}"
+        assert f"RANK{rank}_OK" in out
