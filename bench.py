@@ -42,18 +42,18 @@ def main() -> int:
     from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
 
     # default = exact per-pair negative draws (reference semantics).
-    # `python bench.py -shared_negatives=8` reproduces the ~2x faster
-    # group-shared sampling mode documented in the README.
-    shared_neg = 0
-    for arg in sys.argv[1:]:
-        if arg.startswith("-shared_negatives="):
-            shared_neg = int(arg.split("=", 1)[1])
+    # `python bench.py -shared_negatives=8` reproduces the faster
+    # group-shared sampling mode documented in the README (parsed by the
+    # framework's own flag registry, like every other option).
+    mv.define_int("shared_negatives", 0,
+                  "share each K-negative draw across G consecutive pairs")
 
     corpus = "/tmp/mv_bench_corpus.txt"
     if not os.path.exists(corpus):
         make_corpus(corpus)
 
-    mv.init(["bench", "-log_level=error"])
+    mv.init(["bench", "-log_level=error"] + sys.argv[1:])
+    shared_neg = mv.get_flag("shared_negatives")
     dictionary = Dictionary.build(corpus, min_count=1)
     # TPU-native settings: bf16 embedding tables (f32 grad accumulation in
     # the step) and 2.5x candidate oversampling so the window/subsample

@@ -19,7 +19,7 @@ from typing import Any, List, Optional, Sequence
 from . import config
 from .config import (define_bool, define_float, define_int, define_string,
                      get_flag, parse_cmd_flags, set_flag)
-from .dashboard import Dashboard, Monitor, Timer, monitor
+from .dashboard import Dashboard, Monitor, Timer, monitor, profile_trace
 from .log import Log, LogLevel, check, check_notnull
 from .quantization import SparseFilter
 from .runtime import Session

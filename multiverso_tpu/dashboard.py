@@ -156,3 +156,25 @@ def monitored_block_until_ready(name: str, value: Any) -> Any:
     jax.block_until_ready(value)
     mon.end()
     return value
+
+
+@contextmanager
+def profile_trace(log_dir: str, name: str = "PROFILE") -> Iterator[Monitor]:
+    """Capture an XLA profiler trace for the enclosed span.
+
+    Observability tier above the reference's wall-clock Monitors (SURVEY
+    §5.5: "no tracing spans"): wraps ``jax.profiler`` so the span's device
+    timeline (HLO ops, HBM transfers, collective phases) lands in
+    ``log_dir`` for TensorBoard/xprof, while a Dashboard monitor records
+    the same span's wall time alongside the other counters.
+    """
+    import jax
+
+    mon = Dashboard.get_or_create(name)
+    mon.begin()
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield mon
+    finally:
+        jax.profiler.stop_trace()
+        mon.end()

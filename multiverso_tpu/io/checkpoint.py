@@ -8,9 +8,11 @@ exists: ``save``/``restore`` walk the session's table registry and write one
 binary record per table plus a JSON manifest. Rank 0 writes; every process
 restores (single-controller JAX reloads give every process the same state).
 
-For large-model checkpointing with per-shard parallel IO, use orbax directly
-on the tables' ``.array`` views; this module is the framework-native
-lightweight path matching reference semantics.
+For large-model checkpointing with per-shard parallel IO, use
+:func:`save_orbax`/:func:`restore_orbax` below (orbax-backed, with the same
+manifest/type checks and a stream fallback for non-array tables);
+``save``/``restore`` are the framework-native lightweight path matching
+reference semantics.
 """
 
 from __future__ import annotations
@@ -79,6 +81,98 @@ def restore(directory: str, session: Optional[Session] = None) -> None:
         with open_stream(os.path.join(directory, entry["file"]), "rb") as stream:
             table.load(stream)
     Log.info("checkpoint restored: %d table(s) <- %s", len(sess.tables), directory)
+
+
+def save_orbax(directory: str, session: Optional[Session] = None) -> None:
+    """Orbax-backed checkpoint: per-shard parallel IO for array tables.
+
+    The native :func:`save` funnels every table through a rank-0 host
+    buffer; this path hands the HBM-resident sharded ``jax.Array``s to
+    orbax's ``StandardCheckpointer`` (each host writes its own shards —
+    the right tool once tables stop fitting one host). Non-array tables
+    (KV) fall back to their ``Serializable`` stream records inside the
+    same directory.
+    """
+    import orbax.checkpoint as ocp
+
+    sess = session or Session.get()
+    if not sess.started:
+        Log.fatal("save_orbax() requires an initialised session")
+    directory = os.path.abspath(directory)
+    sess.barrier()
+    arrays = {}
+    manifest = {"version": 1, "format": "orbax", "tables": []}
+    for table in sess.tables:
+        entry = {"id": table.table_id, "type": type(table).__name__,
+                 "name": getattr(table, "name", "")}
+        if getattr(table, "array", None) is not None:
+            arrays[f"table_{table.table_id}"] = table.array
+            entry["storage"] = "orbax"
+        else:
+            path = os.path.join(directory, f"table_{table.table_id}.bin")
+            if sess.rank == 0:
+                os.makedirs(directory, exist_ok=True)
+                with open_stream(path, "wb") as stream:
+                    table.store(stream)
+            entry["storage"] = "stream"
+            entry["file"] = os.path.basename(path)
+        manifest["tables"].append(entry)
+    if arrays:   # orbax rejects empty items (all-KV sessions have none)
+        with ocp.StandardCheckpointer() as checkpointer:
+            checkpointer.save(os.path.join(directory, "arrays"), arrays,
+                              force=True)
+            checkpointer.wait_until_finished()
+    if sess.rank == 0:
+        with open(os.path.join(directory, _MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=2)
+    sess.barrier()
+    Log.info("orbax checkpoint saved: %d table(s) -> %s",
+             len(sess.tables), directory)
+
+
+def restore_orbax(directory: str, session: Optional[Session] = None) -> None:
+    """Restore a :func:`save_orbax` checkpoint (sharded in-place reads)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    sess = session or Session.get()
+    if not sess.started:
+        Log.fatal("restore_orbax() requires an initialised session")
+    directory = os.path.abspath(directory)
+    manifest_path = os.path.join(directory, _MANIFEST)
+    if not os.path.exists(manifest_path):
+        Log.fatal(f"no checkpoint manifest at {manifest_path}")
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    by_id = {entry["id"]: entry for entry in manifest["tables"]}
+    targets = {}
+    array_tables = {}
+    for table in sess.tables:
+        entry = by_id.get(table.table_id)
+        if entry is None:
+            Log.fatal(f"checkpoint missing table id {table.table_id}")
+        if entry["type"] != type(table).__name__:
+            Log.fatal(
+                f"checkpoint table {table.table_id} is {entry['type']}, "
+                f"session has {type(table).__name__}")
+        if entry.get("storage") == "orbax":
+            key = f"table_{table.table_id}"
+            targets[key] = jax.ShapeDtypeStruct(
+                table.array.shape, table.array.dtype,
+                sharding=table.array.sharding)
+            array_tables[key] = table
+        else:
+            with open_stream(os.path.join(directory, entry["file"]),
+                             "rb") as stream:
+                table.load(stream)
+    if targets:
+        with ocp.StandardCheckpointer() as checkpointer:
+            restored = checkpointer.restore(
+                os.path.join(directory, "arrays"), targets)
+        for key, value in restored.items():
+            array_tables[key].set_array(value)
+    Log.info("orbax checkpoint restored: %d table(s) <- %s",
+             len(sess.tables), directory)
 
 
 def list_steps(root: str) -> List[int]:

@@ -106,3 +106,33 @@ def test_autosaver_ignores_partial_tmp_dir(mv_session, tmp_path):
     os.makedirs(os.path.join(root, "step_99.tmp"), exist_ok=True)
     assert checkpoint.list_steps(root) == [1]
     assert checkpoint.restore_latest(root) == 1
+
+
+def test_orbax_save_restore_roundtrip(mv_session, tmp_path):
+    import numpy as np
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+
+    arr = mv.create_table("array", 64)
+    mat = mv.create_table("matrix", 16, 8)
+    kv = mv.create_table("kv")
+    arr.add(np.full(64, 3.0, np.float32))
+    mat.add_rows([2, 5], np.ones((2, 8), np.float32))
+    kv.add([11], [2.5])
+
+    ckpt = str(tmp_path / "orbax_ckpt")
+    checkpoint.save_orbax(ckpt)
+
+    arr.add(np.ones(64, np.float32))
+    mat.add(np.ones((16, 8), np.float32))
+    kv.add([11], [40.0])
+
+    checkpoint.restore_orbax(ckpt)
+    np.testing.assert_allclose(arr.get(), 3.0)
+    expect = np.zeros((16, 8), np.float32)
+    expect[[2, 5]] = 1.0
+    np.testing.assert_allclose(mat.get(), expect)
+    assert kv.get([11]) == [2.5]
+    # restored arrays keep their sharding
+    assert mat.array.sharding == mat.sharding

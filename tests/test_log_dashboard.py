@@ -65,3 +65,20 @@ def test_monitor_context_manager_and_display():
     text = Dashboard.display(emit=lambda *a: None)
     assert "span_test" in text
     assert Dashboard.watch("missing") == "[missing] not monitored"
+
+
+def test_profile_trace_writes_xplane(tmp_path):
+    import os
+
+    import jax.numpy as jnp
+
+    from multiverso_tpu.dashboard import Dashboard, profile_trace
+
+    logdir = str(tmp_path / "trace")
+    with profile_trace(logdir, name="PROF_SPAN"):
+        jnp.ones((64, 64)).sum().block_until_ready()
+    found = []
+    for root, _, files in os.walk(logdir):
+        found.extend(files)
+    assert found, "profiler trace produced no files"
+    assert "PROF_SPAN" in Dashboard.display()
