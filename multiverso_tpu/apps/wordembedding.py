@@ -23,7 +23,7 @@ import sys
 import time
 from collections import Counter
 from dataclasses import dataclass
-from typing import Iterator, List, Optional, Tuple
+from typing import Any, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -317,6 +317,9 @@ class TrainResult:
     final_loss: float
 
 
+_DEVICE_CORPUS_MAX_TOKENS = 1 << 27   # 128M tokens ≈ 1 GB of ids in HBM
+
+
 def train(
     corpus_path: str,
     output_path: Optional[str] = None,
@@ -326,9 +329,18 @@ def train(
     sample: float = 1e-3,
     dictionary: Optional[Dictionary] = None,
     log_every: int = 200,
+    device_corpus: Optional[bool] = None,
+    table_dtype: Optional[Any] = None,
 ) -> TrainResult:
     """Full training driver (reference ``TrainNeuralNetwork``,
-    ``distributed_wordembedding.cpp:146``)."""
+    ``distributed_wordembedding.cpp:146``).
+
+    ``device_corpus`` selects the fast path: upload the encoded corpus to
+    HBM once and sample + train entirely on device (``train_device_steps``
+    — the mode ``bench.py`` measures). Default (None) auto-enables it when
+    the corpus fits the HBM budget; False streams host-generated pair
+    batches (unbounded corpus size, the reference's loader-thread shape).
+    """
     import multiverso_tpu as mv
 
     cfg = cfg or Word2VecConfig()
@@ -344,11 +356,13 @@ def train(
 
     # The same two tables the reference allocates (WE/src/communicator.cpp:17-33);
     # AdaGrad G state lives model-side when cfg.use_adagrad.
+    dtype_kw = {} if table_dtype is None else {"dtype": table_dtype}
     input_table = mv.create_table(
         "matrix", vocab, cfg.embedding_size, init_value="random",
-        seed=cfg.seed, name="word2vec_input")
+        seed=cfg.seed, name="word2vec_input", **dtype_kw)
     output_table = mv.create_table(
-        "matrix", vocab, cfg.embedding_size, name="word2vec_output")
+        "matrix", vocab, cfg.embedding_size, name="word2vec_output",
+        **dtype_kw)
     # word-count bookkeeping table (reference KV wordcount table)
     wordcount_table = mv.create_table("kv", name="word2vec_wordcount")
 
@@ -366,47 +380,112 @@ def train(
     loss = 0.0
     t0 = time.perf_counter()
     mon = Dashboard.get_or_create("W2V_TRAIN_BATCH")
-    group = max(1, cfg.steps_per_call)
-    from ..parallel import prefetch_iterator
 
-    for epoch in range(epochs):
-        progress = {"words": 0}
-        # loader-thread overlap: batch generation runs ahead on a bg thread
-        batches = prefetch_iterator(
-            iter_pair_batches(corpus_path, dictionary, cfg.window,
-                              cfg.batch_size, sample=sample, cbow=cfg.cbow,
-                              seed=cfg.seed + epoch, progress=progress),
-            depth=2 * group)
-        pending = []
-        for step_idx, batch in enumerate(batches):
-            pending.append(batch)
-            if len(pending) < group:
-                continue
-            mon.begin()
-            if group == 1:
-                loss = model.train_batch(*pending[0])
-            else:
-                loss = model.train_batches(
-                    np.stack([b[0] for b in pending]),
-                    np.stack([b[1] for b in pending]),
-                    np.stack([b[2] for b in pending]))
-            pairs += sum(batch_examples(b[2]) for b in pending)
+    ids = sent_ids = None
+    if device_corpus is None or device_corpus:
+        ids, sent_ids = encode_corpus(corpus_path, dictionary)
+        n_enc = int(ids.shape[0])
+        # auto-enable when the corpus fits the HBM budget AND is big enough
+        # that the fast-path defaults pay off (the fused sampler also needs
+        # batch + 2*window positions per step); small corpora fall back to
+        # host streaming, where per-batch dispatch cost doesn't matter
+        min_positions = cfg.batch_size + 2 * cfg.window + 2
+        if device_corpus is None:
+            device_corpus = (n_enc <= _DEVICE_CORPUS_MAX_TOKENS
+                             and n_enc >= max(min_positions, 1 << 16))
+        elif n_enc < min_positions:
+            Log.fatal(f"device_corpus needs at least batch_size + 2*window "
+                      f"positions; corpus has {n_enc}")
+
+    if device_corpus:
+        # -- device-resident fast path: corpus in HBM, sampling + training
+        #    fused into multi-step dispatches --------------------------------
+        # fast-path defaults: fuse many steps per dispatch and oversample
+        # candidates unless the caller chose otherwise (cfg is read lazily
+        # by the fused builder, so this runs before any compilation)
+        if cfg.steps_per_call <= 1:
+            cfg.steps_per_call = 32
+        if cfg.oversample <= 1:
+            cfg.oversample = 2.5
+        discard = subsample_probs(counts, sample).astype(np.float32)
+        model.load_corpus_chunk(ids, sent_ids, discard)
+        n = int(ids.shape[0])
+        spc = cfg.steps_per_call
+        m_per_step = model._candidate_batch(n)
+        # The device sampler draws ONE (center, context) pair per corpus
+        # position per pass; the reference trains every word in the shrunk
+        # window (expected window+1 pairs per center,
+        # ``wordembedding.cpp:214``). Scale passes so one "epoch" trains
+        # the reference's pair count. CBOW is one example per center.
+        pair_factor = 1 if cfg.cbow else cfg.window + 1
+        calls_per_epoch = max(1, -(-(n * pair_factor) // (spc * m_per_step)))
+        for epoch in range(epochs):
+            done = 0.0   # running pair count, synced once per log point
+            pending_counts = []
+            for call in range(calls_per_epoch):
+                mon.begin()
+                loss, count = model.train_device_steps(spc)
+                mon.end()
+                pending_counts.append(count)
+                if log_every and (call + 1) % log_every == 0:
+                    done += float(np.sum([float(c) for c in pending_counts]))
+                    pending_counts = []
+                    elapsed = time.perf_counter() - t0
+                    Log.info(
+                        "epoch %d call %d: %.0f pairs/sec, lr %.5f, "
+                        "loss %.4f", epoch, call + 1,
+                        (pairs + done) / elapsed, model.current_lr(),
+                        float(loss))
+            done += float(np.sum([float(c) for c in pending_counts]))
+            pairs += int(done)
+            wordcount_table.add([0], [dictionary.train_words])
+            mv.barrier()
+        mode = " [device corpus]"
+    else:
+        group = max(1, cfg.steps_per_call)
+        from ..parallel import prefetch_iterator
+
+        for epoch in range(epochs):
+            progress = {"words": 0}
+            # loader-thread overlap: batch generation runs ahead on a thread
+            batches = prefetch_iterator(
+                iter_pair_batches(corpus_path, dictionary, cfg.window,
+                                  cfg.batch_size, sample=sample,
+                                  cbow=cfg.cbow, seed=cfg.seed + epoch,
+                                  progress=progress),
+                depth=2 * group)
             pending = []
-            mon.end()
-            # exact lr-decay progress in word units (reference word_count)
-            model.set_words_trained(
-                epoch * dictionary.train_words + progress["words"])
-            if log_every and (step_idx + 1) % log_every == 0:
-                elapsed = time.perf_counter() - t0
-                Log.info(
-                    "epoch %d step %d: %.0f pairs/sec, lr %.5f, loss %.4f",
-                    epoch, step_idx + 1, pairs / elapsed, model.current_lr(),
-                    float(loss))
-        for centers, contexts, mask in pending:  # tail batches, one dispatch each
-            loss = model.train_batch(centers, contexts, mask)
-            pairs += batch_examples(mask)
-        wordcount_table.add([0], [dictionary.train_words])
-        mv.barrier()
+            for step_idx, batch in enumerate(batches):
+                pending.append(batch)
+                if len(pending) < group:
+                    continue
+                mon.begin()
+                if group == 1:
+                    loss = model.train_batch(*pending[0])
+                else:
+                    loss = model.train_batches(
+                        np.stack([b[0] for b in pending]),
+                        np.stack([b[1] for b in pending]),
+                        np.stack([b[2] for b in pending]))
+                pairs += sum(batch_examples(b[2]) for b in pending)
+                pending = []
+                mon.end()
+                # exact lr-decay progress in word units (reference word_count)
+                model.set_words_trained(
+                    epoch * dictionary.train_words + progress["words"])
+                if log_every and (step_idx + 1) % log_every == 0:
+                    elapsed = time.perf_counter() - t0
+                    Log.info(
+                        "epoch %d step %d: %.0f pairs/sec, lr %.5f, "
+                        "loss %.4f", epoch, step_idx + 1, pairs / elapsed,
+                        model.current_lr(), float(loss))
+            for centers, contexts, mask in pending:  # tail, one dispatch each
+                loss = model.train_batch(centers, contexts, mask)
+                pairs += batch_examples(mask)
+            wordcount_table.add([0], [dictionary.train_words])
+            mv.barrier()
+        mode = ""
+
     final_loss = float(loss)
     elapsed = time.perf_counter() - t0
 
@@ -420,9 +499,10 @@ def train(
                          words_per_sec=words / max(elapsed, 1e-9),
                          pairs_per_sec=pairs / max(elapsed, 1e-9),
                          final_loss=final_loss)
-    Log.info("trained %d words (%d pairs) in %.1fs: %.0f words/sec, %.0f pairs/sec",
+    Log.info("trained %d words (%d pairs) in %.1fs: %.0f words/sec, "
+             "%.0f pairs/sec%s",
              words, pairs, result.elapsed_s, result.words_per_sec,
-             result.pairs_per_sec)
+             result.pairs_per_sec, mode)
     return result
 
 
@@ -430,6 +510,9 @@ def save_embeddings(path: str, dictionary: Dictionary,
                     vectors: np.ndarray) -> None:
     """word2vec text format (reference SaveEmbedding,
     ``distributed_wordembedding.cpp:260-328``)."""
+    # bf16 table dumps come back as ml_dtypes scalars with no float
+    # formatting support; write f32 text regardless of table dtype
+    vectors = np.asarray(vectors, np.float32)
     with open(path, "w") as f:
         f.write(f"{dictionary.vocab_size} {vectors.shape[1]}\n")
         for i, word in enumerate(dictionary.words):
@@ -466,6 +549,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     adagrad = bool(opt("use_adagrad", 0, int))
     read_vocab = opt("read_vocab", "")
     save_vocab = opt("save_vocab", "")
+    device_corpus = opt("device_corpus", -1, int)  # -1 auto, 0 off, 1 on
+    # fast-path knobs. steps_per_call / oversample default to the device
+    # path's tuned values INSIDE train() (the host streaming path keeps its
+    # reference-shaped defaults); -1 = unset
+    steps_per_call = opt("steps_per_call", -1, int)
+    oversample = opt("oversample", -1.0, float)
+    neg_pool = opt("neg_pool", 1 << 22, int)
+    row_mean = bool(opt("row_mean", 1, int))
+    shared_negatives = opt("shared_negatives", 0, int)
+    bf16 = bool(opt("bf16", 0, int))
     if not train_file:
         print("usage: wordembedding -train_file FILE [-output F] [-size N] "
               "[-window N] [-negative N] [-hs 0|1] [-cbow 0|1] [-epoch N] "
@@ -475,7 +568,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     mv.init(argv)
     cfg = Word2VecConfig(embedding_size=size, window=window, negative=negative,
                          hs=hs, cbow=cbow, init_lr=lr, batch_size=batch,
-                         use_adagrad=adagrad)
+                         use_adagrad=adagrad,
+                         neg_pool_size=neg_pool, row_mean_updates=row_mean,
+                         shared_negatives=shared_negatives)
+    if steps_per_call > 0:
+        cfg.steps_per_call = steps_per_call
+    if oversample >= 0:
+        cfg.oversample = oversample
     dictionary = (Dictionary.load(read_vocab, min_count=min_count)
                   if read_vocab else None)
     if save_vocab:
@@ -483,8 +582,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             dictionary = Dictionary.build(train_file, min_count=min_count)
         if mv.rank() == 0:   # same single-writer convention as save_embeddings
             dictionary.save(save_vocab)
+    table_dtype = None
+    if bf16:
+        import jax.numpy as jnp
+
+        table_dtype = jnp.bfloat16
     train(train_file, output, cfg, epochs=epochs, min_count=min_count,
-          sample=sample, dictionary=dictionary)
+          sample=sample, dictionary=dictionary,
+          device_corpus=None if device_corpus < 0 else bool(device_corpus),
+          table_dtype=table_dtype)
     mv.shutdown()
     return 0
 
