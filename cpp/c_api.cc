@@ -351,6 +351,21 @@ SvmHandler MV_SvmParse(const char* path) {
   return data;
 }
 
+SvmHandler MV_BsparseParse(const char* path) {
+  auto* data = new mvtpu::SvmData();
+  bool ok = false;
+  try {
+    ok = mvtpu::ParseBsparse(path, data);
+  } catch (...) {   // never let an exception cross the C ABI into ctypes
+    ok = false;
+  }
+  if (!ok) {
+    delete data;
+    return nullptr;
+  }
+  return data;
+}
+
 long long MV_SvmNumSamples(SvmHandler svm) {
   return static_cast<long long>(
       static_cast<mvtpu::SvmData*>(svm)->labels.size());

@@ -112,6 +112,8 @@ DllExport void MV_BufferFree(void* ptr);
 
 typedef void* SvmHandler;
 DllExport SvmHandler MV_SvmParse(const char* path);
+/* Packed binary sparse records (LogReg bsparse format); same handle ABI. */
+DllExport SvmHandler MV_BsparseParse(const char* path);
 DllExport long long MV_SvmNumSamples(SvmHandler svm);
 DllExport long long MV_SvmNumEntries(SvmHandler svm);
 DllExport void MV_SvmCopy(SvmHandler svm, float* labels, int64_t* indptr,

@@ -5,6 +5,8 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "mvtpu/stream.h"
+
 namespace mvtpu {
 
 namespace {
@@ -153,6 +155,54 @@ bool ParseLibsvm(const std::string& path, SvmData* out) {
       }
     });
     if (any) out->indptr.push_back(static_cast<int64_t>(out->keys.size()));
+  }
+  return true;
+}
+
+bool ParseBsparse(const std::string& path, SvmData* out) {
+  // Record layout mirrors the Python writer (apps/lr_reader.write_bsparse)
+  // and the reference BSparseSampleReader::ParseSample
+  // (Applications/LogisticRegression/src/reader.cpp:382-444):
+  //   <u64 nkeys><i32 label><f64 weight> then nkeys little-endian i64 keys;
+  // the per-record scalar feature value is the weight.
+  auto stream = CreateStream(path, "r");
+  if (!stream) return false;
+  out->labels.clear();
+  out->indptr.assign(1, 0);
+  out->keys.clear();
+  out->values.clear();
+  struct Head {
+    uint64_t nkeys;
+    int32_t label;
+    double weight;
+  } __attribute__((packed));
+  Head head;
+  std::vector<int64_t> key_buf;
+  // Sanity bound on the per-record key count: a corrupt/misaligned file can
+  // decode garbage as nkeys; without the cap, resize() on an exabyte-sized
+  // request would throw across the C ABI (and nkeys * 8 could wrap size_t).
+  constexpr uint64_t kMaxKeysPerRecord = 1ull << 32;
+  for (;;) {
+    size_t got = stream->Read(&head, sizeof(head));
+    if (got == 0) break;                       // clean EOF at record boundary
+    if (got != sizeof(head)) return false;     // truncated header
+    if (head.nkeys > kMaxKeysPerRecord) return false;  // corrupt count
+    key_buf.resize(head.nkeys);
+    size_t want = head.nkeys * sizeof(int64_t);
+    if (want > 0 && stream->Read(key_buf.data(), want) != want) {
+      return false;                            // truncated keys
+    }
+    out->labels.push_back(static_cast<float>(head.label));
+    for (int64_t k : key_buf) {
+      if (k < INT32_MIN || k > INT32_MAX) {
+        // SvmData keys are i32; refuse to truncate silently — the caller
+        // falls back to the (i64-capable) Python reader.
+        return false;
+      }
+      out->keys.push_back(static_cast<int32_t>(k));
+      out->values.push_back(static_cast<float>(head.weight));
+    }
+    out->indptr.push_back(static_cast<int64_t>(out->keys.size()));
   }
   return true;
 }

@@ -58,6 +58,11 @@ struct SvmData {
 // "label k:v k:v ..." per line (value defaults to 1 when omitted).
 bool ParseLibsvm(const std::string& path, SvmData* out);
 
+// Packed binary sparse records (LogReg bsparse format,
+// LR/src/reader.cpp:382-444): <u64 nkeys><i32 label><f64 weight> + keys.
+// Returns false on open failure or a truncated record.
+bool ParseBsparse(const std::string& path, SvmData* out);
+
 }  // namespace mvtpu
 
 #endif  // MVTPU_READER_H_

@@ -28,6 +28,7 @@ reader variant and the test path share one convention.
 
 from __future__ import annotations
 
+import os
 import queue
 import struct
 import threading
@@ -42,6 +43,7 @@ from ..log import Log
 Sample = Tuple[float, np.ndarray, np.ndarray]
 
 _BSPARSE_HEAD = struct.Struct("<qid")  # nkeys, label, weight
+_NATIVE_BSPARSE_MAX = 512 << 20   # materialization cap for the C++ parser
 
 
 def _parse_features(parts: List[str], sparse: bool, input_size: int
@@ -138,8 +140,31 @@ def sample_iterator(reader_type: str, files: str, sparse: bool,
         if not sparse:
             Log.fatal("bsparse reader requires sparse=true "
                       "(LR/src/reader.cpp:296 LR_CHECK(sparse))")
+        from .. import native
+
         for path in paths:
-            yield from iter_bsparse(path)
+            # C++ record parser (cpp/mvtpu/reader.cc) for files small enough
+            # to materialize (it returns whole arrays; the Python reader
+            # streams in bounded chunks, so big files stay on it). Values
+            # round-trip through f32 on the native path (SvmData layout);
+            # keys >= 2^31 make the native parser refuse, falling back to
+            # the i64-capable Python reader.
+            use_native = (native.available()
+                          and os.path.getsize(path) <= _NATIVE_BSPARSE_MAX)
+            if use_native:
+                try:
+                    labels, indptr, keys, values = native.parse_bsparse(path)
+                except IOError:
+                    Log.debug("native bsparse parse refused %s; using the "
+                              "Python reader", path)
+                    use_native = False
+            if use_native:
+                for i in range(labels.shape[0]):
+                    lo, hi = int(indptr[i]), int(indptr[i + 1])
+                    yield (float(labels[i]), keys[lo:hi].astype(np.int64),
+                           values[lo:hi].astype(np.float64))
+            else:
+                yield from iter_bsparse(path)
         return
     parse = parse_weighted if reader_type == "weight" else parse_default
     if reader_type not in ("default", "weight"):

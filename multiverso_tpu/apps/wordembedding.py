@@ -331,6 +331,8 @@ def train(
     log_every: int = 200,
     device_corpus: Optional[bool] = None,
     table_dtype: Optional[Any] = None,
+    steps_per_call: Optional[int] = None,
+    oversample: Optional[float] = None,
 ) -> TrainResult:
     """Full training driver (reference ``TrainNeuralNetwork``,
     ``distributed_wordembedding.cpp:146``).
@@ -340,7 +342,14 @@ def train(
     — the mode ``bench.py`` measures). Default (None) auto-enables it when
     the corpus fits the HBM budget; False streams host-generated pair
     batches (unbounded corpus size, the reference's loader-thread shape).
+
+    ``steps_per_call`` / ``oversample`` override the matching cfg fields;
+    left as None, cfg values at their dataclass defaults are resolved to
+    the chosen path's tuned values (device: 32 / 2.5; host: unchanged).
+    The caller's ``cfg`` object is never mutated.
     """
+    import dataclasses
+
     import multiverso_tpu as mv
 
     cfg = cfg or Word2VecConfig()

@@ -79,6 +79,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.MV_BufferFree.argtypes = [c.c_void_p]
     lib.MV_SvmParse.argtypes = [c.c_char_p]
     lib.MV_SvmParse.restype = c.c_void_p
+    lib.MV_BsparseParse.argtypes = [c.c_char_p]
+    lib.MV_BsparseParse.restype = c.c_void_p
     lib.MV_SvmNumSamples.argtypes = [c.c_void_p]
     lib.MV_SvmNumSamples.restype = c.c_longlong
     lib.MV_SvmNumEntries.argtypes = [c.c_void_p]
@@ -181,14 +183,7 @@ def build_vocab(path: str, min_count: int = 5) -> Optional[NativeVocab]:
     return NativeVocab(handle, lib)
 
 
-def parse_libsvm(path: str):
-    """Returns (labels, indptr, keys, values) numpy arrays, or None."""
-    lib = load()
-    if lib is None:
-        return None
-    handle = lib.MV_SvmParse(path.encode())
-    if not handle:
-        raise IOError(f"native libsvm parse failed: {path}")
+def _copy_svm_handle(lib, handle):
     n = int(lib.MV_SvmNumSamples(handle))
     entries = int(lib.MV_SvmNumEntries(handle))
     labels = np.zeros(n, np.float32)
@@ -203,6 +198,33 @@ def parse_libsvm(path: str):
                    values.ctypes.data_as(c.POINTER(c.c_float)))
     lib.MV_SvmFree(handle)
     return labels, indptr, keys, values
+
+
+def parse_libsvm(path: str):
+    """Returns (labels, indptr, keys, values) numpy arrays, or None."""
+    lib = load()
+    if lib is None:
+        return None
+    handle = lib.MV_SvmParse(path.encode())
+    if not handle:
+        raise IOError(f"native libsvm parse failed: {path}")
+    return _copy_svm_handle(lib, handle)
+
+
+def parse_bsparse(path: str):
+    """Native bsparse reader (LogReg binary records); None without the lib.
+
+    Raises IOError on open failure or a truncated record (matching the
+    Python reader's EOFError stance on corrupt files).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    handle = lib.MV_BsparseParse(path.encode())
+    if not handle:
+        raise IOError(f"native bsparse parse failed (missing or truncated): "
+                      f"{path}")
+    return _copy_svm_handle(lib, handle)
 
 
 # -- bridge ------------------------------------------------------------------

@@ -251,3 +251,33 @@ def test_jax_ext_shared_registry(mv_session):
         pm._all_mv_shared.clear()
     finally:
         sys.path.remove(os.path.join(REPO, "binding", "python"))
+
+
+def test_native_bsparse_matches_python(native_lib, tmp_path):
+    """C++ bsparse parser agrees with the Python reader record-for-record."""
+    from multiverso_tpu import native
+    from multiverso_tpu.apps.lr_reader import iter_bsparse, write_bsparse
+
+    samples = [
+        (1.0, np.asarray([3, 7, 100], np.int64), np.full(3, 2.5)),
+        (0.0, np.asarray([5], np.int64), np.full(1, 1.0)),
+        (1.0, np.asarray([], np.int64), np.asarray([], np.float64)),
+    ]
+    path = str(tmp_path / "x.bsparse")
+    write_bsparse(path, samples)
+
+    labels, indptr, keys, values = native.parse_bsparse(path)
+    py = list(iter_bsparse(path))
+    assert labels.shape[0] == len(py) == 3
+    for i, (lab, k, v) in enumerate(py):
+        lo, hi = int(indptr[i]), int(indptr[i + 1])
+        assert float(labels[i]) == lab
+        np.testing.assert_array_equal(keys[lo:hi], k)
+        np.testing.assert_allclose(values[lo:hi], v)
+
+    # truncated record -> error, not silent EOF
+    data = open(path, "rb").read()
+    bad = str(tmp_path / "bad.bsparse")
+    open(bad, "wb").write(data[:-4])
+    with pytest.raises(IOError):
+        native.parse_bsparse(bad)
