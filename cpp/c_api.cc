@@ -376,7 +376,7 @@ long long MV_SvmNumEntries(SvmHandler svm) {
 }
 
 void MV_SvmCopy(SvmHandler svm, float* labels, int64_t* indptr, int32_t* keys,
-                float* values) {
+                double* values) {
   auto* data = static_cast<mvtpu::SvmData*>(svm);
   std::memcpy(labels, data->labels.data(),
               data->labels.size() * sizeof(float));
@@ -384,10 +384,12 @@ void MV_SvmCopy(SvmHandler svm, float* labels, int64_t* indptr, int32_t* keys,
               data->indptr.size() * sizeof(int64_t));
   std::memcpy(keys, data->keys.data(), data->keys.size() * sizeof(int32_t));
   std::memcpy(values, data->values.data(),
-              data->values.size() * sizeof(float));
+              data->values.size() * sizeof(double));
 }
 
 void MV_SvmFree(SvmHandler svm) { delete static_cast<mvtpu::SvmData*>(svm); }
+
+int MV_ExtAbiVersion(void) { return MV_EXT_ABI_VERSION; }
 
 int MV_RunNativeTests(void) { return mvtpu::RunNativeTests(); }
 

@@ -25,6 +25,13 @@ extern "C" {
 
 typedef void* TableHandler;
 
+/* ext: ABI revision of the non-reference extensions (Svm readers, bridge,
+ * vocab). Bumped whenever an exported signature changes so a stale .so and
+ * a newer Python loader can never exchange mis-sized buffers. Rev 2: f64
+ * SvmData values. */
+#define MV_EXT_ABI_VERSION 2
+DllExport int MV_ExtAbiVersion();
+
 DllExport void MV_Init(int* argc, char* argv[]);
 DllExport void MV_ShutDown();
 DllExport void MV_Barrier();
@@ -116,8 +123,10 @@ DllExport SvmHandler MV_SvmParse(const char* path);
 DllExport SvmHandler MV_BsparseParse(const char* path);
 DllExport long long MV_SvmNumSamples(SvmHandler svm);
 DllExport long long MV_SvmNumEntries(SvmHandler svm);
+/* values are double so text/binary sample values round-trip exactly
+ * (parity with the Python readers, which yield f64). */
 DllExport void MV_SvmCopy(SvmHandler svm, float* labels, int64_t* indptr,
-                          int32_t* keys, float* values);
+                          int32_t* keys, double* values);
 DllExport void MV_SvmFree(SvmHandler svm);
 
 /* ext: in-library self-tests of the native primitives (allocator, queues,

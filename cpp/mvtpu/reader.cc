@@ -147,11 +147,11 @@ bool ParseLibsvm(const std::string& path, SvmData* out) {
       if (colon == nullptr) {
         out->keys.push_back(
             static_cast<int32_t>(std::strtol(start, nullptr, 10)));
-        out->values.push_back(1.0f);
+        out->values.push_back(1.0);
       } else {
         out->keys.push_back(
             static_cast<int32_t>(std::strtol(start, nullptr, 10)));
-        out->values.push_back(std::strtof(colon + 1, nullptr));
+        out->values.push_back(std::strtod(colon + 1, nullptr));
       }
     });
     if (any) out->indptr.push_back(static_cast<int64_t>(out->keys.size()));
@@ -200,7 +200,7 @@ bool ParseBsparse(const std::string& path, SvmData* out) {
         return false;
       }
       out->keys.push_back(static_cast<int32_t>(k));
-      out->values.push_back(static_cast<float>(head.weight));
+      out->values.push_back(head.weight);
     }
     out->indptr.push_back(static_cast<int64_t>(out->keys.size()));
   }

@@ -52,7 +52,7 @@ struct SvmData {
   std::vector<float> labels;
   std::vector<int64_t> indptr;  // size labels.size() + 1
   std::vector<int32_t> keys;
-  std::vector<float> values;
+  std::vector<double> values;
 };
 
 // "label k:v k:v ..." per line (value defaults to 1 when omitted).

@@ -146,7 +146,7 @@ def sample_iterator(reader_type: str, files: str, sparse: bool,
             # C++ record parser (cpp/mvtpu/reader.cc) for files small enough
             # to materialize (it returns whole arrays; the Python reader
             # streams in bounded chunks, so big files stay on it). Values
-            # round-trip through f32 on the native path (SvmData layout);
+            # are f64 end-to-end, matching the Python reader exactly;
             # keys >= 2^31 make the native parser refuse, falling back to
             # the i64-capable Python reader.
             use_native = (native.available()
@@ -162,7 +162,7 @@ def sample_iterator(reader_type: str, files: str, sparse: bool,
                 for i in range(labels.shape[0]):
                     lo, hi = int(indptr[i]), int(indptr[i + 1])
                     yield (float(labels[i]), keys[lo:hi].astype(np.int64),
-                           values[lo:hi].astype(np.float64))
+                           values[lo:hi])
             else:
                 yield from iter_bsparse(path)
         return

@@ -352,7 +352,15 @@ def train(
 
     import multiverso_tpu as mv
 
-    cfg = cfg or Word2VecConfig()
+    # Private copy: the fast-path default resolution below tunes fields on
+    # it, and the caller's cfg must never inherit those values.
+    cfg = dataclasses.replace(cfg) if cfg is not None else Word2VecConfig()
+    explicit_spc = steps_per_call is not None
+    explicit_ovs = oversample is not None
+    if explicit_spc:
+        cfg.steps_per_call = int(steps_per_call)
+    if explicit_ovs:
+        cfg.oversample = float(oversample)
     if dictionary is None:
         Log.info("building dictionary from %s ...", corpus_path)
         dictionary = Dictionary.build(corpus_path, min_count=min_count)
@@ -362,6 +370,13 @@ def train(
     cfg.vocab_size = vocab
     counts = np.asarray(dictionary.counts, np.float64)
     Log.info("vocab %d, train words %d", vocab, dictionary.train_words)
+    if cfg.row_mean_updates is None:
+        # Auto: batched scatter-sum matches the reference's sequential
+        # updates until hot rows collect more than ~row_update_cap colliding
+        # pair grads per batch; past that, switch to capped row-mean to keep
+        # training stable (see docs/EMBEDDING_QUALITY.md).
+        cfg.row_mean_updates = (
+            cfg.batch_size >= cfg.row_update_cap * max(vocab, 1))
 
     # The same two tables the reference allocates (WE/src/communicator.cpp:17-33);
     # AdaGrad G state lives model-side when cfg.use_adagrad.
@@ -405,6 +420,13 @@ def train(
         elif n_enc < min_positions:
             Log.fatal(f"device_corpus needs at least batch_size + 2*window "
                       f"positions; corpus has {n_enc}")
+        elif n_enc > _DEVICE_CORPUS_MAX_TOKENS:
+            # Explicit opt-in overrides the auto budget (large-HBM parts can
+            # hold far more); surface the cost instead of refusing.
+            Log.error(f"device_corpus=True uploads {n_enc} corpus tokens "
+                     f"(~{n_enc * 8 >> 20} MB) to HBM, over the "
+                     f"{_DEVICE_CORPUS_MAX_TOKENS}-token auto budget; "
+                     f"use device_corpus=False to stream instead")
 
     if device_corpus:
         # -- device-resident fast path: corpus in HBM, sampling + training
@@ -412,9 +434,9 @@ def train(
         # fast-path defaults: fuse many steps per dispatch and oversample
         # candidates unless the caller chose otherwise (cfg is read lazily
         # by the fused builder, so this runs before any compilation)
-        if cfg.steps_per_call <= 1:
+        if cfg.steps_per_call <= 1 and not explicit_spc:
             cfg.steps_per_call = 32
-        if cfg.oversample <= 1:
+        if cfg.oversample <= 1 and not explicit_ovs:
             cfg.oversample = 2.5
         discard = subsample_probs(counts, sample).astype(np.float32)
         model.load_corpus_chunk(ids, sent_ids, discard)
@@ -565,25 +587,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     steps_per_call = opt("steps_per_call", -1, int)
     oversample = opt("oversample", -1.0, float)
     neg_pool = opt("neg_pool", 1 << 22, int)
-    row_mean = bool(opt("row_mean", 1, int))
+    # -1 auto: reference summed-update semantics at small batch, row-mean
+    # divergence guard only once batches are large enough to need it (see
+    # docs/EMBEDDING_QUALITY.md for the quality comparison behind this)
+    row_mean = opt("row_mean", -1, int)
     shared_negatives = opt("shared_negatives", 0, int)
     bf16 = bool(opt("bf16", 0, int))
     if not train_file:
         print("usage: wordembedding -train_file FILE [-output F] [-size N] "
               "[-window N] [-negative N] [-hs 0|1] [-cbow 0|1] [-epoch N] "
               "[-min_count N] [-sample F] [-lr F] [-batch_size N] "
-              "[-use_adagrad 0|1] [-read_vocab F] [-save_vocab F]")
+              "[-use_adagrad 0|1] [-read_vocab F] [-save_vocab F] "
+              "[-row_mean -1|0|1]\n"
+              "  -row_mean: 0 = reference summed-update semantics "
+              "(wordembedding.cpp:120-168); 1 = capped row-mean updates "
+              "(large-batch divergence guard); -1 (default) = auto, on only "
+              "when batch_size is large relative to the vocabulary")
         return 2
     mv.init(argv)
     cfg = Word2VecConfig(embedding_size=size, window=window, negative=negative,
                          hs=hs, cbow=cbow, init_lr=lr, batch_size=batch,
                          use_adagrad=adagrad,
-                         neg_pool_size=neg_pool, row_mean_updates=row_mean,
+                         neg_pool_size=neg_pool,
+                         row_mean_updates=None if row_mean < 0 else bool(row_mean),
                          shared_negatives=shared_negatives)
-    if steps_per_call > 0:
-        cfg.steps_per_call = steps_per_call
-    if oversample >= 0:
-        cfg.oversample = oversample
     dictionary = (Dictionary.load(read_vocab, min_count=min_count)
                   if read_vocab else None)
     if save_vocab:
@@ -599,7 +626,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     train(train_file, output, cfg, epochs=epochs, min_count=min_count,
           sample=sample, dictionary=dictionary,
           device_corpus=None if device_corpus < 0 else bool(device_corpus),
-          table_dtype=table_dtype)
+          table_dtype=table_dtype,
+          steps_per_call=steps_per_call if steps_per_call > 0 else None,
+          oversample=oversample if oversample >= 0 else None)
     mv.shutdown()
     return 0
 

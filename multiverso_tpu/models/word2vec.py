@@ -75,9 +75,11 @@ class Word2VecConfig:
     # pair grads, so hot (frequent) rows receive thousands-of-pairs-sized
     # steps and TRAINING DIVERGES once batch_size is large relative to the
     # vocabulary (e.g. 64k batch on a 5k vocab). Enable for large batches;
-    # off (reference-equivalent sum, matching sequential movement at small
-    # batch) by default.
-    row_mean_updates: bool = False
+    # None = auto: the train() driver turns it on only when batch_size is
+    # large relative to the vocabulary (>= row_update_cap expected hits per
+    # row); False = reference-equivalent sum always. Falsy when a Word2Vec
+    # is built directly without resolution, i.e. reference semantics.
+    row_mean_updates: Optional[bool] = None
     # with row_mean_updates: per-row update = mean-grad * min(count, cap).
     # cap bounds how much a hot row can move per batch — rows with <= cap
     # collisions keep the reference's sequential-sum movement exactly;

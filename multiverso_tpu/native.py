@@ -28,6 +28,8 @@ from .log import Log
 _LIB_ENV = "MV_NATIVE_LIB"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
+# Must match MV_EXT_ABI_VERSION in cpp/c_api.h (rev 2: f64 SvmData values).
+_EXT_ABI_VERSION = 2
 
 
 def _lib_candidates() -> List[str]:
@@ -87,7 +89,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.MV_SvmNumEntries.restype = c.c_longlong
     lib.MV_SvmCopy.argtypes = [c.c_void_p, c.POINTER(c.c_float),
                                c.POINTER(c.c_int64), c.POINTER(c.c_int32),
-                               c.POINTER(c.c_float)]
+                               c.POINTER(c.c_double)]
     lib.MV_SvmFree.argtypes = [c.c_void_p]
 
 
@@ -107,6 +109,19 @@ def load() -> Optional[ctypes.CDLL]:
             try:
                 lib = ctypes.CDLL(path)
             except OSError:
+                continue
+            # Refuse ABI-skewed builds: a stale .so with a different ext
+            # signature set would silently exchange mis-sized buffers
+            # (e.g. f32 SvmData values into an f64 array).
+            try:
+                got = int(lib.MV_ExtAbiVersion())
+            except AttributeError:
+                got = 1   # pre-versioning builds
+            if got != _EXT_ABI_VERSION:
+                Log.error(
+                    "native library %s has ext ABI rev %d, need %d — "
+                    "rebuild cpp/ (make); falling back to Python paths",
+                    path, got, _EXT_ABI_VERSION)
                 continue
             _declare(lib)
             _lib = lib
@@ -189,13 +204,13 @@ def _copy_svm_handle(lib, handle):
     labels = np.zeros(n, np.float32)
     indptr = np.zeros(n + 1, np.int64)
     keys = np.zeros(entries, np.int32)
-    values = np.zeros(entries, np.float32)
+    values = np.zeros(entries, np.float64)
     c = ctypes
     lib.MV_SvmCopy(handle,
                    labels.ctypes.data_as(c.POINTER(c.c_float)),
                    indptr.ctypes.data_as(c.POINTER(c.c_int64)),
                    keys.ctypes.data_as(c.POINTER(c.c_int32)),
-                   values.ctypes.data_as(c.POINTER(c.c_float)))
+                   values.ctypes.data_as(c.POINTER(c.c_double)))
     lib.MV_SvmFree(handle)
     return labels, indptr, keys, values
 
