@@ -200,5 +200,7 @@ define_string("updater_type", "default", "server-side updater: default|sgd|adagr
 define_int("omp_threads", 4, "host-side worker threads for async apply loops")
 define_string("mesh_shape", "", "override logical mesh, e.g. '4,2' for (worker,server)")
 define_int("sync_frequency", 1, "rounds between parameter synchronisations")
+define_int("async_poll_ms", 20,
+           "async PS: drain-thread poll interval (bounds peer-delta staleness)")
 define_string("log_file", "", "optional log sink file")
 define_string("log_level", "info", "debug|info|error|fatal")

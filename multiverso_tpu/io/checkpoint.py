@@ -23,11 +23,17 @@ import re
 import shutil
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from ..log import Log
 from ..runtime import Session
-from .stream import open_stream
+from .stream import is_remote, open_stream
+
+
+def _join(directory: str, name: str) -> str:
+    """Path join that preserves URI schemes (``gs://...`` stays a URI)."""
+    return directory.rstrip("/") + "/" + name if is_remote(directory) \
+        else os.path.join(directory, name)
 
 _MANIFEST = "manifest.json"
 _STEP_DIR = re.compile(r"^step_(\d+)$")
@@ -40,20 +46,21 @@ def save(directory: str, session: Optional[Session] = None) -> None:
         Log.fatal("save() requires an initialised session")
     sess.barrier()
     if sess.rank == 0:
-        os.makedirs(directory, exist_ok=True)
+        if not is_remote(directory):
+            os.makedirs(directory, exist_ok=True)
         manifest = {"version": 1, "tables": []}
         for table in sess.tables:
-            path = os.path.join(directory, f"table_{table.table_id}.bin")
-            with open_stream(path, "wb") as stream:
+            name = f"table_{table.table_id}.bin"
+            with open_stream(_join(directory, name), "wb") as stream:
                 table.store(stream)
             manifest["tables"].append({
                 "id": table.table_id,
                 "type": type(table).__name__,
                 "name": getattr(table, "name", ""),
-                "file": os.path.basename(path),
+                "file": name,
             })
-        with open(os.path.join(directory, _MANIFEST), "w") as f:
-            json.dump(manifest, f, indent=2)
+        with open_stream(_join(directory, _MANIFEST), "wb") as f:
+            f.write(json.dumps(manifest, indent=2).encode("utf-8"))
         Log.info("checkpoint saved: %d table(s) -> %s", len(sess.tables), directory)
     sess.barrier()
 
@@ -64,11 +71,12 @@ def restore(directory: str, session: Optional[Session] = None) -> None:
     sess = session or Session.get()
     if not sess.started:
         Log.fatal("restore() requires an initialised session")
-    manifest_path = os.path.join(directory, _MANIFEST)
-    if not os.path.exists(manifest_path):
+    manifest_path = _join(directory, _MANIFEST)
+    try:
+        with open_stream(manifest_path, "rb") as f:
+            manifest = json.loads(f.read().decode("utf-8"))
+    except FileNotFoundError:
         Log.fatal(f"no checkpoint manifest at {manifest_path}")
-    with open(manifest_path) as f:
-        manifest = json.load(f)
     by_id = {entry["id"]: entry for entry in manifest["tables"]}
     for table in sess.tables:
         entry = by_id.get(table.table_id)
@@ -78,7 +86,7 @@ def restore(directory: str, session: Optional[Session] = None) -> None:
             Log.fatal(
                 f"checkpoint table {table.table_id} is {entry['type']}, "
                 f"session has {type(table).__name__}")
-        with open_stream(os.path.join(directory, entry["file"]), "rb") as stream:
+        with open_stream(_join(directory, entry["file"]), "rb") as stream:
             table.load(stream)
     Log.info("checkpoint restored: %d table(s) <- %s", len(sess.tables), directory)
 
@@ -175,16 +183,33 @@ def restore_orbax(directory: str, session: Optional[Session] = None) -> None:
              len(sess.tables), directory)
 
 
+def _step_dirs(root: str) -> List[Tuple[int, str]]:
+    """(step, directory-name) of complete checkpoints, ascending by step.
+
+    Directory names are preserved verbatim — zero-padded names like
+    ``step_000010`` must restore from their actual path, not a
+    reconstructed ``step_10``.
+    """
+    if is_remote(root):
+        from . import remote
+
+        names = remote.list_subdirs_with(root, _MANIFEST)
+    elif os.path.isdir(root):
+        names = [name for name in os.listdir(root)
+                 if os.path.exists(os.path.join(root, name, _MANIFEST))]
+    else:
+        return []
+    found = []
+    for name in names:
+        m = _STEP_DIR.match(name)
+        if m:
+            found.append((int(m.group(1)), name))
+    return sorted(found)
+
+
 def list_steps(root: str) -> List[int]:
     """Completed checkpoint steps under ``root``, ascending."""
-    if not os.path.isdir(root):
-        return []
-    steps = []
-    for name in os.listdir(root):
-        m = _STEP_DIR.match(name)
-        if m and os.path.exists(os.path.join(root, name, _MANIFEST)):
-            steps.append(int(m.group(1)))
-    return sorted(steps)
+    return [step for step, _ in _step_dirs(root)]
 
 
 def restore_latest(root: str, session: Optional[Session] = None
@@ -197,11 +222,12 @@ def restore_latest(root: str, session: Optional[Session] = None
     restarted job calls this before training and resumes from wherever the
     autosaver last landed.
     """
-    steps = list_steps(root)
-    if not steps:
+    dirs = _step_dirs(root)
+    if not dirs:
         return None
-    restore(os.path.join(root, f"step_{steps[-1]}"), session)
-    return steps[-1]
+    step, name = dirs[-1]
+    restore(os.path.join(root, name), session)
+    return step
 
 
 class Autosaver:
@@ -259,21 +285,36 @@ class Autosaver:
     def save_now(self, step: int) -> None:
         with self._lock:
             sess = self._session or Session.get()
-            final = os.path.join(self._root, f"step_{step}")
-            tmp = final + ".tmp"
-            if os.path.isdir(tmp):
-                shutil.rmtree(tmp)
-            save(tmp, sess)
-            if sess.rank == 0:
-                if os.path.isdir(final):
-                    shutil.rmtree(final)
-                os.replace(tmp, final)
-                self._prune()
+            final = _join(self._root, f"step_{step}")
+            if is_remote(self._root):
+                # object stores have no atomic rename; the manifest is
+                # written LAST by save() and _step_dirs only counts
+                # manifest-bearing dirs, so manifest-commit is the atomic
+                # point
+                save(final, sess)
+                if sess.rank == 0:
+                    self._prune()
+            else:
+                tmp = final + ".tmp"
+                if os.path.isdir(tmp):
+                    shutil.rmtree(tmp)
+                save(tmp, sess)
+                if sess.rank == 0:
+                    if os.path.isdir(final):
+                        shutil.rmtree(final)
+                    os.replace(tmp, final)
+                    self._prune()
             sess.barrier()
             self._last_time = time.monotonic()
 
     def _prune(self) -> None:
-        steps = list_steps(self._root)
-        for old in steps[:-self._keep]:
-            shutil.rmtree(os.path.join(self._root, f"step_{old}"),
-                          ignore_errors=True)
+        old = _step_dirs(self._root)[:-self._keep]
+        if is_remote(self._root):
+            from . import remote
+
+            for _, name in old:
+                remote.delete_prefix(_join(self._root, name))
+        else:
+            for _, name in old:
+                shutil.rmtree(os.path.join(self._root, name),
+                              ignore_errors=True)

@@ -1,0 +1,146 @@
+"""Remote stream backends over tensorstore KvStore (gs://, memory://).
+
+The reference ships an HDFS stream behind libhdfs
+(``src/io/hdfs_stream.cpp``, ``include/multiverso/io/hdfs_stream.h:24`` in
+the Multiverso reference) so tables and corpora can live on the cluster
+filesystem. The TPU-VM equivalent of "the cluster filesystem" is object
+storage — GCS — and the portable driver layer shipped with JAX is
+tensorstore. This module registers:
+
+* ``gs://bucket/path`` — GCS objects via tensorstore's ``gcs`` driver
+  (credentials resolved by the environment, as on any TPU VM);
+* ``memory://name/path`` — an in-process object store (tensorstore
+  ``memory`` driver under one shared context), the hermetic test double for
+  the same code path.
+
+Object stores have no append/seek-write, so a write stream buffers locally
+and uploads one object at close — exactly how the reference's HDFS stream
+commits on ``Flush``/close. Read streams fetch the object once and serve
+from memory (table records are read straight through anyway).
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+from typing import BinaryIO
+
+from ..log import Log
+
+_lock = threading.Lock()
+_memory_context = None   # shared so memory:// writes persist per-process
+
+
+def _kvstore_for(uri) -> tuple:
+    """(opened KvStore, key) for a parsed ``URI``."""
+    import tensorstore as ts
+
+    global _memory_context
+    key = uri.path.lstrip("/")
+    if uri.scheme == "gs":
+        if not uri.host:
+            Log.fatal(f"gs:// URI needs a bucket: {uri.uri}")
+        store = ts.KvStore.open({"driver": "gcs", "bucket": uri.host}).result()
+        return store, key
+    if uri.scheme == "memory":
+        with _lock:
+            if _memory_context is None:
+                _memory_context = ts.Context()
+        store = ts.KvStore.open({"driver": "memory"},
+                                context=_memory_context).result()
+        # host names a namespace inside the shared store
+        return store, f"{uri.host}/{key}" if uri.host else key
+    Log.fatal(f"unsupported remote scheme {uri.scheme!r}")
+
+
+class _KvReadStream(io.BytesIO):
+    """Whole-object read stream (reference HDFSStream read mode)."""
+
+    def __init__(self, store, key: str, uri: str) -> None:
+        try:
+            result = store.read(key).result()
+        except Exception as exc:
+            raise FileNotFoundError(f"{uri}: {exc}") from exc
+        if str(result.state) == "missing":
+            raise FileNotFoundError(uri)
+        super().__init__(bytes(result.value))
+
+
+class _KvWriteStream(io.BytesIO):
+    """Buffered write stream; commits ONE object at close (object stores
+    have no append — same commit-on-close the reference HDFS stream has)."""
+
+    def __init__(self, store, key: str, uri: str) -> None:
+        super().__init__()
+        self._store = store
+        self._key = key
+        self._uri = uri
+        self._committed = False
+
+    def close(self) -> None:
+        if not self._committed and not self.closed:
+            self._store.write(self._key, self.getvalue()).result()
+            self._committed = True
+        super().close()
+
+
+def open_remote(uri, mode: str) -> BinaryIO:
+    """Scheme opener signature for :func:`io.stream.register_scheme`."""
+    store, key = _kvstore_for(uri)
+    if "w" in mode:
+        return _KvWriteStream(store, key, uri.uri)
+    if "a" in mode:
+        Log.fatal(f"append mode unsupported on object store: {uri.uri}")
+    return _KvReadStream(store, key, uri.uri)
+
+
+def exists(uri_str: str) -> bool:
+    """Object existence probe (manifest checks on remote checkpoints)."""
+    from .stream import URI
+
+    uri = URI(uri_str)
+    store, key = _kvstore_for(uri)
+    try:
+        return str(store.read(key).result().state) != "missing"
+    except Exception:
+        return False
+
+
+def list_subdirs_with(root_uri: str, filename: str):
+    """Immediate subdirectory names under ``root_uri`` that contain
+    ``filename`` (checkpoint-step discovery on object stores, where
+    "directories" are key prefixes)."""
+    from .stream import URI
+
+    store, prefix = _kvstore_for(URI(root_uri))
+    prefix = prefix.rstrip("/")
+    prefix = prefix + "/" if prefix else ""
+    names = set()
+    for raw in store.list().result():
+        key = raw.decode("utf-8") if isinstance(raw, bytes) else str(raw)
+        if not key.startswith(prefix):
+            continue
+        parts = key[len(prefix):].split("/")
+        if len(parts) == 2 and parts[1] == filename:
+            names.add(parts[0])
+    return sorted(names)
+
+
+def delete_prefix(dir_uri: str) -> None:
+    """Delete every object under ``dir_uri`` (remote checkpoint pruning)."""
+    import tensorstore as ts
+
+    from .stream import URI
+
+    store, prefix = _kvstore_for(URI(dir_uri))
+    prefix = prefix.rstrip("/") + "/"
+    # exclusive max = prefix with '/' bumped to the next code point, i.e.
+    # the tightest range covering exactly the keys under the prefix
+    store.delete_range(ts.KvStore.KeyRange(prefix, prefix[:-1] + "0"))
+
+
+def register() -> None:
+    from .stream import register_scheme
+
+    register_scheme("gs", open_remote)
+    register_scheme("memory", open_remote)

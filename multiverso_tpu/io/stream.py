@@ -63,11 +63,23 @@ def open_stream(uri: str, mode: str = "rb") -> BinaryIO:
     """``StreamFactory::GetStream`` (``src/io/io.cpp:8-21``)."""
     parsed = URI(uri)
     opener = _OPENERS.get(parsed.scheme)
+    if opener is None and parsed.scheme in ("gs", "memory"):
+        # remote backends (tensorstore) register on first use — the
+        # reference's compile-time MULTIVERSO_USE_HDFS becomes a lazy import
+        from . import remote
+
+        remote.register()
+        opener = _OPENERS.get(parsed.scheme)
     if opener is None:
         Log.fatal(f"no stream handler for scheme {parsed.scheme!r} ({uri})")
     if "b" not in mode:
         mode += "b"
     return opener(parsed, mode)
+
+
+def is_remote(path: str) -> bool:
+    """True when ``path`` is a non-file URI (no local mkdir/exists)."""
+    return URI(path).scheme != "file"
 
 
 class TextReader:
@@ -107,7 +119,10 @@ _MAGIC = b"MVTA"
 
 def write_array(stream: BinaryIO, array: np.ndarray) -> None:
     array = np.ascontiguousarray(array)
-    dtype_tag = array.dtype.str.encode("ascii")
+    # extension dtypes (ml_dtypes bfloat16 etc.) stringify as opaque '|V2';
+    # their NAME round-trips (resolved via ml_dtypes on read)
+    tag = (array.dtype.str if array.dtype.kind != "V" else array.dtype.name)
+    dtype_tag = tag.encode("ascii")
     stream.write(_MAGIC)
     stream.write(struct.pack("<B", len(dtype_tag)))
     stream.write(dtype_tag)
@@ -122,7 +137,13 @@ def read_array(stream: BinaryIO) -> np.ndarray:
     if magic != _MAGIC:
         Log.fatal(f"bad table record magic {magic!r}")
     (tag_len,) = struct.unpack("<B", stream.read(1))
-    dtype = np.dtype(stream.read(tag_len).decode("ascii"))
+    tag = stream.read(tag_len).decode("ascii")
+    try:
+        dtype = np.dtype(tag)
+    except TypeError:
+        import ml_dtypes   # extension dtype written by name
+
+        dtype = np.dtype(getattr(ml_dtypes, tag))
     (ndim,) = struct.unpack("<B", stream.read(1))
     shape = tuple(struct.unpack("<q", stream.read(8))[0] for _ in range(ndim))
     count = int(np.prod(shape)) if shape else 1

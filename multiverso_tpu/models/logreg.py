@@ -87,6 +87,7 @@ class LogReg:
     # -- math --------------------------------------------------------------
     def _forward(self, w, x):
         """x: [B, input]; w: [output, input+1] -> scores [B, output]."""
+        w = self.table.logical(w)   # drop server-padding rows (fake classes)
         scores = x @ w[:, :-1].T + w[:, -1]
         obj = self.cfg.objective_type
         if obj == "sigmoid":
@@ -99,8 +100,11 @@ class LogReg:
         cfg = self.cfg
         updater = self.table.updater
 
+        table = self.table
+
         def step(w, ustate, x, y, lr, momentum, rho, lam, wid):
-            def loss_fn(w):
+            def loss_fn(wf):
+                w = table.logical(wf)   # pad rows get zero grads
                 scores = x @ w[:, :-1].T + w[:, -1]
                 if cfg.objective_type == "sigmoid":
                     # y: [B, output] in {0,1}

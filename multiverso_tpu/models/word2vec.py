@@ -205,7 +205,9 @@ class Word2Vec:
             self._codes = jnp.asarray(huffman.codes)       # [vocab, L]
             self._path_mask = jnp.asarray(huffman.mask)    # [vocab, L]
         if config.use_adagrad:
-            shape = (config.vocab_size, config.embedding_size)
+            # physical table shape: G rows align 1:1 with (padded) embedding
+            # rows so the scatter-accumulate shares the table's sharding
+            shape = input_table.padded_shape
             zeros = lambda: jax.jit(
                 lambda: jnp.zeros(shape, jnp.float32),
                 out_shardings=input_table.sharding)()

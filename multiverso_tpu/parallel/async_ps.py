@@ -1,0 +1,269 @@
+"""Cross-process ASYNC parameter serving over the coordination-service KV.
+
+The reference's DEFAULT mode: workers push deltas whenever they like and the
+shared server shards apply them in arrival order (``src/server.cpp:36-60``,
+worker fan-out ``src/worker.cpp:30-92`` in the Multiverso reference) — every
+worker's delta is eventually visible to every worker, with no round gating.
+
+TPU re-design. There is no shared server process: every process holds the
+full (sharded-in-HBM) table replica and folds deltas with jitted updater
+steps. Sync mode makes replicas identical by aggregating each round (BSP —
+XLA's native model). For ASYNC mode this module adds the missing
+cross-process data plane:
+
+* every local Add is applied to the local replica immediately (zero-latency
+  self-visibility, like a worker sharing a process with its server), and
+  **published** to the process group through the JAX coordination service's
+  key-value store (gRPC over DCN — the same control plane that replaced
+  MPI_Init/rank-0 registration);
+* a per-process background **drain thread** (the reference's server actor
+  thread re-expressed) polls peers' publication counters and applies their
+  deltas to the local replica in arrival order, via the same jitted
+  updater/scatter paths as local Adds.
+
+Consistency contract (documented bounded staleness):
+
+* every delta is applied exactly once on every process; each process sees
+  its own Adds immediately and peers' Adds within one drain interval plus
+  transport time (arrival order may differ between replicas, exactly like
+  the reference's per-server arrival order);
+* with the ``default``/commutative updater, all replicas converge to the
+  same state once quiescent — ``drain()`` (a collective) forces that point:
+  after it returns, every process has applied every delta published before
+  it anywhere, so ``get()`` equals Sigma_workers Sigma_iters delta (the
+  invariant the reference's array test asserts, ``Test/main.cpp:87-127``);
+* stateful updaters (AdaGrad slots) carry the originating worker_id in the
+  record, so per-worker state is exact; only cross-worker apply ORDER is
+  replica-dependent (true of the reference too).
+
+Payload hygiene: records are framed numpy buffers (no pickle); dense deltas
+ride the ``SparseFilter`` wire compression (``quantization.py``) — the same
+>50-percent-small rule the reference applies to cross-process Add payloads
+(``include/multiverso/util/quantization_util.h:95``).
+
+Garbage collection: each record is acknowledged by its consumers via an
+atomic counter; the last consumer (size-1 acks) deletes the record and its
+ack key, so the KV store stays bounded by in-flight traffic.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import config
+from ..log import Log
+from ..quantization import SparseFilter
+
+# record kinds
+DENSE, KEYED, KV = 0, 1, 2
+
+_HEADER = struct.Struct("<BBiiffff")  # kind, n_arrays, table_id, worker_id,
+#                                       lr, momentum, rho, lam
+
+# Publication/consumption counters survive init/shutdown cycles within one
+# process-group lifetime: the coordination service KV outlives the Session,
+# so a fresh Session must continue the sequence numbers, not restart them
+# (stop() drains collectively, so no record outlives its Session).
+_published = 0
+_consumed: dict = {}
+_state_lock = threading.Lock()
+
+
+def _serialize(kind: int, table_id: int, option, arrays: Sequence[np.ndarray]
+               ) -> bytes:
+    buf = io.BytesIO()
+    buf.write(_HEADER.pack(kind, len(arrays), table_id,
+                           int(getattr(option, "worker_id", 0)),
+                           float(getattr(option, "learning_rate", 0.0)),
+                           float(getattr(option, "momentum", 0.0)),
+                           float(getattr(option, "rho", 0.0)),
+                           float(getattr(option, "lam", 0.0))))
+    from ..io.stream import write_array
+
+    for arr in arrays:
+        write_array(buf, np.ascontiguousarray(arr))
+    return buf.getvalue()
+
+
+def _deserialize(data: bytes):
+    from ..updaters import AddOption
+
+    from ..io.stream import read_array
+
+    buf = io.BytesIO(data)
+    kind, n_arrays, table_id, wid, lr, mom, rho, lam = _HEADER.unpack(
+        buf.read(_HEADER.size))
+    arrays = [read_array(buf) for _ in range(n_arrays)]
+    option = AddOption(worker_id=wid, learning_rate=lr, momentum=mom,
+                       rho=rho, lam=lam)
+    return kind, table_id, option, arrays
+
+
+class AsyncDeltaBus:
+    """Per-process async-PS data plane (publish + drain thread)."""
+
+    def __init__(self, sess, client, poll_interval: float) -> None:
+        self._sess = sess
+        self._client = client
+        self._rank = sess.rank
+        self._size = sess.size
+        self._interval = poll_interval
+        self._filters: dict = {}   # np.dtype -> SparseFilter (typed wire)
+        self._pub_lock = threading.Lock()
+        self._drain_lock = threading.Lock()
+        self._stop = threading.Event()
+        with _state_lock:
+            for r in range(self._size):
+                _consumed.setdefault(r, 0)
+        self._thread = threading.Thread(
+            target=self._drain_loop, name="mvps-drain", daemon=True)
+        self._thread.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    @classmethod
+    def maybe_start(cls, sess) -> Optional["AsyncDeltaBus"]:
+        """Start the bus iff this session runs multi-process async PS."""
+        if sess.size <= 1:
+            return None
+        if config.get_flag("sync") or config.get_flag("ma"):
+            return None
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:   # no coordination service (shouldn't happen >1p)
+            Log.error("async PS: no coordination-service client; "
+                      "cross-process deltas will NOT propagate")
+            return None
+        interval = float(config.get_flag("async_poll_ms")) / 1000.0
+        bus = cls(sess, client, interval)
+        Log.info("async PS bus up: rank %d/%d, poll %.0f ms",
+                 sess.rank, sess.size, interval * 1000)
+        return bus
+
+    def stop(self) -> None:
+        """Collective: drain everything in flight, then stop the thread."""
+        self.drain()
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+    # -- publish (worker -> group) ----------------------------------------
+    def _publish(self, payload: bytes) -> None:
+        global _published
+        with self._pub_lock:
+            seq = _published
+            self._client.key_value_set_bytes(f"mvps/{self._rank}/{seq}",
+                                             payload)
+            _published = seq + 1
+            # counter bump AFTER the payload is visible: readers never see
+            # a sequence number without its record
+            self._client.key_value_increment(f"mvps/{self._rank}/n", 1)
+
+    def _filter_for(self, dtype) -> SparseFilter:
+        """SparseFilter typed to the table dtype — a filter is
+        ``SparseFilter<data_t>`` in the reference too; an f32-typed filter
+        would silently downcast f64 deltas on the wire."""
+        dtype = np.dtype(dtype)
+        f = self._filters.get(dtype)
+        if f is None:
+            f = self._filters[dtype] = SparseFilter(clip=0.0, dtype=dtype)
+        return f
+
+    def publish_dense(self, table_id: int, delta: np.ndarray, option) -> None:
+        delta = np.ascontiguousarray(delta)
+        blobs = self._filter_for(delta.dtype).filter_in([delta.ravel()])
+        self._publish(_serialize(DENSE, table_id, option, blobs))
+
+    def publish_keyed(self, table_id: int, ids: np.ndarray,
+                      vals: np.ndarray, option) -> None:
+        self._publish(_serialize(KEYED, table_id, option, [ids, vals]))
+
+    def publish_kv(self, table_id: int, keys: np.ndarray,
+                   vals: np.ndarray) -> None:
+        self._publish(_serialize(KV, table_id, None, [keys, vals]))
+
+    # -- drain (group -> local replica) ------------------------------------
+    def _peer_count(self, r: int) -> int:
+        try:
+            return int(self._client.key_value_try_get(f"mvps/{r}/n"))
+        except Exception as exc:
+            # Only an absent counter means "no publications yet"; any other
+            # transport error must NOT be read as 0 — drain() pins its
+            # quiesce frontier on this value, and a swallowed RPC failure
+            # would let a barrier pass with peer deltas unapplied.
+            if "NOT_FOUND" in str(exc):
+                return 0
+            raise
+
+    def poll_once(self) -> int:
+        """Apply every currently-visible peer delta; returns applied count."""
+        applied = 0
+        with self._drain_lock:
+            for r in range(self._size):
+                if r == self._rank:
+                    continue
+                n = self._peer_count(r)
+                while _consumed[r] < n:
+                    seq = _consumed[r]
+                    key = f"mvps/{r}/{seq}"
+                    data = self._client.blocking_key_value_get_bytes(
+                        key, 60_000)
+                    self._apply(data)
+                    with _state_lock:
+                        _consumed[r] = seq + 1
+                    applied += 1
+                    acks = self._client.key_value_increment(f"{key}/a", 1)
+                    if acks >= self._size - 1:   # last consumer collects
+                        self._client.key_value_delete(key)
+                        self._client.key_value_delete(f"{key}/a")
+        return applied
+
+    def _drain_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.poll_once()
+            except Exception as exc:   # pragma: no cover - transport races
+                if not self._stop.is_set():
+                    Log.error("async PS drain error: %s", exc)
+
+    def _apply(self, data: bytes) -> None:
+        kind, table_id, option, arrays = _deserialize(data)
+        table = self._sess.table(table_id)
+        if kind == DENSE:
+            # the publisher staged the delta in the table dtype, so the
+            # receiving replica's table dtype IS the wire value dtype
+            flat = self._filter_for(table.dtype).filter_out(arrays)[0]
+            table._apply_dense(flat.reshape(table.shape), option)
+        elif kind == KEYED:
+            table._dispatch_keyed(arrays[0], arrays[1], option)
+        elif kind == KV:
+            table._apply_remote_kv(arrays[0], arrays[1])
+        else:
+            Log.error("async PS: unknown record kind %d", kind)
+
+    # -- quiesce -----------------------------------------------------------
+    def drain(self, tag: str = "drain") -> None:
+        """Collective flush: after it returns on ALL processes, every delta
+        published before any process entered is applied everywhere.
+
+        Protocol: barrier A pins the publication frontier (everything
+        published-before-entry is visible); each process then consumes up to
+        the pinned counters; barrier B confirms group-wide completion.
+        """
+        global _drain_round
+        with _state_lock:
+            _drain_round += 1
+            rnd = _drain_round
+        self._client.wait_at_barrier(f"mvps/{tag}/{rnd}/a", 600_000)
+        targets = {r: self._peer_count(r)
+                   for r in range(self._size) if r != self._rank}
+        while any(_consumed[r] < n for r, n in targets.items()):
+            self.poll_once()
+        self._client.wait_at_barrier(f"mvps/{tag}/{rnd}/b", 600_000)
+
+
+_drain_round = 0

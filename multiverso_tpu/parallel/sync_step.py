@@ -51,7 +51,9 @@ def make_sync_step(
     updater = table.updater
 
     def _step(data, ustate, batch, lr, momentum, rho, lam, wid):
-        loss, grads = jax.value_and_grad(loss_fn)(data, batch)
+        # loss_fn sees the logical view; grads on server-padding rows are 0
+        loss, grads = jax.value_and_grad(
+            lambda d, b: loss_fn(table.logical(d), b))(data, batch)
         option = AddOption(worker_id=wid, learning_rate=lr,
                            momentum=momentum, rho=rho, lam=lam)
         delta = lr * grads

@@ -37,6 +37,7 @@ class Session:
         self.tables: List[Any] = []
         self.role: int = _ROLE_ALL
         self.started = False
+        self.async_bus: Optional[Any] = None  # cross-process async PS plane
 
     # -- singleton --------------------------------------------------------
     @classmethod
@@ -59,8 +60,17 @@ class Session:
                 return rest
             self.role = _ROLES.get(config.get_flag("ps_role"), _ROLE_ALL)
             self.topo = topology.discover()
+            if self.topo.num_workers % self.topo.size != 0:
+                Log.fatal(
+                    f"mesh worker axis ({self.topo.num_workers}) must be a "
+                    f"multiple of the process count ({self.topo.size}) so "
+                    f"every process owns the same number of worker lanes; "
+                    f"pass -mesh_shape to fix the layout")
             self.started = True
             topology.barrier("mv_init")
+            from .parallel.async_ps import AsyncDeltaBus
+
+            self.async_bus = AsyncDeltaBus.maybe_start(self)
             Log.info(
                 "multiverso-tpu initialised: rank %d/%d, mesh %s, mode %s",
                 self.rank, self.size, dict(self.topo.mesh.shape),
@@ -75,6 +85,12 @@ class Session:
             if not self.started:
                 return
             topology.barrier("mv_shutdown")
+            if self.async_bus is not None:
+                # collective: every in-flight delta lands everywhere before
+                # any table is torn down (the reference's FinishTrain drain,
+                # src/zoo.cpp:96-101)
+                self.async_bus.stop()
+                self.async_bus = None
             for table in self.tables:
                 flush = getattr(table, "flush", None)
                 if flush is not None:
@@ -85,7 +101,13 @@ class Session:
             self.topo = None
 
     def barrier(self) -> None:
+        """``MV_Barrier``. In async mode with >1 process this also quiesces
+        the delta bus, so barrier-separated phases observe each other's Adds
+        — the property the reference's binding tests rely on ("barriers
+        between phases make the async PS deterministic", SURVEY §4)."""
         self._require_started()
+        if self.async_bus is not None:
+            self.async_bus.drain("barrier")
         topology.barrier()
 
     # -- registry ---------------------------------------------------------
@@ -111,6 +133,21 @@ class Session:
         return self.topo.mesh
 
     @property
+    def table_mesh(self):
+        """Mesh parameter tables shard over.
+
+        Sync/MA/single-process: the global mesh (one logical array, BSP
+        collectives). Multi-process ASYNC: the process-LOCAL mesh — each
+        process holds an independent replica it updates without collective
+        participation, and the delta bus (``parallel.async_ps``) provides
+        eventual cross-process visibility (the reference's async contract).
+        """
+        self._require_started()
+        if self.async_bus is not None:
+            return self.topo.local_mesh
+        return self.topo.mesh
+
+    @property
     def rank(self) -> int:
         self._require_started()
         return self.topo.rank
@@ -122,8 +159,25 @@ class Session:
 
     @property
     def num_workers(self) -> int:
+        """Size of the ONE worker-id space (dense ids 0..num_workers-1).
+
+        Defined as the mesh ``worker`` axis — the same space the data plane
+        shards batches over, the per-worker updater state (AdaGrad slots) is
+        sized by, and the bindings' ``workers_num`` reports (the reference's
+        dense Zoo worker ids, ``src/zoo.cpp:119-138``). In the canonical
+        deployment the worker axis equals the process count (one
+        data-parallel worker per process); a single process may declare a
+        wider axis (``-mesh_shape``) to drive several worker lanes from one
+        host, and then owns all of them.
+        """
         self._require_started()
-        return self.topo.size  # one logical PS worker per process
+        return self.topo.num_workers
+
+    @property
+    def local_workers(self) -> int:
+        """Worker lanes owned by this process (num_workers / size)."""
+        self._require_started()
+        return self.topo.num_workers // self.topo.size
 
     @property
     def num_servers(self) -> int:
@@ -132,8 +186,12 @@ class Session:
 
     @property
     def worker_id(self) -> int:
+        """First worker lane owned by this process (host-side Adds act as
+        this worker); lanes are contiguous per process."""
         self._require_started()
-        return self.topo.rank if self.role & _ROLE_WORKER else -1
+        if not (self.role & _ROLE_WORKER):
+            return -1
+        return self.topo.rank * self.local_workers
 
     @property
     def server_id(self) -> int:

@@ -89,7 +89,7 @@ class TableBase:
         if not sess.started:
             Log.fatal("create tables after multiverso_tpu.init()")
         self._sess = sess
-        self.mesh = sess.mesh
+        self.mesh = sess.table_mesh
         self.shape = tuple(int(s) for s in shape)
         self.dtype = jnp.dtype(dtype)
         self.table_id = sess.register_table(self)
@@ -103,17 +103,27 @@ class TableBase:
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # Physical storage pads the leading dim up to a multiple of the
+        # server axis so EVERY table shards (the reference handles the
+        # remainder range explicitly, ``src/table/array_table.cpp:11-22``;
+        # XLA wants equal shards, so we pad and mask instead). ``shape``
+        # stays logical; get()/store() hide the tail.
+        self.padded_shape = self._padded_shape()
+        self.pad_rows = ((self.padded_shape[0] - self.shape[0])
+                         if self.shape else 0)
         data_spec = self._data_pspec()
         self.sharding = NamedSharding(self.mesh, data_spec)
         if init_value is not None:
             init_host = np.asarray(init_value, dtype=self.dtype).reshape(self.shape)
-            self._data = jax.device_put(init_host, self.sharding)
+            self._data = jax.device_put(self._pad_host(init_host), self.sharding)
         else:
             self._data = jax.jit(
-                lambda: jnp.zeros(self.shape, self.dtype), out_shardings=self.sharding
+                lambda: jnp.zeros(self.padded_shape, self.dtype),
+                out_shardings=self.sharding
             )()
 
-        ustate = self.updater.init_state(self.shape, self.dtype, self.num_worker_slots)
+        ustate = self.updater.init_state(self.padded_shape, self.dtype,
+                                         self.num_worker_slots)
         if isinstance(ustate, tuple) and len(ustate) == 0:
             self._ustate = ()
             self._ustate_sharding = ()
@@ -126,15 +136,38 @@ class TableBase:
         self._apply_fn = self._build_apply()
 
     # -- sharding layout ---------------------------------------------------
+    def _padded_shape(self) -> Tuple[int, ...]:
+        """Physical shape: leading dim rounded up to a server-axis multiple."""
+        if not self.shape:
+            return self.shape
+        s = int(self.mesh.shape[SERVER_AXIS])
+        rows = -(-self.shape[0] // s) * s
+        return (rows,) + self.shape[1:]
+
+    def _pad_host(self, host: np.ndarray) -> np.ndarray:
+        """Zero-pad a logical host array out to the physical shape."""
+        if not self.pad_rows:
+            return host
+        out = np.zeros(self.padded_shape, dtype=host.dtype)
+        out[: self.shape[0]] = host
+        return out
+
+    def logical(self, data: jax.Array) -> jax.Array:
+        """Logical view of a physical (padded) array; jit-safe static slice.
+
+        Models doing whole-array math (e.g. softmax over table rows) must
+        use this so padding rows never contribute; gather/scatter consumers
+        can use the padded array directly (pad rows are never indexed).
+        """
+        return data[: self.shape[0]] if self.pad_rows else data
+
     def _data_pspec(self):
         """Leading dim sharded over the server axis; override for layouts."""
         from jax.sharding import PartitionSpec as P
 
-        num_servers = self._sess.num_servers
-        if self.shape and self.shape[0] % num_servers == 0:
+        if self.shape:
             return P(SERVER_AXIS, *(None,) * (len(self.shape) - 1))
-        # Uneven leading dim: keep it unsharded rather than fight XLA padding.
-        return P(*(None,) * len(self.shape))
+        return P()
 
     # -- jitted update step ------------------------------------------------
     def _build_apply(self):
@@ -219,13 +252,21 @@ class TableBase:
             [all_v[r, : int(counts[r, 0])] for r in range(all_v.shape[0])])
         return out_i, out_v
 
-    # -- delta staging -----------------------------------------------------
-    def _stage_delta(self, delta: Any) -> jax.Array:
-        host = np.asarray(delta, dtype=self.dtype).reshape(self.shape)
-        if config.get_flag("sync") and self._sess.size > 1:
-            host = host.copy()
-            self._sess.aggregate(host)
-        return jax.device_put(host, self.sharding)
+    # -- delta application -------------------------------------------------
+    def _apply_dense(self, host: np.ndarray, option: AddOption) -> None:
+        """Fold a logical-shape host delta into the replica (jitted updater
+        step on the sharded state). Shared by local Adds and the async-PS
+        drain thread (``parallel.async_ps``) — the server-side
+        ``ProcessAdd`` path, ``src/server.cpp:48-60``."""
+        staged = jax.device_put(self._pad_host(host), self.sharding)
+        with self._lock:
+            mon = Dashboard.get_or_create(f"TABLE_ADD[{self.name}]")
+            mon.begin()
+            self._data, self._ustate = self._apply_fn(
+                self._data, self._ustate, staged,
+                *_option_scalars(option, self.dtype),
+            )
+            mon.end()
 
     # -- public ops --------------------------------------------------------
     def _add_handle(self) -> AsyncHandle:
@@ -238,16 +279,16 @@ class TableBase:
     def add_async(self, delta: Any, option: Optional[AddOption] = None) -> AsyncHandle:
         """Fold a delta into the table; returns immediately (``AddAsync``)."""
         option = self._default_option(option)
-        staged = self._stage_delta(delta)
-        with self._lock:
-            mon = Dashboard.get_or_create(f"TABLE_ADD[{self.name}]")
-            mon.begin()
-            self._data, self._ustate = self._apply_fn(
-                self._data, self._ustate, staged,
-                *_option_scalars(option, self.dtype),
-            )
-            mon.end()
-            return self._add_handle()
+        host = np.asarray(delta, dtype=self.dtype).reshape(self.shape)
+        if config.get_flag("sync") and self._sess.size > 1:
+            # BSP: every replica folds the SUM of all workers' deltas
+            host = host.copy()
+            self._sess.aggregate(host)
+        elif self._sess.async_bus is not None:
+            # async PS: peers fold this delta via their drain threads
+            self._sess.async_bus.publish_dense(self.table_id, host, option)
+        self._apply_dense(host, option)
+        return self._add_handle()
 
     def add(self, delta: Any, option: Optional[AddOption] = None) -> None:
         """Blocking Add (``WorkerTable::Add``, ``src/table.cpp:34``)."""
@@ -258,7 +299,9 @@ class TableBase:
             # Snapshot via an async device copy: later adds donate `_data`,
             # so the handle must own a buffer nothing else will consume.
             snap = jnp.copy(self._data)
-        return AsyncHandle(snap, callback=lambda: np.asarray(snap))
+        rows = self.shape[0] if self.shape else None
+        return AsyncHandle(
+            snap, callback=lambda: np.asarray(snap)[:rows])
 
     def get(self, option: Optional[GetOption] = None) -> np.ndarray:
         """Blocking whole-table Get -> host ndarray (``WorkerTable::Get``)."""
@@ -267,15 +310,28 @@ class TableBase:
     # -- device-side view --------------------------------------------------
     @property
     def array(self) -> jax.Array:
-        """Zero-copy sharded device view (the idiomatic TPU read path)."""
+        """Zero-copy sharded device view (the idiomatic TPU read path).
+
+        This is the PHYSICAL array — ``padded_shape``, with ``pad_rows``
+        zero rows at the tail when the logical leading dim is not a
+        server-axis multiple. Gather/scatter consumers can use it directly
+        (valid row ids never touch the pad); whole-array math must go
+        through :meth:`logical`.
+        """
         with self._lock:
             return self._data
 
     def set_array(self, value: jax.Array) -> None:
         """Install updated device state (used by jitted train loops that
-        thread the table state through ``parallel.sync_step``)."""
-        if value.shape != self.shape:
-            Log.fatal(f"set_array shape {value.shape} != table shape {self.shape}")
+        thread the table state through ``parallel.sync_step``). Accepts the
+        physical (padded) shape or the logical shape (padded with zeros)."""
+        if tuple(value.shape) == self.padded_shape:
+            pass
+        elif tuple(value.shape) == self.shape:
+            value = self._pad_host(np.asarray(value, dtype=self.dtype))
+        else:
+            Log.fatal(f"set_array shape {value.shape} != table shape "
+                      f"{self.shape} (physical {self.padded_shape})")
         with self._lock:
             self._data = jax.device_put(value, self.sharding)
 
@@ -299,7 +355,8 @@ class TableBase:
             Log.fatal(
                 f"checkpoint shape {host.shape} != table shape {self.shape}")
         with self._lock:
-            self._data = jax.device_put(host.astype(self.dtype), self.sharding)
+            self._data = jax.device_put(
+                self._pad_host(host.astype(self.dtype)), self.sharding)
 
     @property
     def size(self) -> int:

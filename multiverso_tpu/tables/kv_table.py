@@ -43,12 +43,28 @@ class KVTable:
     # -- worker API (kv_table.h:24-70) ------------------------------------
     def add(self, keys: Iterable, values: Iterable) -> None:
         """Server-side ``+=`` per key (``KVServerTable::ProcessAdd``)."""
+        keys = list(keys)
+        values = list(values)
+        bus = self._sess.async_bus
+        if bus is not None:   # async PS: peers fold this via their drain
+            bus.publish_kv(self.table_id,
+                           np.asarray(keys, np.int64),
+                           np.asarray(values, np.float64))
         with self._lock:
             for k, v in zip(keys, values):
                 k = self.key_dtype.type(k).item()
                 v = self.value_dtype.type(v).item()
                 self._store[k] = self._store.get(k, 0) + v
-                self._pending[k] = self._pending.get(k, 0) + v
+                if bus is None:
+                    self._pending[k] = self._pending.get(k, 0) + v
+
+    def _apply_remote_kv(self, keys: np.ndarray, values: np.ndarray) -> None:
+        """Drain-thread apply of a peer's adds (no re-publication)."""
+        with self._lock:
+            for k, v in zip(keys, values):
+                k = self.key_dtype.type(k).item()
+                v = self.value_dtype.type(v).item()
+                self._store[k] = self._store.get(k, 0) + v
 
     def get(self, keys: Iterable) -> List:
         """Pull values into the local cache and return them in key order."""

@@ -90,13 +90,13 @@ class MatrixTable(TableBase):
     def get_row(self, row_id: int) -> np.ndarray:
         return self.get_rows([row_id])[0]
 
-    def add_rows_async(self, row_ids: Any, values: Any,
-                       option: Optional[AddOption] = None) -> AsyncHandle:
-        """Scatter-apply deltas into a set of rows (``Add(row_ids, ...)``)."""
-        option = self._default_option(option)
-        ids = np.asarray(row_ids, dtype=np.int32).ravel()
-        vals = np.asarray(values, dtype=self.dtype).reshape(ids.shape[0], self.num_col)
-        ids, vals = self._aggregate_keyed(ids, vals)
+    def _dispatch_keyed(self, ids: np.ndarray, vals: np.ndarray,
+                        option: AddOption) -> None:
+        """Pad/bucket + jitted scatter-apply of row deltas; shared by local
+        Adds and the async-PS drain thread."""
+        ids = np.asarray(ids, dtype=np.int32).ravel()
+        vals = np.asarray(vals, dtype=self.dtype).reshape(
+            ids.shape[0], self.num_col)
         n = ids.shape[0]
         size = _rowops.bucket_size(n)
         padded_ids, mask = _rowops.pad_ids(ids, n, size)
@@ -109,7 +109,19 @@ class MatrixTable(TableBase):
                 jnp.asarray(padded_ids), jnp.asarray(padded_vals),
                 jnp.asarray(mask), *_option_scalars(option, self.dtype),
             )
-            return self._add_handle()
+
+    def add_rows_async(self, row_ids: Any, values: Any,
+                       option: Optional[AddOption] = None) -> AsyncHandle:
+        """Scatter-apply deltas into a set of rows (``Add(row_ids, ...)``)."""
+        option = self._default_option(option)
+        ids = np.asarray(row_ids, dtype=np.int32).ravel()
+        vals = np.asarray(values, dtype=self.dtype).reshape(ids.shape[0], self.num_col)
+        bus = self._sess.async_bus
+        if bus is not None:
+            bus.publish_keyed(self.table_id, ids, vals, option)
+        ids, vals = self._aggregate_keyed(ids, vals)
+        self._dispatch_keyed(ids, vals, option)
+        return self._add_handle()
 
     def add_rows(self, row_ids: Any, values: Any,
                  option: Optional[AddOption] = None) -> None:

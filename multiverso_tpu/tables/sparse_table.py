@@ -50,12 +50,10 @@ class SparseTable(TableBase):
             out = self._key_gather(self._data, jnp.asarray(padded))
         return np.asarray(out)[:n]
 
-    def add_keys_async(self, keys: Any, values: Any,
-                       option: Optional[AddOption] = None) -> AsyncHandle:
-        option = self._default_option(option)
-        ids = np.asarray(keys, dtype=np.int32).ravel()
-        vals = np.asarray(values, dtype=self.dtype).ravel()
-        ids, vals = self._aggregate_keyed(ids, vals)
+    def _dispatch_keyed(self, ids: np.ndarray, vals: np.ndarray,
+                        option: AddOption) -> None:
+        ids = np.asarray(ids, dtype=np.int32).ravel()
+        vals = np.asarray(vals, dtype=self.dtype).ravel()
         n = ids.shape[0]
         size = _rowops.bucket_size(n)
         padded_ids, mask = _rowops.pad_ids(ids, n, size)
@@ -66,7 +64,18 @@ class SparseTable(TableBase):
                 jnp.asarray(padded_ids), jnp.asarray(padded_vals),
                 jnp.asarray(mask), *_option_scalars(option, self.dtype),
             )
-            return self._add_handle()
+
+    def add_keys_async(self, keys: Any, values: Any,
+                       option: Optional[AddOption] = None) -> AsyncHandle:
+        option = self._default_option(option)
+        ids = np.asarray(keys, dtype=np.int32).ravel()
+        vals = np.asarray(values, dtype=self.dtype).ravel()
+        bus = self._sess.async_bus
+        if bus is not None:
+            bus.publish_keyed(self.table_id, ids, vals, option)
+        ids, vals = self._aggregate_keyed(ids, vals)
+        self._dispatch_keyed(ids, vals, option)
+        return self._add_handle()
 
     def add_keys(self, keys: Any, values: Any,
                  option: Optional[AddOption] = None) -> None:
@@ -101,14 +110,10 @@ class FTRLTable(TableBase):
         zn = np.asarray(out)[:n]
         return zn[:, self.Z], zn[:, self.N]
 
-    def add_keys(self, keys: Any, delta_z: Any, delta_n: Any) -> None:
-        """Accumulate ``FTRLGradient{delta_z, delta_n}`` per key."""
-        ids = np.asarray(keys, dtype=np.int32).ravel()
-        vals = np.stack([
-            np.asarray(delta_z, dtype=self.dtype).ravel(),
-            np.asarray(delta_n, dtype=self.dtype).ravel(),
-        ], axis=1)
-        ids, vals = self._aggregate_keyed(ids, vals)
+    def _dispatch_keyed(self, ids: np.ndarray, vals: np.ndarray,
+                        option=None) -> None:
+        ids = np.asarray(ids, dtype=np.int32).ravel()
+        vals = np.asarray(vals, dtype=self.dtype).reshape(ids.shape[0], 2)
         n = ids.shape[0]
         size = _rowops.bucket_size(n)
         padded_ids, mask = _rowops.pad_ids(ids, n, size)
@@ -117,4 +122,17 @@ class FTRLTable(TableBase):
             self._data = self._key_apply(
                 self._data, jnp.asarray(padded_ids), jnp.asarray(padded_vals),
                 jnp.asarray(mask))
+
+    def add_keys(self, keys: Any, delta_z: Any, delta_n: Any) -> None:
+        """Accumulate ``FTRLGradient{delta_z, delta_n}`` per key."""
+        ids = np.asarray(keys, dtype=np.int32).ravel()
+        vals = np.stack([
+            np.asarray(delta_z, dtype=self.dtype).ravel(),
+            np.asarray(delta_n, dtype=self.dtype).ravel(),
+        ], axis=1)
+        bus = self._sess.async_bus
+        if bus is not None:
+            bus.publish_keyed(self.table_id, ids, vals, None)
+        ids, vals = self._aggregate_keyed(ids, vals)
+        self._dispatch_keyed(ids, vals)
         jax.block_until_ready(self._data)

@@ -46,10 +46,28 @@ class Topology:
     process_index: int
     process_count: int
     devices: List["jax.Device"] = field(default_factory=list)
+    _local_mesh: Optional["jax.sharding.Mesh"] = None
 
     @property
     def num_workers(self) -> int:
         return int(self.mesh.shape[WORKER_AXIS])
+
+    @property
+    def local_mesh(self) -> "jax.sharding.Mesh":
+        """Mesh over THIS process's devices only (worker=1, server=n_local).
+
+        Async-PS tables live here: each process owns an independent replica
+        it can update without collective participation (the global mesh
+        would make every ``device_put``/jit a group-wide collective, which
+        is exactly what async mode must not require). Deltas cross
+        processes via ``parallel.async_ps``, not via array sharding.
+        """
+        if self._local_mesh is None:
+            local = [d for d in self.devices
+                     if d.process_index == self.process_index]
+            self._local_mesh = make_mesh(
+                (1, len(local)), devices=local)
+        return self._local_mesh
 
     @property
     def num_servers(self) -> int:

@@ -1,0 +1,104 @@
+"""Remote stream backend (VERDICT r1 item 6): gs:// and memory:// openers
+behind the scheme registry, wired through checkpoint save/restore.
+
+The hermetic double for GCS is tensorstore's in-process memory driver —
+the same KvStore code path as the ``gcs`` driver, no network (mirrors the
+reference testing HDFS streams against local files)."""
+
+import numpy as np
+import pytest
+
+
+def test_memory_stream_round_trip():
+    from multiverso_tpu.io.stream import open_stream, read_array, write_array
+
+    uri = "memory://bucket/dir/rec.bin"
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    with open_stream(uri, "wb") as s:
+        write_array(s, arr)
+    with open_stream(uri, "rb") as s:
+        got = read_array(s)
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_memory_text_reader():
+    from multiverso_tpu.io.stream import TextReader, open_stream
+
+    uri = "memory://bucket/corpus.txt"
+    with open_stream(uri, "wb") as s:
+        s.write(b"hello world\nsecond line\n")
+    with TextReader(uri) as reader:
+        assert list(reader) == ["hello world", "second line"]
+
+
+def test_remote_missing_object_raises():
+    from multiverso_tpu.io.stream import open_stream
+
+    with pytest.raises(FileNotFoundError):
+        open_stream("memory://bucket/nope.bin", "rb")
+
+
+def test_remote_exists_probe():
+    from multiverso_tpu.io import remote
+    from multiverso_tpu.io.stream import open_stream
+
+    assert not remote.exists("memory://bucket/p.bin")
+    with open_stream("memory://bucket/p.bin", "wb") as s:
+        s.write(b"x")
+    assert remote.exists("memory://bucket/p.bin")
+
+
+def test_gs_uri_maps_to_gcs_driver():
+    """gs:// parses to the tensorstore gcs driver spec (no network)."""
+    from multiverso_tpu.io.remote import _kvstore_for
+    from multiverso_tpu.io.stream import URI
+
+    store, key = _kvstore_for(URI("gs://my-bucket/ckpt/step_1/m.json"))
+    spec = store.spec().to_json()
+    assert spec["driver"] == "gcs"
+    assert spec["bucket"] == "my-bucket"
+    assert key == "ckpt/step_1/m.json"
+
+
+def test_checkpoint_save_restore_remote(mv_session):
+    """Checkpoint round trip through the remote scheme end-to-end."""
+    from multiverso_tpu.io import checkpoint
+
+    mv = mv_session
+    t = mv.create_table("array", 24)
+    t.add(np.arange(24, dtype=np.float32))
+    m = mv.create_table("matrix", 5, 3)
+    m.add_rows([1, 4], np.full((2, 3), 2.5, np.float32))
+
+    uri = "memory://ckpts/step_000003"
+    checkpoint.save(uri)
+
+    # clobber, then restore from the object store
+    t.add(np.full(24, 100.0, np.float32))
+    m.add(np.ones((5, 3), np.float32))
+    checkpoint.restore(uri)
+
+    np.testing.assert_allclose(t.get(), np.arange(24, dtype=np.float32))
+    want = np.zeros((5, 3), np.float32)
+    want[[1, 4]] = 2.5
+    np.testing.assert_allclose(m.get(), want)
+
+
+def test_autosaver_remote_root_prune_and_restore_latest(mv_session):
+    """Autosaver + restore_latest against an object-store root: step
+    listing, manifest-commit atomicity, and pruning all work remotely."""
+    from multiverso_tpu.io import checkpoint, remote
+
+    mv = mv_session
+    t = mv.create_table("array", 8)
+    root = "memory://asave/ckpts"
+    saver = checkpoint.Autosaver(root, every_steps=1, keep=2)
+    for step in (1, 2, 3):
+        t.add(np.ones(8, np.float32))
+        assert saver.step(step)
+    assert checkpoint.list_steps(root) == [2, 3]   # pruned to keep=2
+    assert not remote.exists(root + "/step_1/manifest.json")
+
+    t.add(np.full(8, 50.0, np.float32))
+    assert checkpoint.restore_latest(root) == 3
+    np.testing.assert_allclose(t.get(), 3.0)

