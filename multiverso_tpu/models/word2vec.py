@@ -80,6 +80,15 @@ class Word2VecConfig:
     # row); False = reference-equivalent sum always. Falsy when a Word2Vec
     # is built directly without resolution, i.e. reference semantics.
     row_mean_updates: Optional[bool] = None
+    # scatter-apply strategy for the embedding updates:
+    #   "scatter"  — XLA scatter-add straight into the (bf16) table;
+    #   "segsum"   — segment-sum the updates into a dense f32 delta, then
+    #                one vector add (collision-free; wins when the rows
+    #                are zipf-hot and the scatter serialises on duplicates);
+    #   "split8"   — 8 shadow copies indexed by update position % 8, then
+    #                summed (caps any row's collision chain at N/8).
+    # Measured on-chip by tools/w2v_profile.py; default picked by it.
+    update_impl: str = "scatter"
     # with row_mean_updates: per-row update = mean-grad * min(count, cap).
     # cap bounds how much a hot row can move per batch — rows with <= cap
     # collisions keep the reference's sequential-sum movement exactly;
@@ -249,8 +258,23 @@ class Word2Vec:
         batch_sharding = NamedSharding(mesh, P(WORKER_AXIS))
         emb_sharding = self.input_table.sharding
 
-        def apply_sgd(w, rows, grads, lr):
-            return w.at[rows].add((-lr * grads).astype(w.dtype))
+        impl = cfg.update_impl
+
+        def apply_sgd(w, rows, grads, lr, scale=None):
+            upd = -lr * grads if scale is None \
+                else (-lr) * scale[:, None] * grads
+            if impl == "segsum":
+                dense = jax.ops.segment_sum(upd, rows,
+                                            num_segments=w.shape[0])
+                return (w.astype(jnp.float32) + dense).astype(w.dtype)
+            if impl == "split8":
+                R = 8
+                lane = jax.lax.rem(
+                    jnp.arange(rows.shape[0], dtype=jnp.int32), R)
+                shadow = jnp.zeros((R,) + w.shape, jnp.float32)
+                shadow = shadow.at[lane, rows].add(upd)
+                return (w.astype(jnp.float32) + shadow.sum(0)).astype(w.dtype)
+            return w.at[rows].add(upd.astype(w.dtype))
 
         def apply_adagrad(w, g_acc, rows, grads, lr):
             g_rows = jnp.take(g_acc, rows, axis=0) + grads * grads
@@ -298,7 +322,16 @@ class Word2Vec:
                 loss = loss + ((jax.nn.softplus(s_pos) - s_pos)
                                * ex_mask).sum()
                 grad_h = grad_h + g_pos[:, None] * u_pos
-                scatters.append((target_word, g_pos[:, None] * h,
+                # scatter-bound grads are emitted in the TABLE dtype when
+                # that is rounding-equivalent: the plain-SGD scatter converts
+                # each update to it before adding anyway, and a bf16 [N, D]
+                # buffer halves the dominant HBM traffic of the update path.
+                # NOT equivalent for AdaGrad (consumes grads in f32 math) or
+                # shared negatives (G-group contraction must accumulate f32).
+                exact_cast = not cfg.use_adagrad and G == 1
+                scat_dt = w_out.dtype if exact_cast else jnp.float32
+                scatters.append((target_word,
+                                 (g_pos[:, None] * h).astype(scat_dt),
                                  ex_mask))
                 # negatives: [B/G, K, D] rows (per-pair when G == 1)
                 u_neg = jnp.take(w_out, negs, axis=0)            # [B/G, K, D]
@@ -322,7 +355,7 @@ class Word2Vec:
                     (B // G, cfg.negative)).reshape(-1)
                 scatters.append((negs.reshape(-1), jnp.einsum(
                     "gbk,gbd->gkd", g_neg, hg,
-                    preferred_element_type=jnp.float32).reshape(-1, D),
+                    preferred_element_type=scat_dt).reshape(-1, D),
                     occ_neg))
             if cfg.hs:
                 nodes = jnp.take(self._paths, target_word, axis=0)   # [B, L]
@@ -368,23 +401,51 @@ class Word2Vec:
             c = jnp.maximum(jnp.take(counts, rows, axis=0), 1.0)
             return grads * (jnp.minimum(c, cap) / c)[:, None]
 
+        def _row_scale_vec(counts, rows):
+            """[N] multiplier form of ``_row_scale`` — handed to apply_sgd
+            so the rescale fuses into the scatter operand's elementwise
+            chain instead of materialising a second [N, D] grads pass
+            (measured ~35%% of the step at the bench shape)."""
+            cap = max(float(cfg.row_update_cap), 1.0)
+            c = jnp.maximum(jnp.take(counts, rows, axis=0), 1.0)
+            return jnp.minimum(c, cap) / c
+
         def apply_updates(w_in, w_out, g_in, g_out, in_rows, in_grads,
                           in_occ, scatters, lr):
+            in_scale = out_counts = None
             if cfg.row_mean_updates:
                 in_counts = _row_counts([(in_rows, in_occ)])
-                in_grads = _row_scale(in_counts, in_rows, in_grads)
                 out_counts = _row_counts(
                     [(rows, occ) for rows, _, occ in scatters])
-                scatters = [(rows, _row_scale(out_counts, rows, grads), occ)
-                            for rows, grads, occ in scatters]
+                if cfg.use_adagrad:
+                    # adagrad consumes scaled grads twice (G accumulation +
+                    # update): materialise once
+                    in_grads = _row_scale(in_counts, in_rows, in_grads)
+                    scatters = [
+                        (rows, _row_scale(out_counts, rows, grads), occ)
+                        for rows, grads, occ in scatters]
+                else:
+                    in_scale = _row_scale_vec(in_counts, in_rows)
             if cfg.use_adagrad:
                 w_in, g_in = apply_adagrad(w_in, g_in, in_rows, in_grads, lr)
                 for rows, grads, _ in scatters:
                     w_out, g_out = apply_adagrad(w_out, g_out, rows, grads, lr)
             else:
-                w_in = apply_sgd(w_in, in_rows, in_grads, lr)
-                for rows, grads, _ in scatters:
-                    w_out = apply_sgd(w_out, rows, grads, lr)
+                w_in = apply_sgd(w_in, in_rows, in_grads, lr, in_scale)
+                if len(scatters) > 1 and impl in ("segsum", "split8"):
+                    # dense impls pay per-pass table traffic: combine sets.
+                    # (for the scatter impl the concat's extra [N, D]
+                    # materialisation costs more than the second scatter)
+                    rows = jnp.concatenate([s[0] for s in scatters])
+                    grads = jnp.concatenate([s[1] for s in scatters])
+                    scale = (None if out_counts is None
+                             else _row_scale_vec(out_counts, rows))
+                    w_out = apply_sgd(w_out, rows, grads, lr, scale)
+                else:
+                    for rows, grads, _ in scatters:
+                        scale = (None if out_counts is None
+                                 else _row_scale_vec(out_counts, rows))
+                        w_out = apply_sgd(w_out, rows, grads, lr, scale)
             return w_in, w_out, g_in, g_out
 
         if not cfg.cbow:
@@ -522,23 +583,17 @@ class Word2Vec:
                 for a in arrays)
             return packed + (jnp.arange(B) < n_valid,)
 
-        def fused(w_in, w_out, g_in, g_out, corpus, sents, discard, lr, key,
-                  start0):
+        def fused(w_in, w_out, g_in, g_out, ext_ids, ext_sents, ext_disc,
+                  lr, key, start0):
             """Sequential corpus streaming (the reference reads sentences in
             order — ``WE/src/reader.cpp``): each step consumes the next M
             corpus positions as centers, so every word lookup is a contiguous
             slice instead of a scalar gather. The per-pair window offset is
             resolved by selecting among the 2W statically-shifted copies of
-            the slab — pure vector ops, no gathers.
+            the slab — pure vector ops, no gathers. The wrap-around-extended
+            buffers are precomputed once per chunk (``load_corpus_chunk``).
             """
-            n = corpus.shape[0]
-            # wrap-around extension: any start in [0, n) can slice M + 2W
-            ext_ids = jnp.concatenate([corpus[-W:], corpus, corpus[:M + W]])
-            ext_sents = jnp.concatenate([sents[-W:], sents, sents[:M + W]])
-            # per-position discard prob: ONE O(n) gather per fused call,
-            # amortized over all S batches
-            dpos = jnp.take(discard, corpus, axis=0)
-            ext_disc = jnp.concatenate([dpos[-W:], dpos, dpos[:M + W]])
+            n = ext_ids.shape[0] - M - 2 * W
 
             # ---- bulk RNG: ONE vectorized draw for all S batches ----
             key, k1, k2, k3, k4, k5 = jax.random.split(key, 6)
@@ -719,15 +774,37 @@ class Word2Vec:
         if discard is None:
             discard = np.zeros(self.config.vocab_size, np.float32)
         self._discard = jnp.asarray(discard, jnp.float32)
+        # Hoist the wrap-around extension + per-position discard gather out
+        # of the fused step: they are O(corpus) and depend only on the chunk
+        # (profiled at ~13 ms/dispatch on a 2M-token chunk — pure waste when
+        # re-done every call).
+        n = int(self._corpus.shape[0])
+        M = self._candidate_batch(n)
+        W = self.config.window
+
+        def _ext(corpus, sents, discard):
+            dpos = jnp.take(discard, corpus, axis=0)
+            return (
+                jnp.concatenate([corpus[-W:], corpus, corpus[:M + W]]),
+                jnp.concatenate([sents[-W:], sents, sents[:M + W]]),
+                jnp.concatenate([dpos[-W:], dpos, dpos[:M + W]]),
+            )
+
+        self._ext_bufs = jax.jit(_ext)(self._corpus, self._sents,
+                                       self._discard)
+        # the originals are folded into the ext buffers; keeping them would
+        # pin a second copy of the corpus in HBM for the model's lifetime
+        self._corpus_len = n
+        del self._corpus, self._sents, self._discard
 
     def train_device_steps(self, n_steps: int) -> Tuple[Any, Any]:
         """Run ``n_steps`` sample+train iterations on device in one dispatch.
 
         Returns (mean_loss, pairs_trained) as async jax scalars.
         """
-        if not hasattr(self, "_corpus"):
+        if not hasattr(self, "_ext_bufs"):
             Log.fatal("call load_corpus_chunk() before train_device_steps()")
-        n = int(self._corpus.shape[0])
+        n = self._corpus_len
         M = self._candidate_batch(n)
         fused = getattr(self, "_fused_cache", {}).get((n_steps, M))
         if fused is None:
@@ -745,7 +822,7 @@ class Word2Vec:
             (self.input_table._data, self.output_table._data,
              g_in, g_out, loss, count, self._key) = fused(
                 self.input_table._data, self.output_table._data,
-                g_in, g_out, self._corpus, self._sents, self._discard,
+                g_in, g_out, *self._ext_bufs,
                 lr, self._key, jnp.int32(start0))
         if cfg.use_adagrad:
             self._g_in, self._g_out = g_in, g_out
