@@ -23,7 +23,8 @@ from .dashboard import Dashboard, Monitor, Timer, monitor, profile_trace
 from .log import Log, LogLevel, check, check_notnull
 from .quantization import SparseFilter
 from .runtime import Session
-from .topology import SERVER_AXIS, SEQ_AXIS, WORKER_AXIS, make_mesh, sharding_for
+from .topology import (SERVER_AXIS, SEQ_AXIS, WORKER_AXIS, make_mesh,
+                       net_bind, net_connect, sharding_for)
 
 __version__ = "0.1.0"
 

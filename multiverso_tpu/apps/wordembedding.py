@@ -34,6 +34,9 @@ from ..models.word2vec import (HuffmanCodes, Word2Vec, Word2VecConfig,
                                build_huffman)
 
 
+_INFREQUENT_BUCKET = "WE_ARE_THE_INFREQUENT_WORDS"
+
+
 class Dictionary:
     """Vocab with counts + id mapping (reference ``WE/src/dictionary.cpp``)."""
 
@@ -42,6 +45,81 @@ class Dictionary:
         self.word2id = {}
         self.words: List[str] = []
         self.counts: List[int] = []
+        self._whitelist: set = set()
+
+    # -- reference dictionary extras (dictionary.h:42-62) ------------------
+    def set_whitelist(self, words) -> None:
+        """Words exempt from frequency pruning/merging (``SetWhiteList``)."""
+        self._whitelist = set(words)
+
+    def insert(self, word: str, count: int = 1) -> None:
+        """``Insert``: accumulate a word-count pair."""
+        idx = self.word2id.get(word)
+        if idx is None:
+            self.word2id[word] = len(self.words)
+            self.words.append(word)
+            self.counts.append(int(count))
+        else:
+            self.counts[idx] += int(count)
+
+    def remove_words_less_than(self, min_count: int) -> None:
+        """Drop sub-threshold words (``RemoveWordsLessThan``); whitelisted
+        and zero-freq entries survive, like the reference."""
+        kept = [(w, c) for w, c in zip(self.words, self.counts)
+                if c >= min_count or c == 0 or w in self._whitelist]
+        self.word2id = {w: i for i, (w, _) in enumerate(kept)}
+        self.words = [w for w, _ in kept]
+        self.counts = [c for _, c in kept]
+
+    def merge_infrequent_words(self, threshold: int) -> None:
+        """Collapse sub-threshold words into ONE shared bucket id
+        (``MergeInfrequentWords``, ``dictionary.cpp:26-51``): rare words
+        keep training signal through a shared embedding row instead of
+        being dropped."""
+        new_words: List[str] = []
+        new_counts: List[int] = []
+        new_map: dict = {}
+        infreq_idx = -1
+        for word, count in zip(self.words, self.counts):
+            if count >= threshold or count == 0 or word in self._whitelist:
+                new_map[word] = len(new_words)
+                new_words.append(word)
+                new_counts.append(count)
+            else:
+                if infreq_idx < 0:
+                    infreq_idx = len(new_words)
+                    new_map[_INFREQUENT_BUCKET] = infreq_idx
+                    new_words.append(_INFREQUENT_BUCKET)
+                    new_counts.append(0)
+                new_map[word] = infreq_idx
+                new_counts[infreq_idx] += count
+        self.words, self.counts, self.word2id = new_words, new_counts, new_map
+
+    def load_tri_letter(self, path: str, min_count: int = 1,
+                        letter_count: int = 3, combine: bool = False) -> None:
+        """Tri-letter-gram vocabulary from a word-count file
+        (``LoadTriLetterFromFile``, ``dictionary.cpp:95-140``): each word
+        becomes ``#word#`` character n-grams (the DSSM trick); ``combine``
+        also inserts the surface word."""
+        with TextReader(path) as reader:
+            for line in reader:
+                parts = line.split()
+                if len(parts) != 2:
+                    continue
+                try:
+                    word, count = parts[0], int(parts[1])
+                except ValueError:
+                    continue
+                if count < min_count:
+                    continue
+                if combine:
+                    self.insert(word, count)
+                hashed = f"#{word}#"
+                if len(hashed) <= letter_count:
+                    self.insert(hashed, count)
+                else:
+                    for i in range(len(hashed) - letter_count + 1):
+                        self.insert(hashed[i:i + letter_count], count)
 
     @classmethod
     def build(cls, corpus_path: str, min_count: int = 5) -> "Dictionary":
@@ -320,6 +398,64 @@ class TrainResult:
 _DEVICE_CORPUS_MAX_TOKENS = 1 << 27   # 128M tokens ≈ 1 GB of ids in HBM
 
 
+class _AsyncDeltaPusher:
+    """``AddDeltaParameter`` over the async bus (``WE/src/communicator.cpp:194``).
+
+    The fused training steps mutate the LOCAL table replica directly, so in
+    async multi-process mode nothing would cross processes; this periodically
+    publishes each table's OWN-training movement — (current − snapshot)
+    minus the peer deltas the drain thread folded in meanwhile (tracked by
+    ``TableBase._remote_accum``) — and peers apply it like any other Add.
+    Per-worker AdaGrad state stays local, matching the framework's
+    per-worker accumulator semantics.
+    """
+
+    def __init__(self, tables, every_calls: int = 1) -> None:
+        import multiverso_tpu as mv
+        from ..updaters import AddOption
+
+        self.bus = mv.session().async_bus
+        self.active = self.bus is not None
+        if not self.active:
+            return
+        self._option = AddOption(worker_id=max(mv.worker_id(), 0))
+        self.every = max(1, int(every_calls))
+        self.calls = 0
+        self.tables = list(tables)
+        self._snaps = []
+        for t in self.tables:
+            if t.updater.name != "default":
+                Log.fatal("async delta pusher requires the default "
+                          "(accumulate) updater on its tables; "
+                          f"{t.name!r} has {t.updater.name!r}")
+            with t._lock:
+                self._snaps.append(np.asarray(t.get(), np.float32).copy())
+                t._remote_accum = np.zeros(t.shape, np.float32)
+
+    def tick(self, force: bool = False) -> None:
+        if not self.active:
+            return
+        self.calls += 1
+        if not force and self.calls % self.every:
+            return
+        for i, t in enumerate(self.tables):
+            with t._lock:   # atomic vs the drain thread (RLock: get() nests)
+                cur = np.asarray(t.get(), np.float32)
+                own = cur - self._snaps[i] - t._remote_accum
+                t._remote_accum[...] = 0.0
+                self._snaps[i] = cur
+            self.bus.publish_dense(t.table_id, own.astype(t.dtype),
+                                   self._option)
+
+    def close(self) -> None:
+        if not self.active:
+            return
+        self.tick(force=True)
+        for t in self.tables:
+            with t._lock:
+                t._remote_accum = None
+
+
 def train(
     corpus_path: str,
     output_path: Optional[str] = None,
@@ -428,94 +564,110 @@ def train(
                      f"{_DEVICE_CORPUS_MAX_TOKENS}-token auto budget; "
                      f"use device_corpus=False to stream instead")
 
-    if device_corpus:
-        # -- device-resident fast path: corpus in HBM, sampling + training
-        #    fused into multi-step dispatches --------------------------------
-        # fast-path defaults: fuse many steps per dispatch and oversample
-        # candidates unless the caller chose otherwise (cfg is read lazily
-        # by the fused builder, so this runs before any compilation)
-        if cfg.steps_per_call <= 1 and not explicit_spc:
-            cfg.steps_per_call = 32
-        if cfg.oversample <= 1 and not explicit_ovs:
-            cfg.oversample = 2.5
-        discard = subsample_probs(counts, sample).astype(np.float32)
-        model.load_corpus_chunk(ids, sent_ids, discard)
-        n = int(ids.shape[0])
-        spc = cfg.steps_per_call
-        m_per_step = model._candidate_batch(n)
-        # The device sampler draws ONE (center, context) pair per corpus
-        # position per pass; the reference trains every word in the shrunk
-        # window (expected window+1 pairs per center,
-        # ``wordembedding.cpp:214``). Scale passes so one "epoch" trains
-        # the reference's pair count. CBOW is one example per center.
-        pair_factor = 1 if cfg.cbow else cfg.window + 1
-        calls_per_epoch = max(1, -(-(n * pair_factor) // (spc * m_per_step)))
-        for epoch in range(epochs):
-            done = 0.0   # running pair count, synced once per log point
-            pending_counts = []
-            for call in range(calls_per_epoch):
-                mon.begin()
-                loss, count = model.train_device_steps(spc)
-                mon.end()
-                pending_counts.append(count)
-                if log_every and (call + 1) % log_every == 0:
-                    done += float(np.sum([float(c) for c in pending_counts]))
-                    pending_counts = []
-                    elapsed = time.perf_counter() - t0
-                    Log.info(
-                        "epoch %d call %d: %.0f pairs/sec, lr %.5f, "
-                        "loss %.4f", epoch, call + 1,
-                        (pairs + done) / elapsed, model.current_lr(),
-                        float(loss))
-            done += float(np.sum([float(c) for c in pending_counts]))
-            pairs += int(done)
-            wordcount_table.add([0], [dictionary.train_words])
-            mv.barrier()
-        mode = " [device corpus]"
-    else:
-        group = max(1, cfg.steps_per_call)
-        from ..parallel import prefetch_iterator
+    # async multi-process: publish own-training deltas every
+    # -sync_frequency calls (reference AddDeltaParameter cadence); inactive
+    # single-process / sync / ma
+    pusher = _AsyncDeltaPusher(
+        [input_table, output_table],
+        every_calls=max(1, int(mv.get_flag("sync_frequency"))))
 
-        for epoch in range(epochs):
-            progress = {"words": 0}
-            # loader-thread overlap: batch generation runs ahead on a thread
-            batches = prefetch_iterator(
-                iter_pair_batches(corpus_path, dictionary, cfg.window,
-                                  cfg.batch_size, sample=sample,
-                                  cbow=cfg.cbow, seed=cfg.seed + epoch,
-                                  progress=progress),
-                depth=2 * group)
-            pending = []
-            for step_idx, batch in enumerate(batches):
-                pending.append(batch)
-                if len(pending) < group:
-                    continue
-                mon.begin()
-                if group == 1:
-                    loss = model.train_batch(*pending[0])
-                else:
-                    loss = model.train_batches(
-                        np.stack([b[0] for b in pending]),
-                        np.stack([b[1] for b in pending]),
-                        np.stack([b[2] for b in pending]))
-                pairs += sum(batch_examples(b[2]) for b in pending)
+    try:
+        if device_corpus:
+            # -- device-resident fast path: corpus in HBM, sampling + training
+            #    fused into multi-step dispatches --------------------------------
+            # fast-path defaults: fuse many steps per dispatch and oversample
+            # candidates unless the caller chose otherwise (cfg is read lazily
+            # by the fused builder, so this runs before any compilation)
+            if cfg.steps_per_call <= 1 and not explicit_spc:
+                cfg.steps_per_call = 32
+            if cfg.oversample <= 1 and not explicit_ovs:
+                cfg.oversample = 2.5
+            discard = subsample_probs(counts, sample).astype(np.float32)
+            model.load_corpus_chunk(ids, sent_ids, discard)
+            n = int(ids.shape[0])
+            spc = cfg.steps_per_call
+            m_per_step = model._candidate_batch(n)
+            # The device sampler draws ONE (center, context) pair per corpus
+            # position per pass; the reference trains every word in the shrunk
+            # window (expected window+1 pairs per center,
+            # ``wordembedding.cpp:214``). Scale passes so one "epoch" trains
+            # the reference's pair count. CBOW is one example per center.
+            pair_factor = 1 if cfg.cbow else cfg.window + 1
+            calls_per_epoch = max(1, -(-(n * pair_factor) // (spc * m_per_step)))
+            for epoch in range(epochs):
+                done = 0.0   # running pair count, synced once per log point
+                pending_counts = []
+                for call in range(calls_per_epoch):
+                    mon.begin()
+                    loss, count = model.train_device_steps(spc)
+                    mon.end()
+                    pusher.tick()
+                    pending_counts.append(count)
+                    if log_every and (call + 1) % log_every == 0:
+                        done += float(np.sum([float(c) for c in pending_counts]))
+                        pending_counts = []
+                        elapsed = time.perf_counter() - t0
+                        Log.info(
+                            "epoch %d call %d: %.0f pairs/sec, lr %.5f, "
+                            "loss %.4f", epoch, call + 1,
+                            (pairs + done) / elapsed, model.current_lr(),
+                            float(loss))
+                done += float(np.sum([float(c) for c in pending_counts]))
+                pairs += int(done)
+                wordcount_table.add([0], [dictionary.train_words])
+                pusher.tick(force=True)
+                mv.barrier()   # quiesces the bus: all epoch deltas land
+            mode = " [device corpus]"
+        else:
+            group = max(1, cfg.steps_per_call)
+            from ..parallel import prefetch_iterator
+
+            for epoch in range(epochs):
+                progress = {"words": 0}
+                # loader-thread overlap: batch generation runs ahead on a thread
+                batches = prefetch_iterator(
+                    iter_pair_batches(corpus_path, dictionary, cfg.window,
+                                      cfg.batch_size, sample=sample,
+                                      cbow=cfg.cbow, seed=cfg.seed + epoch,
+                                      progress=progress),
+                    depth=2 * group)
                 pending = []
-                mon.end()
-                # exact lr-decay progress in word units (reference word_count)
-                model.set_words_trained(
-                    epoch * dictionary.train_words + progress["words"])
-                if log_every and (step_idx + 1) % log_every == 0:
-                    elapsed = time.perf_counter() - t0
-                    Log.info(
-                        "epoch %d step %d: %.0f pairs/sec, lr %.5f, "
-                        "loss %.4f", epoch, step_idx + 1, pairs / elapsed,
-                        model.current_lr(), float(loss))
-            for centers, contexts, mask in pending:  # tail, one dispatch each
-                loss = model.train_batch(centers, contexts, mask)
-                pairs += batch_examples(mask)
-            wordcount_table.add([0], [dictionary.train_words])
-            mv.barrier()
-        mode = ""
+                for step_idx, batch in enumerate(batches):
+                    pending.append(batch)
+                    if len(pending) < group:
+                        continue
+                    mon.begin()
+                    if group == 1:
+                        loss = model.train_batch(*pending[0])
+                    else:
+                        loss = model.train_batches(
+                            np.stack([b[0] for b in pending]),
+                            np.stack([b[1] for b in pending]),
+                            np.stack([b[2] for b in pending]))
+                    pairs += sum(batch_examples(b[2]) for b in pending)
+                    pending = []
+                    mon.end()
+                    pusher.tick()
+                    # exact lr-decay progress in word units (reference word_count)
+                    model.set_words_trained(
+                        epoch * dictionary.train_words + progress["words"])
+                    if log_every and (step_idx + 1) % log_every == 0:
+                        elapsed = time.perf_counter() - t0
+                        Log.info(
+                            "epoch %d step %d: %.0f pairs/sec, lr %.5f, "
+                            "loss %.4f", epoch, step_idx + 1, pairs / elapsed,
+                            model.current_lr(), float(loss))
+                for centers, contexts, mask in pending:  # tail, one dispatch each
+                    loss = model.train_batch(centers, contexts, mask)
+                    pairs += batch_examples(mask)
+                wordcount_table.add([0], [dictionary.train_words])
+                pusher.tick(force=True)
+                mv.barrier()   # quiesces the bus: all epoch deltas land
+            mode = ""
+    finally:
+        # always detach the remote accumulators (unbounded growth if
+        # left installed after a failed run)
+        pusher.close()
 
     final_loss = float(loss)
     elapsed = time.perf_counter() - t0

@@ -326,9 +326,12 @@ class Word2Vec:
                 # that is rounding-equivalent: the plain-SGD scatter converts
                 # each update to it before adding anyway, and a bf16 [N, D]
                 # buffer halves the dominant HBM traffic of the update path.
-                # NOT equivalent for AdaGrad (consumes grads in f32 math) or
-                # shared negatives (G-group contraction must accumulate f32).
-                exact_cast = not cfg.use_adagrad and G == 1
+                # NOT equivalent for AdaGrad (consumes grads in f32 math),
+                # shared negatives (G-group contraction must accumulate
+                # f32), or row-mean (the per-row scale multiplies AFTER,
+                # which would double-round).
+                exact_cast = (not cfg.use_adagrad and G == 1
+                              and not cfg.row_mean_updates)
                 scat_dt = w_out.dtype if exact_cast else jnp.float32
                 scatters.append((target_word,
                                  (g_pos[:, None] * h).astype(scat_dt),
@@ -818,6 +821,9 @@ class Word2Vec:
         g_out = self._g_out if cfg.use_adagrad else None
         start0 = getattr(self, "_stream_pos", 0) % n
         self._stream_pos = (start0 + n_steps * M) % n
+        # read-and-rebind of table state stays under BOTH table locks so a
+        # concurrent async-PS drain apply can never land between the read
+        # and the rebind (it would be silently overwritten)
         with self.input_table._lock, self.output_table._lock:
             (self.input_table._data, self.output_table._data,
              g_in, g_out, loss, count, self._key) = fused(

@@ -237,7 +237,7 @@ class AsyncDeltaBus:
             # the publisher staged the delta in the table dtype, so the
             # receiving replica's table dtype IS the wire value dtype
             flat = self._filter_for(table.dtype).filter_out(arrays)[0]
-            table._apply_dense(flat.reshape(table.shape), option)
+            table._apply_remote_dense(flat.reshape(table.shape), option)
         elif kind == KEYED:
             table._dispatch_keyed(arrays[0], arrays[1], option)
         elif kind == KV:

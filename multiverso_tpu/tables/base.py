@@ -253,6 +253,18 @@ class TableBase:
         return out_i, out_v
 
     # -- delta application -------------------------------------------------
+    def _apply_remote_dense(self, host: np.ndarray, option: AddOption) -> None:
+        """Bus entry point for a peer's dense delta. Besides applying it,
+        feed the optional remote-delta accumulator apps use to separate
+        their OWN training movement from peers' contributions when they
+        train on the replica directly (``apps/wordembedding``'s
+        AddDeltaParameter equivalent)."""
+        with self._lock:
+            accum = getattr(self, "_remote_accum", None)
+            if accum is not None:
+                accum += np.asarray(host, accum.dtype)
+            self._apply_dense(host, option)
+
     def _apply_dense(self, host: np.ndarray, option: AddOption) -> None:
         """Fold a logical-shape host delta into the replica (jitted updater
         step on the sharded state). Shared by local Adds and the async-PS

@@ -120,15 +120,70 @@ def make_mesh(
     return Mesh(grid, axis_names=tuple(axis_names))
 
 
+# Explicit net bootstrap state (net_bind/net_connect), consulted before the
+# env vars by _maybe_init_distributed.
+_explicit_net: Dict[str, object] = {}
+
+
+def net_bind(rank: int, endpoint: str) -> None:
+    """Declare THIS process's rank and endpoint (``MV_NetBind``,
+    ``include/multiverso/multiverso.h:43-62`` — the reference's MPI-free
+    ZMQ deployment mode, where a machine file / explicit bind+connect
+    replaces mpirun).
+
+    Call before :func:`multiverso_tpu.init`, paired with
+    :func:`net_connect`. In this framework the transport is the JAX
+    coordination service, so binding reduces to declaring identity; the
+    per-rank data endpoints of the reference collapse into the single
+    coordinator endpoint (rank 0's).
+    """
+    _explicit_net["rank"] = int(rank)
+    _explicit_net["endpoint"] = str(endpoint)
+
+
+def net_connect(ranks: Sequence[int], endpoints: Sequence[str]) -> None:
+    """Declare the full group (``MV_NetConnect``): ``endpoints[i]`` is rank
+    ``ranks[i]``'s endpoint; rank 0's endpoint becomes the coordinator.
+    Call before :func:`multiverso_tpu.init` (after :func:`net_bind`)."""
+    ranks = [int(r) for r in ranks]
+    if len(ranks) != len(endpoints):
+        Log.fatal(f"net_connect: {len(ranks)} ranks vs "
+                  f"{len(endpoints)} endpoints")
+    if len(set(ranks)) != len(ranks):
+        Log.fatal(f"net_connect: duplicate ranks in {ranks}")
+    table = dict(zip(ranks, endpoints))
+    if 0 not in table:
+        Log.fatal("net_connect needs rank 0's endpoint (the coordinator)")
+    _explicit_net["num"] = len(table)
+    _explicit_net["coordinator"] = str(table[0])
+
+
 def _maybe_init_distributed() -> None:
-    """Initialise the multi-host process group if the env asks for it.
+    """Initialise the multi-host process group if asked to.
 
     Replaces MPI_Init + rank-0 registration: coordination rides DCN via the
-    JAX coordination service. Single-process runs skip this entirely.
+    JAX coordination service. Bootstrap sources, in order: the explicit
+    net_bind/net_connect API (the reference's machine-file/ZMQ mode), then
+    the MV_*/JAX_* coordinator env vars. Single-process runs skip this.
     """
     # Read the env BEFORE touching any jax API: probing jax.process_count()
     # would itself initialise the local backend, after which
     # jax.distributed.initialize() raises.
+    if "coordinator" in _explicit_net and "rank" in _explicit_net:
+        import jax
+
+        try:
+            jax.distributed.initialize(
+                coordinator_address=_explicit_net["coordinator"],
+                num_processes=int(_explicit_net["num"]),
+                process_id=int(_explicit_net["rank"]),
+            )
+        except RuntimeError as exc:
+            Log.debug("jax.distributed.initialize skipped: %s", exc)
+        Log.info("process group (explicit net): rank %d/%d via %s",
+                 jax.process_index(), jax.process_count(),
+                 _explicit_net["coordinator"])
+        return
     coord = os.environ.get("MV_COORDINATOR_ADDRESS") or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     )

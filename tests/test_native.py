@@ -281,3 +281,21 @@ def test_native_bsparse_matches_python(native_lib, tmp_path):
     open(bad, "wb").write(data[:-4])
     with pytest.raises(IOError):
         native.parse_bsparse(bad)
+
+
+def test_lua_abi_replay():
+    """The Lua binding's full ABI call sequence (binding/lua/test.lua)
+    replayed by a C driver against the shared library — the executable
+    stand-in for the binding until a Lua interpreter exists here."""
+    import subprocess
+
+    binary = os.path.join(REPO, "cpp", "lua_abi_replay")
+    if not os.path.exists(binary):
+        build = subprocess.run(["make", "-s", "lua_abi_replay"],
+                               cwd=os.path.join(REPO, "cpp"),
+                               capture_output=True, text=True)
+        assert build.returncode == 0, build.stderr[-2000:]
+    result = subprocess.run([binary], capture_output=True, text=True,
+                            timeout=120)
+    assert result.returncode == 0, (result.stdout, result.stderr)
+    assert "lua ABI replay: OK" in result.stdout

@@ -31,3 +31,22 @@ def test_all_modes_run():
         result = _run(mode)
         assert result.returncode == 0, (mode, result.stderr[-2000:])
         assert "ms/round" in result.stdout, (mode, result.stdout)
+
+
+def test_lightlda_mode_runs():
+    """LightLDA-style sparse workload (BASELINE config 4 shape, shrunk):
+    dirty-row filtered pulls + per-worker pushes with count conservation."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import sys, runpy; sys.argv = ['perf_tables', 'lightlda', "
+        "'-rows=512', '-cols=8', '-rounds=2', '-workers=2', "
+        "'-doc_words=64']; "
+        "runpy.run_path('tools/perf_tables.py', run_name='__main__')"
+    )
+    result = subprocess.run([sys.executable, "-c", code], cwd=_REPO, env=env,
+                            capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "filtered pull:" in result.stdout
+    assert "probe: +0.0" in result.stdout, result.stdout

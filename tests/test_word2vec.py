@@ -377,3 +377,44 @@ def test_shared_negatives_batch_divisibility(mv_session):
     w_out = mv.create_table("matrix", 8, 4)
     with pytest.raises(FatalError):
         Word2Vec(cfg, w_in, w_out, counts=np.ones(8))
+
+
+def test_dictionary_extras(tmp_path):
+    """Reference dictionary extras (dictionary.h:42-62): whitelist,
+    infrequent-word merging, tri-letter loading."""
+    from multiverso_tpu.apps.wordembedding import (_INFREQUENT_BUCKET,
+                                                   Dictionary)
+
+    d = Dictionary(min_count=1)
+    for word, count in [("the", 100), ("cat", 3), ("sat", 2), ("rare", 1),
+                        ("keepme", 1)]:
+        d.insert(word, count)
+    d.set_whitelist(["keepme"])
+    d.merge_infrequent_words(3)
+    # 'the' and 'cat' survive; 'sat'+'rare' merge into the bucket;
+    # whitelisted 'keepme' survives despite low freq
+    assert d.word2id["the"] != d.word2id["cat"]
+    assert d.word2id["sat"] == d.word2id["rare"] == d.word2id[
+        _INFREQUENT_BUCKET]
+    assert d.counts[d.word2id[_INFREQUENT_BUCKET]] == 3
+    assert "keepme" in d.word2id
+    assert d.encode(["the", "sat", "rare"])[1] == d.encode(["rare"])[0]
+
+    d2 = Dictionary(min_count=1)
+    vocab_file = tmp_path / "wc.txt"
+    vocab_file.write_text("cat 5\nrare 1\n")
+    d2.load_tri_letter(str(vocab_file), min_count=2, letter_count=3)
+    # '#cat#' -> trigrams #ca, cat, at#; 'rare' filtered by min_count
+    assert set(d2.words) == {"#ca", "cat", "at#"}
+    assert all(c == 5 for c in d2.counts)
+
+    d3 = Dictionary(min_count=1)
+    d3.load_tri_letter(str(vocab_file), min_count=1, letter_count=3,
+                       combine=True)
+    assert "rare" in d3.word2id and "#ra" in d3.word2id
+
+    d4 = Dictionary(min_count=1)
+    for word, count in [("a", 5), ("b", 1)]:
+        d4.insert(word, count)
+    d4.remove_words_less_than(2)
+    assert d4.words == ["a"]

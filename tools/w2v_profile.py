@@ -6,8 +6,9 @@ measured fact, not a guess:
 
 * full fused step (sample + train) — the bench.py number;
 * train-only on a fixed batch (no sampler) — isolates the gather/scatter
-  + MXU objective work;
-* sampler-only (no train step) — isolates the corpus sampling machinery;
+  + MXU objective work; the printed "sampler overhead" is the
+  full-minus-train residual (sampling + the dispatch/fusion differences
+  between the two programs);
 * bytes-per-pair roofline vs the chip's HBM bandwidth.
 
 Optionally dumps an xprof trace (``--trace DIR``) via
