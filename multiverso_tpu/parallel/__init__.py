@@ -8,11 +8,15 @@ from .allreduce_engine import AllreduceEngine
 from .async_buffer import ASyncBuffer, PipelinedGetter, prefetch_iterator
 from .collectives import (all_gather, allreduce, allreduce_replicated,
                           reduce_scatter, ring_shift)
+from .health import FailureDetector
 from .pipeline import (STAGE_AXIS, make_pipeline_mesh, microbatch,
                        pipeline_apply, stack_stage_params)
+from .ssp import SSPClock
 from .sync_step import make_sync_step
 
 __all__ = [
+    "SSPClock",
+    "FailureDetector",
     "AllreduceEngine",
     "ASyncBuffer",
     "PipelinedGetter",

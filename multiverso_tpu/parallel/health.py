@@ -1,0 +1,137 @@
+"""Heartbeat failure detection (SURVEY §5.3 — absent in the reference).
+
+The reference has no failure story at all: registration happens once at
+startup, there are no heartbeats, and a dead node hangs the job silently
+(``src/controller.cpp:46-80``; SURVEY: "no heartbeats, no server failover").
+This module provides the detection half of the recovery loop; the repair
+half is checkpoint/resume (``io/checkpoint.restore_latest`` — a restarted
+job reloads the newest complete checkpoint and continues).
+
+Mechanism: every process runs a daemon thread bumping a per-rank heartbeat
+counter in the coordination-service KV store. ``dead_peers()`` reports
+peers whose counter has not advanced within ``timeout_s`` (measured on the
+local clock from the last observed change — no clock sync needed).
+``start_watchdog()`` turns detection into action: a background check that
+invokes a callback (default: ``Log.fatal``) when a peer is declared dead,
+so a lost process fails the job loudly within bounded time instead of
+deadlocking the next collective.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..log import Log
+
+
+class FailureDetector:
+    """Per-process heartbeat publisher + peer liveness monitor."""
+
+    def __init__(self, interval_s: float = 1.0, session=None) -> None:
+        from ..runtime import Session
+
+        sess = session or Session.get()
+        if not sess.started:
+            Log.fatal("FailureDetector requires an initialised session")
+        self._sess = sess
+        self._interval = float(interval_s)
+        self._client = None
+        self._stop = threading.Event()
+        self._watch_cb: Optional[Callable[[List[int]], None]] = None
+        self._watch_timeout = 0.0
+        # last observed (counter value, local monotonic time) per peer
+        self._seen: Dict[int, tuple] = {}
+        if sess.size > 1:
+            from jax._src import distributed
+
+            self._client = distributed.global_state.client
+            if self._client is None:
+                Log.fatal("FailureDetector: no coordination-service client")
+            self._key = f"mvhb/{sess.rank}"
+            self._client.key_value_increment(self._key, 1)
+            now = time.monotonic()
+            self._seen = {r: (0, now) for r in range(sess.size)
+                          if r != sess.rank}
+            self._thread = threading.Thread(
+                target=self._beat_loop, name="mvhb", daemon=True)
+            self._thread.start()
+
+    # -- publisher ---------------------------------------------------------
+    def _beat_loop(self) -> None:
+        errors = 0
+        while not self._stop.wait(self._interval):
+            try:
+                self._client.key_value_increment(self._key, 1)
+                errors = 0
+            except Exception as exc:
+                # transient service blips must NOT stop the publisher — a
+                # halted heartbeat makes peers declare a HEALTHY process
+                # dead. Log sparsely and keep beating.
+                errors += 1
+                if not self._stop.is_set() and errors in (1, 10, 100):
+                    Log.error("heartbeat publish failed (x%d): %s",
+                              errors, exc)
+                continue
+            cb = self._watch_cb
+            if cb is not None:
+                try:
+                    dead = self.dead_peers(self._watch_timeout)
+                except Exception:
+                    continue
+                if dead:
+                    self._watch_cb = None   # fire once
+                    cb(dead)
+
+    # -- monitor -----------------------------------------------------------
+    def _peer_count(self, r: int) -> int:
+        try:
+            return int(self._client.key_value_try_get(f"mvhb/{r}"))
+        except Exception as exc:
+            if "NOT_FOUND" in str(exc):
+                return 0
+            raise
+
+    def dead_peers(self, timeout_s: float) -> List[int]:
+        """Ranks whose heartbeat has not advanced for ``timeout_s``."""
+        if self._client is None:
+            return []
+        now = time.monotonic()
+        dead = []
+        for r in list(self._seen):
+            count = self._peer_count(r)
+            last_count, last_time = self._seen[r]
+            if count != last_count:
+                self._seen[r] = (count, now)
+            elif now - last_time > timeout_s:
+                dead.append(r)
+        return dead
+
+    def start_watchdog(self, timeout_s: float,
+                       on_failure: Optional[Callable[[List[int]], None]]
+                       = None) -> None:
+        """Declare-dead-and-act: when a peer misses heartbeats for
+        ``timeout_s``, invoke ``on_failure(dead_ranks)`` (default: fatal
+        log naming the dead ranks — crash fast, restart, resume from the
+        latest checkpoint)."""
+        if self._client is None:
+            return
+
+        def _default(dead: List[int]) -> None:
+            # runs on the heartbeat DAEMON thread: an exception here would
+            # only kill that thread while the main thread hangs at its next
+            # collective — the exact outcome the watchdog exists to
+            # prevent. Log, then hard-exit the process.
+            import os
+
+            Log.error(f"peer rank(s) {dead} missed heartbeats for "
+                      f"{timeout_s:.0f}s — exiting; restart the job and "
+                      f"resume via io.checkpoint.restore_latest()")
+            os._exit(17)
+
+        self._watch_timeout = float(timeout_s)
+        self._watch_cb = on_failure or _default
+
+    def stop(self) -> None:
+        self._stop.set()

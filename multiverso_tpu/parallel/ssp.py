@@ -1,0 +1,130 @@
+"""SSP — stale-synchronous-parallel clock (bounded staleness).
+
+The reference reserved this spot and never built it: only binary
+sync/async modes exist, and the ``-backup_worker_ratio`` flag is dead code
+(``src/server.cpp:20-21,229-231`` in the Multiverso reference; SURVEY §2.5
+"SSP/bounded staleness ❌"). This module completes the spectrum:
+
+* sync (BSP)  — every round gated (``-sync=true``);
+* **SSP**     — rounds may drift up to ``staleness`` apart (this module
+  layered on the async bus);
+* async      — unbounded drift, eventual delivery (``parallel/async_ps.py``).
+
+Protocol (classic SSP vector clock, re-expressed on the coordination
+service): each worker owns a monotonically increasing round counter in the
+KV store. ``tick()`` ends the local round: it flushes the worker's deltas
+to the bus and bumps the counter. Before starting round ``r`` a worker
+calls ``wait()``, which blocks while ``r - min(peer rounds) > staleness``
+— the fastest worker can run at most ``staleness`` rounds ahead of the
+slowest, so every Get observes peer state at most ``staleness`` rounds old
+(plus the bus drain interval). ``staleness=0`` degenerates to per-round
+BSP pacing (with async delivery); ``staleness=inf`` is plain async.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .. import config
+from ..log import Log
+
+
+class SSPClock:
+    """Per-process SSP round clock over the coordination-service KV.
+
+    Usage (every process, symmetric)::
+
+        clock = SSPClock(staleness=2)
+        for round in range(R):
+            clock.wait()          # gate: <= staleness ahead of slowest
+            ... compute + table.add(...) ...
+            clock.tick()          # publish round completion
+        clock.finish()            # release peers forever (like the
+                                  # reference SyncServer's FinishTrain
+                                  # clock = INT_MAX)
+    """
+
+    _FINISHED = 1 << 30
+
+    def __init__(self, staleness: int = 1, poll_s: float = 0.01,
+                 session=None) -> None:
+        from ..runtime import Session
+
+        sess = session or Session.get()
+        if not sess.started:
+            Log.fatal("SSPClock requires an initialised session")
+        if config.get_flag("sync"):
+            Log.fatal("SSPClock is for async mode (-sync=false); BSP "
+                      "already gates every round")
+        self.staleness = int(staleness)
+        self._poll = float(poll_s)
+        self._sess = sess
+        self._round = 0
+        self._client = None
+        if sess.size > 1:
+            from jax._src import distributed
+
+            self._client = distributed.global_state.client
+            if self._client is None:
+                Log.fatal("SSPClock: no coordination-service client")
+            # round keys are generation-scoped so re-created clocks in one
+            # process group don't read stale rounds
+            self._gen = self._client.key_value_increment("mvssp/gen", 1) \
+                if sess.rank == 0 else None
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("mvssp_init")
+            if self._gen is None:
+                self._gen = int(self._client.key_value_try_get("mvssp/gen"))
+            self._key = f"mvssp/{self._gen}/r{sess.rank}"
+            self._client.key_value_increment(self._key, 0)
+
+    @property
+    def round(self) -> int:
+        return self._round
+
+    def _peer_round(self, r: int) -> int:
+        try:
+            return int(self._client.key_value_try_get(
+                f"mvssp/{self._gen}/r{r}"))
+        except Exception as exc:
+            if "NOT_FOUND" in str(exc):
+                return 0
+            raise
+
+    def wait(self, timeout_s: float = 600.0) -> None:
+        """Block until this worker is <= ``staleness`` rounds ahead of the
+        slowest peer (no-op single-process)."""
+        if self._client is None:
+            return
+        deadline = time.monotonic() + timeout_s
+        while True:
+            slowest = min(self._peer_round(r)
+                          for r in range(self._sess.size)
+                          if r != self._sess.rank)
+            if self._round - slowest <= self.staleness:
+                return
+            if time.monotonic() > deadline:
+                Log.fatal(f"SSP wait timed out at round {self._round} "
+                          f"(slowest peer at {slowest}, "
+                          f"staleness {self.staleness})")
+            time.sleep(self._poll)
+
+    def tick(self) -> None:
+        """End the local round and advance the clock. Bus publications made
+        during the round are already visible in the KV store (publish is
+        synchronous), so a peer released by the bumped clock can drain
+        every delta of this round."""
+        self._round += 1
+        if self._client is None:
+            return
+        self._client.key_value_increment(self._key, 1)
+
+    def finish(self) -> None:
+        """Release peers permanently (``FinishTrain``: clock -> INT_MAX,
+        ``src/server.cpp:82-139``)."""
+        if self._client is None:
+            return
+        self._client.key_value_increment(self._key,
+                                         self._FINISHED - self._round)

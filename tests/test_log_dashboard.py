@@ -1,8 +1,11 @@
 """Logger + dashboard tests (reference: util/log.h, dashboard.h)."""
 
+import os
 import time
 
 import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 from multiverso_tpu.dashboard import Dashboard, Monitor, Timer, monitor
 from multiverso_tpu.log import FatalError, Log, LogLevel, check, check_notnull
@@ -82,3 +85,41 @@ def test_profile_trace_writes_xplane(tmp_path):
         found.extend(files)
     assert found, "profiler trace produced no files"
     assert "PROF_SPAN" in Dashboard.display()
+
+
+def test_trace_summary_tool(tmp_path):
+    """tools/trace_summary.py parses a profile_trace capture and reports
+    hardware-measured device durations by source/op."""
+    import contextlib
+    import io as _io
+
+    import jax
+    import jax.numpy as jnp
+
+    from multiverso_tpu.dashboard import profile_trace
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((128, 128))
+    float(f(x))   # compile outside the trace
+    with profile_trace(str(tmp_path)):
+        float(f(x))
+
+    import runpy
+    import sys as _sys
+
+    out = _io.StringIO()
+    argv = _sys.argv
+    _sys.argv = ["trace_summary", str(tmp_path), "--by", "op"]
+    try:
+        with contextlib.redirect_stdout(out):
+            with pytest.raises(SystemExit) as exc:
+                runpy.run_path(
+                    os.path.join(_REPO, "tools", "trace_summary.py"),
+                    run_name="__main__")
+            assert exc.value.code in (0, None)
+    finally:
+        _sys.argv = argv
+    assert "device time total" in out.getvalue()

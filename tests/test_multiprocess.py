@@ -458,3 +458,148 @@ def test_two_process_async_word2vec_app(tmp_path):
     # and training actually moved the table (random init is nonzero, but
     # movement means w0 differs from a fresh seed-42 init... use variance)
     assert float(np.abs(w0).mean()) > 0
+
+
+_SSP_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    sys.path.insert(0, %r)
+    import multiverso_tpu as mv
+    from multiverso_tpu.parallel import SSPClock
+
+    rank = int(os.environ["MV_PROCESS_ID"])
+    mv.init(["ssp", "-sync=false"])
+    t = mv.create_table("array", 8)
+    clock = SSPClock(staleness=2)
+
+    rounds = 8
+    gated = 0.0                      # time the fast worker spent blocked
+    for r in range(rounds):
+        t0 = time.monotonic()
+        clock.wait()
+        gated += time.monotonic() - t0
+        if rank == 1 and r < 3:
+            time.sleep(0.3)          # a deliberately slow worker
+        t.add(np.full(8, 1.0, np.float32))
+        clock.tick()
+    clock.finish()
+    if rank == 0:
+        # the SSP bound must have GATED the fast worker: worker 1 holds
+        # rounds 0-2 for 0.3s each while worker 0 may run only
+        # `staleness` rounds ahead -> it must block for most of the
+        # 0.9s of slow rounds (minus pipeline slack).
+        assert gated > 0.4, f"fast worker never gated ({gated:.2f}s)"
+    mv.barrier()                      # drain the bus
+
+    got = t.get()
+    want = rounds * 2.0               # both workers' deltas everywhere
+    assert np.allclose(got, want), (got[0], want)
+
+    # local visibility staleness held during the run: by round r, at least
+    # (r - staleness) of the peer's rounds were published; after finish +
+    # barrier everything converged (checked above).
+    mv.barrier()
+    mv.shutdown()
+    print(f"RANK{rank}_SSP_OK", flush=True)
+""")
+
+
+def test_two_process_ssp_bounded_staleness(tmp_path):
+    """SSP completes the sync spectrum (the reference reserved but never
+    built it: dead -backup_worker_ratio, src/server.cpp:20-21,229-231):
+    with staleness=2 and one slow worker, the fast worker is gated and
+    both converge exactly after finish()."""
+    port = _free_port()
+    script = tmp_path / "ssp_worker.py"
+    script.write_text(_SSP_WORKER % _REPO)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "MV_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "MV_NUM_PROCESSES": "2",
+            "MV_PROCESS_ID": str(rank),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"rank {rank} timed out")
+        assert proc.returncode == 0, f"rank {rank}:\n{out[-3000:]}"
+        assert f"RANK{rank}_SSP_OK" in out
+
+
+_HB_WORKER = textwrap.dedent("""
+    import os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    sys.path.insert(0, %r)
+    import multiverso_tpu as mv
+    from multiverso_tpu.parallel import FailureDetector
+
+    rank = int(os.environ["MV_PROCESS_ID"])
+    mv.init(["hb", "-sync=false"])
+    det = FailureDetector(interval_s=0.2)
+    mv.barrier()
+
+    if rank == 1:
+        # simulate a crash: vanish without shutdown (heartbeats stop)
+        print("RANK1_HB_DIES", flush=True)
+        os._exit(0)
+
+    # survivor: the peer must be declared dead within the timeout window
+    deadline = time.monotonic() + 30
+    dead = []
+    while time.monotonic() < deadline:
+        dead = det.dead_peers(timeout_s=1.5)
+        if dead:
+            break
+        time.sleep(0.2)
+    assert dead == [1], dead
+    det.stop()
+    print("RANK0_HB_OK", flush=True)
+    os._exit(0)   # peer is gone; a collective shutdown would hang
+""")
+
+
+def test_failure_detector_flags_dead_peer(tmp_path):
+    """SURVEY 5.3 (reference has none): a process that vanishes without
+    shutdown is declared dead by its peers within the heartbeat timeout."""
+    port = _free_port()
+    script = tmp_path / "hb_worker.py"
+    script.write_text(_HB_WORKER % _REPO)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "MV_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "MV_NUM_PROCESSES": "2",
+            "MV_PROCESS_ID": str(rank),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"rank {rank} timed out")
+        outs.append(out)
+    assert "RANK1_HB_DIES" in outs[1]
+    assert procs[0].returncode == 0, f"rank 0:\n{outs[0][-3000:]}"
+    assert "RANK0_HB_OK" in outs[0]

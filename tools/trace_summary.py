@@ -1,0 +1,94 @@
+"""Summarise an xprof trace by op and by source line.
+
+Companion to ``dashboard.profile_trace`` (and any ``jax.profiler`` trace):
+reads the ``*.trace.json.gz`` a capture writes and prints hardware-measured
+device-op durations aggregated two ways —
+
+* by SOURCE line (``file.py:123``) — where your program's time goes;
+* by HLO op name — what XLA turned it into.
+
+This is the analysis loop behind the README's per-op table: capture once
+(``python tools/w2v_profile.py --trace DIR`` or ``with
+profile_trace(DIR): ...``), then ``python tools/trace_summary.py DIR``.
+Wall-clock micro-benchmarks are unreliable on tunneled devices (dispatch
+acks return early); the trace's ``device_duration_ps`` values come from
+the hardware counters and are the trustworthy number.
+
+Usage: python tools/trace_summary.py TRACE_DIR [--top 20] [--by op|source]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def load_events(trace_dir: str):
+    pattern = os.path.join(trace_dir, "**", "*.trace.json.gz")
+    files = sorted(glob.glob(pattern, recursive=True))
+    if not files:
+        sys.exit(f"no *.trace.json.gz under {trace_dir}")
+    events = []
+    for path in files:
+        with gzip.open(path) as f:
+            events.extend(json.load(f).get("traceEvents", []))
+    return events
+
+
+def summarize(events, by: str = "source"):
+    dur = collections.Counter()
+    count = collections.Counter()
+    label = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args", {})
+        if "device_duration_ps" not in args:
+            continue
+        name = e.get("name", "")
+        if "while" in name or name.startswith("jit_"):
+            continue   # wrapper events (while loops, whole-module jit
+            #            executions) already include their children
+        if by == "source":
+            key = args.get("source", "")
+            if not key:
+                continue
+            label.setdefault(key, set()).add(
+                args.get("tf_op", "").split("/")[-1][:40])
+        else:
+            key = e.get("name", "?")
+            label.setdefault(key, set()).add(
+                args.get("source", "").split("/")[-1])
+        dur[key] += int(args["device_duration_ps"]) / 1e9   # ps -> ms
+        count[key] += 1
+    return dur, count, label
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--by", choices=["source", "op"], default="source")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.trace_dir)
+    dur, count, label = summarize(events, args.by)
+    total = sum(dur.values())
+    print(f"device time total: {total:.2f} ms "
+          f"({sum(count.values())} op executions)")
+    print(f"{'ms':>10} {'%':>6} {'n':>6}  {args.by}")
+    for key, d in dur.most_common(args.top):
+        tags = ", ".join(sorted(label[key])[:2])
+        short = key if args.by == "op" else "/".join(key.split("/")[-2:])
+        print(f"{d:10.2f} {d / total * 100:6.1f} {count[key]:6d}  "
+              f"{short}  [{tags}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
