@@ -51,6 +51,10 @@ class TransformerConfig:
     learning_rate: float = 0.1
     momentum: float = 0.9
     seed: int = 0
+    # attention implementation: "reference" (jnp, XLA-fused) or "flash"
+    # (the Pallas TPU kernel, ops/flash_attention.py — trains through its
+    # custom VJP; runs in interpret mode off-TPU, so tests stay hermetic)
+    attention: str = "reference"
 
 
 def init_params(cfg: TransformerConfig,
@@ -117,20 +121,26 @@ def _rmsnorm(x, g):
                  keepdims=True) + 1e-6).astype(x.dtype) * g
 
 
-def _attention(q, k, v, n_heads: int):
+def _attention(q, k, v, n_heads: int, impl: str = "reference"):
     """Causal multi-head attention, [B, T, D] in/out.
 
-    The per-example computation IS :func:`ops.reference_attention` (vmapped
-    over batch) — one causal-attention implementation shared by the model,
-    the sequence-parallel ops, and the tests.
+    ``impl="reference"``: :func:`ops.reference_attention` vmapped over
+    batch — one causal-attention implementation shared by the model, the
+    sequence-parallel ops, and the tests. ``impl="flash"``: the Pallas
+    flash kernel (:func:`ops.flash_attention`), online-softmax tiles in
+    VMEM with a custom VJP for training.
     """
-    from ..ops.ring_attention import reference_attention
-
     B, T, D = q.shape
     dh = D // n_heads
     split = lambda x: x.reshape(B, T, n_heads, dh)
-    out = jax.vmap(partial(reference_attention, causal=True))(
-        split(q), split(k), split(v))
+    if impl == "flash":
+        from ..ops.flash_attention import flash_attention as fn
+    elif impl == "reference":
+        from ..ops.ring_attention import reference_attention as fn
+    else:
+        Log.fatal(f"unknown attention impl {impl!r} "
+                  "(expected 'reference' or 'flash')")
+    out = jax.vmap(partial(fn, causal=True))(split(q), split(k), split(v))
     return out.reshape(B, T, D)
 
 
@@ -143,7 +153,8 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
     def block(h, layer):
         x = _rmsnorm(h, layer["ln1_g"])
         h = h + _attention(x @ layer["w_q"], x @ layer["w_k"],
-                           x @ layer["w_v"], cfg.n_heads) @ layer["w_o"]
+                           x @ layer["w_v"], cfg.n_heads,
+                           cfg.attention) @ layer["w_o"]
         x = _rmsnorm(h, layer["ln2_g"])
         h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
         return h, None

@@ -61,18 +61,30 @@ class FailureDetector:
     # -- publisher ---------------------------------------------------------
     def _beat_loop(self) -> None:
         errors = 0
+        first_err: Optional[float] = None
         while not self._stop.wait(self._interval):
             try:
                 self._client.key_value_increment(self._key, 1)
                 errors = 0
+                first_err = None
             except Exception as exc:
                 # transient service blips must NOT stop the publisher — a
                 # halted heartbeat makes peers declare a HEALTHY process
-                # dead. Log sparsely and keep beating.
+                # dead. Log sparsely and keep beating; if the service
+                # stays unreachable past the watchdog timeout, that IS a
+                # failure (the rank-0 coordinator died) — fire.
                 errors += 1
+                now = time.monotonic()
+                first_err = first_err or now
                 if not self._stop.is_set() and errors in (1, 10, 100):
                     Log.error("heartbeat publish failed (x%d): %s",
                               errors, exc)
+                cb = self._watch_cb
+                if (cb is not None and self._watch_timeout > 0
+                        and now - first_err > self._watch_timeout
+                        and not self._stop.is_set()):
+                    self._watch_cb = None
+                    cb([0])   # coordination service (rank 0) unreachable
                 continue
             cb = self._watch_cb
             if cb is not None:
@@ -93,8 +105,17 @@ class FailureDetector:
                 return 0
             raise
 
+    def _peer_finished(self, r: int) -> bool:
+        try:
+            self._client.key_value_try_get(f"mvhb/{r}/done")
+            return True
+        except Exception:
+            return False
+
     def dead_peers(self, timeout_s: float) -> List[int]:
-        """Ranks whose heartbeat has not advanced for ``timeout_s``."""
+        """Ranks whose heartbeat has not advanced for ``timeout_s``.
+        Peers that deregistered via :meth:`stop` (clean exit) are never
+        reported — a finished straggler is not a failure."""
         if self._client is None:
             return []
         now = time.monotonic()
@@ -105,7 +126,10 @@ class FailureDetector:
             if count != last_count:
                 self._seen[r] = (count, now)
             elif now - last_time > timeout_s:
-                dead.append(r)
+                if self._peer_finished(r):
+                    del self._seen[r]       # clean exit, stop watching
+                else:
+                    dead.append(r)
         return dead
 
     def start_watchdog(self, timeout_s: float,
@@ -134,4 +158,12 @@ class FailureDetector:
         self._watch_cb = on_failure or _default
 
     def stop(self) -> None:
+        """Deregister (clean exit): publish a done marker so peers stop
+        watching this rank, then halt the publisher."""
         self._stop.set()
+        if self._client is not None:
+            try:
+                self._client.key_value_set(f"mvhb/{self._sess.rank}/done",
+                                           "1")
+            except Exception:
+                pass   # exiting anyway; peers fall back to the timeout

@@ -95,20 +95,27 @@ class SSPClock:
 
     def wait(self, timeout_s: float = 600.0) -> None:
         """Block until this worker is <= ``staleness`` rounds ahead of the
-        slowest peer (no-op single-process)."""
+        slowest peer (no-op single-process).
+
+        Round counters are monotonic, so a peer once observed past the
+        gate is never re-polled within this wait — the poll load per
+        worker is O(still-behind peers), not O(size), and the scan
+        short-circuits on the first behind peer.
+        """
         if self._client is None:
             return
+        gate = self._round - self.staleness
+        behind = [r for r in range(self._sess.size) if r != self._sess.rank]
         deadline = time.monotonic() + timeout_s
         while True:
-            slowest = min(self._peer_round(r)
-                          for r in range(self._sess.size)
-                          if r != self._sess.rank)
-            if self._round - slowest <= self.staleness:
+            still = [r for r in behind if self._peer_round(r) < gate]
+            if not still:
                 return
             if time.monotonic() > deadline:
                 Log.fatal(f"SSP wait timed out at round {self._round} "
-                          f"(slowest peer at {slowest}, "
+                          f"(peers {still} behind round {gate}, "
                           f"staleness {self.staleness})")
+            behind = still
             time.sleep(self._poll)
 
     def tick(self) -> None:
