@@ -248,13 +248,31 @@ _FOURP_WORKER = textwrap.dedent("""
         mv.shutdown()
         print(f"RANK{rank}_RESUME_OK", flush=True)
 
-    else:  # ma: model-averaging mode, no PS tables
+    elif phase == "ma":  # model-averaging mode, no PS tables
         mv.init(["worker", "-ma=true"])
         agg = mv.aggregate(np.full(8, float(rank), np.float32))
         assert np.allclose(agg, 0.0 + 1.0 + 2.0 + 3.0), agg
         mv.barrier()
         mv.shutdown()
         print(f"RANK{rank}_MA_OK", flush=True)
+
+    else:  # async: 4-way delta bus (GC needs size-1 acks from 3 peers)
+        mv.init(["worker", "-sync=false"])
+        assert mv.session().async_bus is not None
+        t = mv.create_table("array", 16)
+        for _ in range(3):
+            t.add(np.full(16, float(rank + 1), np.float32))
+        m = mv.create_table("matrix", 8, 2)
+        m.add_rows([rank, 7], np.full((2, 2), 1.0, np.float32))
+        mv.barrier()
+        assert np.allclose(t.get(), 3.0 * (1 + 2 + 3 + 4)), t.get()[0]
+        gm = m.get()
+        assert np.allclose(gm[7], 4.0), gm[7]     # all 4 workers hit row 7
+        for r in range(4):
+            assert np.allclose(gm[r], 1.0), (r, gm[r])
+        mv.barrier()
+        mv.shutdown()
+        print(f"RANK{rank}_ASYNC4_OK", flush=True)
 """)
 
 
@@ -311,6 +329,13 @@ def test_four_process_keyed_ma_and_restart_resume(tmp_path):
     for rank, (proc, out) in enumerate(zip(procs, outs)):
         assert proc.returncode == 0, f"ma rank {rank}:\n{out[-3000:]}"
         assert f"RANK{rank}_MA_OK" in out
+
+    # async delta bus across 4 processes (ack-GC needs all 3 peers)
+    procs, outs = _run_group(script, 4,
+                             {"MV_TEST_PHASE": "async", "MV_TEST_CKPT": ckpt})
+    for rank, (proc, out) in enumerate(zip(procs, outs)):
+        assert proc.returncode == 0, f"async rank {rank}:\n{out[-3000:]}"
+        assert f"RANK{rank}_ASYNC4_OK" in out
 
 
 _NETAPI_WORKER = textwrap.dedent("""
