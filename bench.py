@@ -6,10 +6,11 @@ Metric: word2vec skip-gram negative-sampling training pairs/sec at the
 reference's NAMED configuration shape — text8: ~71k vocabulary, 200-dim
 embeddings (BASELINE.json config 2; the corpus itself is synthesised with a
 zipf unigram law because this environment has no network egress, but vocab
-size, dimensionality, window, negatives and subsampling all match). EXACT
-reference semantics: per-pair negative draws, summed updates (row_mean off —
-legitimate at this shape: batch 64k << cap*vocab, see
-docs/EMBEDDING_QUALITY.md).
+size, dimensionality, window, negatives and subsampling all match). Exact
+per-pair negative draws; updates use the capped row-mean stabiliser
+(quality parity documented in docs/EMBEDDING_QUALITY.md) because raw
+summed updates DIVERGE at 64k batch on a zipf corpus — see the auto rule
+in apps/wordembedding.py.
 
 ``vs_baseline`` is the ratio against 1.0M pairs/sec, the ballpark of the
 reference C++ implementation's per-host throughput on its published hardware
@@ -74,15 +75,18 @@ def main() -> int:
     # accumulation in the step), 2.5x candidate oversampling so the
     # window/subsample rejection tests don't waste gather/scatter slots,
     # pre-drawn negative pool (contiguous-slice draws instead of random
-    # gathers). row_mean stays OFF — reference summed-update semantics,
-    # stable at this shape (batch << row_update_cap * vocab; the auto rule
-    # in apps/wordembedding.py and docs/EMBEDDING_QUALITY.md).
+    # gathers). row_mean (capped, cap=8) is ON: at 64k batch on a zipf
+    # corpus the head words collect thousands of colliding pair grads per
+    # step and raw summed updates diverge (NaN) — the reference's
+    # sequential loop self-limits via sigmoid saturation; the cap plays
+    # that role and measures quality parity (docs/EMBEDDING_QUALITY.md).
+    # Raw summed semantics remain available (and stable) at small batch.
     cfg = Word2VecConfig(vocab_size=dictionary.vocab_size,
                          embedding_size=_DIM,
                          window=5, negative=5, init_lr=0.025,
                          batch_size=65536,
                          oversample=2.5, neg_pool_size=1 << 22,
-                         row_mean_updates=False,
+                         row_mean_updates=True,
                          shared_negatives=shared_neg)
     import jax.numpy as jnp
     w_in = mv.create_table("matrix", dictionary.vocab_size, _DIM,

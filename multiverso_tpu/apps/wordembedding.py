@@ -474,10 +474,12 @@ def train(
     ``distributed_wordembedding.cpp:146``).
 
     ``device_corpus`` selects the fast path: upload the encoded corpus to
-    HBM once and sample + train entirely on device (``train_device_steps``
-    — the mode ``bench.py`` measures). Default (None) auto-enables it when
-    the corpus fits the HBM budget; False streams host-generated pair
-    batches (unbounded corpus size, the reference's loader-thread shape).
+    HBM and sample + train entirely on device (``train_device_steps`` —
+    the mode ``bench.py`` measures). Corpora over the HBM budget rotate
+    through equal-length chunks, so the path scales to the 1B-token
+    north-star corpus (~8 uploads/epoch at the default budget). Default
+    (None) auto-enables it when the corpus fits one chunk; False streams
+    host-generated pair batches (the reference's loader-thread shape).
 
     ``steps_per_call`` / ``oversample`` override the matching cfg fields;
     left as None, cfg values at their dataclass defaults are resolved to
@@ -508,11 +510,22 @@ def train(
     Log.info("vocab %d, train words %d", vocab, dictionary.train_words)
     if cfg.row_mean_updates is None:
         # Auto: batched scatter-sum matches the reference's sequential
-        # updates until hot rows collect more than ~row_update_cap colliding
-        # pair grads per batch; past that, switch to capped row-mean to keep
-        # training stable (see docs/EMBEDDING_QUALITY.md).
-        cfg.row_mean_updates = (
-            cfg.batch_size >= cfg.row_update_cap * max(vocab, 1))
+        # updates until the HOTTEST row collects enough colliding pair
+        # grads per step to blow past the sequential loop's sigmoid
+        # self-limiting (zipf corpora concentrate mass: a 71k-vocab corpus
+        # at 64k batch puts thousands of colliding grads on the head words
+        # and summed training NaNs within one dispatch). Estimate the
+        # hot-row hits from the KNOWN sampling laws — centers/contexts
+        # from the unigram counts, negatives from unigram^0.75 — and
+        # switch to capped row-mean past the empirically safe region
+        # (stable at ~150 hits, divergent at ~2300+; threshold 512; see
+        # docs/EMBEDDING_QUALITY.md for quality parity of cap=8).
+        total = max(counts.sum(), 1.0)
+        p_center = float(counts.max() / total)
+        w75 = counts ** 0.75
+        p_neg = float(w75.max() / max(w75.sum(), 1e-12))
+        est_hot = cfg.batch_size * (2 * p_center + cfg.negative * p_neg)
+        cfg.row_mean_updates = est_hot > 512
 
     # The same two tables the reference allocates (WE/src/communicator.cpp:17-33);
     # AdaGrad G state lives model-side when cfg.use_adagrad.
@@ -556,13 +569,8 @@ def train(
         elif n_enc < min_positions:
             Log.fatal(f"device_corpus needs at least batch_size + 2*window "
                       f"positions; corpus has {n_enc}")
-        elif n_enc > _DEVICE_CORPUS_MAX_TOKENS:
-            # Explicit opt-in overrides the auto budget (large-HBM parts can
-            # hold far more); surface the cost instead of refusing.
-            Log.error(f"device_corpus=True uploads {n_enc} corpus tokens "
-                     f"(~{n_enc * 8 >> 20} MB) to HBM, over the "
-                     f"{_DEVICE_CORPUS_MAX_TOKENS}-token auto budget; "
-                     f"use device_corpus=False to stream instead")
+        # corpora over the HBM budget run the device path in rotating
+        # equal-length chunks (handled below); nothing to refuse
 
     # async multi-process: publish own-training deltas every
     # -sync_frequency calls (reference AddDeltaParameter cadence); inactive
@@ -583,35 +591,66 @@ def train(
             if cfg.oversample <= 1 and not explicit_ovs:
                 cfg.oversample = 2.5
             discard = subsample_probs(counts, sample).astype(np.float32)
-            model.load_corpus_chunk(ids, sent_ids, discard)
-            n = int(ids.shape[0])
+            n_enc = int(ids.shape[0])
+            # Corpora over the HBM budget rotate through EQUAL-length chunks
+            # (equal so the fused program compiles once); the tail chunk
+            # wraps to the front, mirroring the in-chunk stream's own
+            # wrap-around. One chunk upload amortises over that chunk's
+            # whole slice of the epoch — the 1B-token north-star corpus
+            # (~8x the budget) pays 8 uploads per epoch.
+            n_chunks = -(-n_enc // _DEVICE_CORPUS_MAX_TOKENS)
+            # equal split (not budget-sized chunks): the tail chunk's wrap
+            # overlap stays < n_chunks tokens instead of retraining up to
+            # a whole budget's worth of front tokens per epoch
+            chunk_len = -(-n_enc // n_chunks)
+            if n_chunks > 1:
+                Log.info("device corpus: %d tokens in %d chunk(s) of %d",
+                         n_enc, n_chunks, chunk_len)
+
+            def chunk_arrays(c):
+                lo = c * chunk_len
+                if lo + chunk_len <= n_enc:
+                    sl = slice(lo, lo + chunk_len)
+                    return ids[sl], sent_ids[sl]
+                wrap = lo + chunk_len - n_enc
+                return (np.concatenate([ids[lo:], ids[:wrap]]),
+                        np.concatenate([sent_ids[lo:], sent_ids[:wrap]]))
+
+            model.load_corpus_chunk(*chunk_arrays(0), discard)
             spc = cfg.steps_per_call
-            m_per_step = model._candidate_batch(n)
+            m_per_step = model._candidate_batch(chunk_len)
             # The device sampler draws ONE (center, context) pair per corpus
             # position per pass; the reference trains every word in the shrunk
             # window (expected window+1 pairs per center,
             # ``wordembedding.cpp:214``). Scale passes so one "epoch" trains
             # the reference's pair count. CBOW is one example per center.
             pair_factor = 1 if cfg.cbow else cfg.window + 1
-            calls_per_epoch = max(1, -(-(n * pair_factor) // (spc * m_per_step)))
+            calls_per_chunk = max(
+                1, -(-(chunk_len * pair_factor) // (spc * m_per_step)))
             for epoch in range(epochs):
                 done = 0.0   # running pair count, synced once per log point
                 pending_counts = []
-                for call in range(calls_per_epoch):
-                    mon.begin()
-                    loss, count = model.train_device_steps(spc)
-                    mon.end()
-                    pusher.tick()
-                    pending_counts.append(count)
-                    if log_every and (call + 1) % log_every == 0:
-                        done += float(np.sum([float(c) for c in pending_counts]))
-                        pending_counts = []
-                        elapsed = time.perf_counter() - t0
-                        Log.info(
-                            "epoch %d call %d: %.0f pairs/sec, lr %.5f, "
-                            "loss %.4f", epoch, call + 1,
-                            (pairs + done) / elapsed, model.current_lr(),
-                            float(loss))
+                call_no = 0
+                for c in range(n_chunks):
+                    if n_chunks > 1 and (epoch > 0 or c > 0):
+                        model.load_corpus_chunk(*chunk_arrays(c), discard)
+                    for _ in range(calls_per_chunk):
+                        call_no += 1
+                        mon.begin()
+                        loss, count = model.train_device_steps(spc)
+                        mon.end()
+                        pusher.tick()
+                        pending_counts.append(count)
+                        if log_every and call_no % log_every == 0:
+                            done += float(np.sum(
+                                [float(x) for x in pending_counts]))
+                            pending_counts = []
+                            elapsed = time.perf_counter() - t0
+                            Log.info(
+                                "epoch %d call %d: %.0f pairs/sec, lr %.5f, "
+                                "loss %.4f", epoch, call_no,
+                                (pairs + done) / elapsed, model.current_lr(),
+                                float(loss))
                 done += float(np.sum([float(c) for c in pending_counts]))
                 pairs += int(done)
                 wordcount_table.add([0], [dictionary.train_words])

@@ -418,3 +418,28 @@ def test_dictionary_extras(tmp_path):
         d4.insert(word, count)
     d4.remove_words_less_than(2)
     assert d4.words == ["a"]
+
+
+def test_device_corpus_chunk_rotation(mv_session, tmp_path, monkeypatch):
+    """Corpora over the HBM budget rotate through equal-length device
+    chunks (north-star 1B-token scale); equal lengths keep ONE compiled
+    fused program; training stays finite and counts words correctly."""
+    import numpy as np
+
+    from multiverso_tpu.apps import wordembedding as we
+
+    rng = np.random.default_rng(0)
+    corpus = tmp_path / "big.txt"
+    with open(corpus, "w") as f:
+        f.write(" ".join(f"w{i}" for i in range(20)) + "\n")
+        for _ in range(400):
+            f.write(" ".join(f"w{i}" for i in rng.integers(0, 20, 16)) + "\n")
+
+    # shrink the budget so this corpus (~6.8k tokens) needs 3 chunks
+    monkeypatch.setattr(we, "_DEVICE_CORPUS_MAX_TOKENS", 2500)
+    cfg = we.Word2VecConfig(embedding_size=8, negative=2, batch_size=256,
+                            steps_per_call=2)
+    res = we.train(str(corpus), None, cfg, epochs=2, min_count=1,
+                   log_every=0, device_corpus=True, steps_per_call=2)
+    assert np.isfinite(res.final_loss)
+    assert res.pairs_trained > 0
