@@ -79,14 +79,16 @@ def main() -> int:
     # corpus the head words collect thousands of colliding pair grads per
     # step and raw summed updates diverge (NaN) — the reference's
     # sequential loop self-limits via sigmoid saturation; the cap plays
-    # that role and measures quality parity (docs/EMBEDDING_QUALITY.md).
-    # Raw summed semantics remain available (and stable) at small batch.
+    # that role and measures quality parity (docs/EMBEDDING_QUALITY.md;
+    # the static expected-count form scores identically and skips the
+    # per-step counts scatter). Raw summed semantics remain available
+    # (and stable) at small batch.
     cfg = Word2VecConfig(vocab_size=dictionary.vocab_size,
                          embedding_size=_DIM,
                          window=5, negative=5, init_lr=0.025,
                          batch_size=65536,
                          oversample=2.5, neg_pool_size=1 << 22,
-                         row_mean_updates=True,
+                         row_mean_updates=True, row_mean_static=True,
                          shared_negatives=shared_neg)
     import jax.numpy as jnp
     w_in = mv.create_table("matrix", dictionary.vocab_size, _DIM,

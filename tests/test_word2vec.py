@@ -443,3 +443,41 @@ def test_device_corpus_chunk_rotation(mv_session, tmp_path, monkeypatch):
                    log_every=0, device_corpus=True, steps_per_call=2)
     assert np.isfinite(res.final_loss)
     assert res.pairs_trained > 0
+
+
+def test_row_mean_static_matches_realized(mv_session):
+    """Static expected-count scaling trains like realized-count scaling
+    (hot rows: expectation ~= realization) and stays finite."""
+    import numpy as np
+
+    from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
+
+    mv = mv_session
+    rng = np.random.default_rng(0)
+    vocab, dim, B = 500, 16, 8192
+    probs = 1.0 / np.arange(1, vocab + 1)
+    probs /= probs.sum()
+    counts = np.maximum(probs * 1e6, 5)
+    ids = rng.choice(vocab, size=100_000, p=probs).astype(np.int32)
+    sents = (np.arange(ids.size) // 200).astype(np.int32)
+
+    def run(static):
+        cfg = Word2VecConfig(vocab_size=vocab, embedding_size=dim,
+                             negative=3, batch_size=B, seed=2,
+                             row_mean_updates=True, row_mean_static=static)
+        w_in = mv.create_table("matrix", vocab, dim, init_value="random",
+                               seed=5)
+        w_out = mv.create_table("matrix", vocab, dim)
+        m = Word2Vec(cfg, w_in, w_out, counts=counts)
+        m.load_corpus_chunk(ids, sents, np.zeros(vocab, np.float32))
+        losses = []
+        for _ in range(6):
+            loss, _ = m.train_device_steps(2)
+            losses.append(float(loss))
+        return losses
+
+    real = run(static=False)
+    stat = run(static=True)
+    assert np.isfinite(stat).all() and np.isfinite(real).all()
+    assert stat[-1] < stat[0]                  # both descend
+    assert abs(stat[-1] - real[-1]) < 0.3, (stat[-1], real[-1])

@@ -85,7 +85,7 @@ def probe(words, vecs, labels):
 
 
 def run_config(corpus, labels, tag, batch_size, row_mean, cap,
-               epochs=3, size=64):
+               epochs=3, size=64, static=False):
     import multiverso_tpu as mv
     from multiverso_tpu.apps.wordembedding import Word2VecConfig, train
     from multiverso_tpu.runtime import Session
@@ -96,7 +96,7 @@ def run_config(corpus, labels, tag, batch_size, row_mean, cap,
         cfg = Word2VecConfig(embedding_size=size, window=5, negative=5,
                              batch_size=batch_size, init_lr=0.05,
                              row_mean_updates=row_mean, row_update_cap=cap,
-                             seed=3)
+                             row_mean_static=static, seed=3)
         out = tempfile.NamedTemporaryFile(suffix=".vec", delete=False).name
         res = train(corpus, out, cfg, epochs=epochs, min_count=1,
                     sample=1e-3, log_every=0)
@@ -127,16 +127,18 @@ def main(argv=None):
     # vocab = 8*40 + 12 = 332 content+stop words. cap*vocab ~ 2.6k: the
     # 16k batch is ~50 expected hits per row -> deep in divergence regime.
     configs = [
-        ("reference-semantics small batch", 1024, False, 8.0),
-        ("summed large batch", 16384, False, 8.0),
-        ("row-mean cap=1 large batch", 16384, True, 1.0),
-        ("row-mean cap=8 large batch", 16384, True, 8.0),
-        ("row-mean cap=32 large batch", 16384, True, 32.0),
-        ("row-mean cap=64 large batch", 16384, True, 64.0),
+        ("reference-semantics small batch", 1024, False, 8.0, False),
+        ("summed large batch", 16384, False, 8.0, False),
+        ("row-mean cap=1 large batch", 16384, True, 1.0, False),
+        ("row-mean cap=8 large batch", 16384, True, 8.0, False),
+        ("row-mean cap=32 large batch", 16384, True, 32.0, False),
+        ("row-mean cap=64 large batch", 16384, True, 64.0, False),
+        ("STATIC row-mean cap=8 large batch", 16384, True, 8.0, True),
     ]
     rows = []
-    for name, batch, rm, cap in configs:
-        r = run_config(corpus, labels, name, batch, rm, cap, epochs=epochs)
+    for name, batch, rm, cap, static in configs:
+        r = run_config(corpus, labels, name, batch, rm, cap, epochs=epochs,
+                       static=static)
         r["name"] = name
         print(f"{name:36s} loss {r['loss']:.4f} "
               f"nn_purity {r['nn_purity']:.3f} gap {r['cos_gap']:.3f}",
