@@ -1,1 +1,2 @@
-"""Reference applications: distributed word2vec + logistic regression."""
+"""Applications: distributed word2vec + logistic regression (reference
+parity) and a transformer LM (beyond reference — apps/lm.py)."""

@@ -96,7 +96,8 @@ class Word2VecConfig:
     # (~12% of the stabilised step at the bench shape). Expectation ==
     # realization for the hot rows the cap exists for (CV = 1/sqrt(hits));
     # cold rows scale to 1 either way. Device-corpus path only (the
-    # expected laws come from load_corpus_chunk); plain SGD only.
+    # expected laws come from load_corpus_chunk); requires plain SGD,
+    # skip-gram, no HS, and oversample > 1 (validated at construction).
     row_mean_static: bool = False
     # with row_mean_updates: per-row update = mean-grad * min(count, cap).
     # cap bounds how much a hot row can move per batch — rows with <= cap
@@ -209,10 +210,27 @@ class Word2Vec:
         self._host_counts = (None if counts is None
                              else np.asarray(counts, np.float64))
         if config.row_mean_updates and config.row_mean_static:
+            # Static scales only model what they can predict: word-law
+            # expectations for full, compacted skip-gram batches.
             if counts is None:
                 Log.fatal("row_mean_static requires vocab counts")
             if config.use_adagrad:
                 Log.fatal("row_mean_static supports plain SGD only")
+            if config.hs:
+                # HS scatters Huffman NODE ids; the word-law table would
+                # look up unrelated words and leave the hottest rows
+                # (top tree nodes) uncapped. Realized counts handle HS.
+                Log.fatal("row_mean_static does not support hierarchical "
+                          "softmax (use realized counts)")
+            if config.cbow:
+                Log.fatal("row_mean_static supports skip-gram only")
+            if config.oversample <= 1:
+                # without candidate compaction only ~half the batch slots
+                # hold valid pairs, so the full-B expectations over-cap
+                # hot rows ~2x; compaction makes B the realized count
+                Log.fatal("row_mean_static requires oversample > 1 "
+                          "(compacted full batches make the expected "
+                          "counts match realizations)")
         if config.negative > 0:
             if counts is None:
                 Log.fatal("negative sampling requires vocab counts")
@@ -866,9 +884,8 @@ class Word2Vec:
         w75 = counts ** 0.75
         p_neg = w75 / max(w75.sum(), 1e-12)
         B, K = cfg.batch_size, cfg.negative
-        slots = (cfg.window + 1) if cfg.cbow else 1
-        e_in = B * p_eff * slots
-        e_out = B * p_eff + B * K * p_neg
+        e_in = B * p_eff                      # sg centers (sg-only mode)
+        e_out = B * p_eff + B * K * p_neg     # targets + negatives
 
         def scale(e):
             c = np.maximum(e, 1.0)

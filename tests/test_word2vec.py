@@ -464,6 +464,7 @@ def test_row_mean_static_matches_realized(mv_session):
     def run(static):
         cfg = Word2VecConfig(vocab_size=vocab, embedding_size=dim,
                              negative=3, batch_size=B, seed=2,
+                             oversample=2.0,
                              row_mean_updates=True, row_mean_static=static)
         w_in = mv.create_table("matrix", vocab, dim, init_value="random",
                                seed=5)
