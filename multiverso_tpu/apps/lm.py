@@ -110,6 +110,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                             attention=attention)
     lm = TransformerLM(cfg)
     data = load_bytes(train_file)
+    if data.shape[0] < seq + 2:
+        Log.fatal(f"corpus has {data.shape[0]} bytes; needs >= seq+2 "
+                  f"({seq + 2}) for [batch, seq+1] windows")
     Log.info("LM: %d bytes corpus, d_model %d, %d layers, %d heads, "
              "attention=%s, mesh %s", data.shape[0], d_model, n_layers,
              n_heads, attention, dict(mv.session().mesh.shape))
@@ -152,7 +155,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         flat = np.concatenate(
             [np.asarray(l, np.float32).ravel() for l in leaves])
         current = flat_table.get()
-        flat_table.add(flat - current)   # set via delta (accumulate table)
+        # every process adds delta/size: the sync aggregate (sum over
+        # processes) and the async bus (every peer applies every add)
+        # both reconstruct the delta exactly once on every replica
+        flat_table.add((flat - current) / mv.size())
 
     t0 = time.perf_counter()
     gen = batches(data, batch, seq, seed=mv.rank())
@@ -167,15 +173,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         if saver is not None and step % ckpt_every == 0:
             snapshot_params()
             saver.step(step)
+    if saver is not None and steps % ckpt_every != 0 and steps > start_step:
+        snapshot_params()
+        saver.save_now(steps)   # the final state is the app's artifact
     if loss is not None:
         Log.info("final loss %.4f (ppl %.1f)", float(loss),
                  float(np.exp(float(loss))))
 
-    if n_sample > 0 and mv.rank() == 0:
+    if n_sample > 0:
+        # the forward pass computes over mesh-sharded params: every
+        # process must participate; only rank 0 prints
         out = sample(lm, data[:16], n_sample)
-        text = bytes(out.astype(np.uint8)).decode("utf-8", errors="replace")
-        print("--- sample ---")
-        print(text)
+        if mv.rank() == 0:
+            text = bytes(out.astype(np.uint8)).decode("utf-8",
+                                                      errors="replace")
+            print("--- sample ---")
+            print(text)
 
     mv.shutdown()
     return 0

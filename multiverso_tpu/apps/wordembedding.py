@@ -578,6 +578,18 @@ def train(
     pusher = _AsyncDeltaPusher(
         [input_table, output_table],
         every_calls=max(1, int(mv.get_flag("sync_frequency"))))
+    # -ssp_staleness=N bounds worker drift: each training call is one SSP
+    # round, and the fastest worker blocks once it is > N rounds ahead.
+    # CONTRACT (the reference sync mode's, src/server.cpp:69-222): workers
+    # must perform equal numbers of training calls per epoch (within the
+    # staleness bound) — skew beyond it deadlocks against the epoch
+    # barrier, exactly as unequal Get/Add counts hung the reference.
+    ssp_clock = None
+    ssp = int(mv.get_flag("ssp_staleness"))
+    if ssp >= 0 and pusher.active:
+        from ..parallel import SSPClock
+
+        ssp_clock = SSPClock(staleness=ssp)
 
     try:
         if device_corpus:
@@ -636,10 +648,14 @@ def train(
                         model.load_corpus_chunk(*chunk_arrays(c), discard)
                     for _ in range(calls_per_chunk):
                         call_no += 1
+                        if ssp_clock is not None:
+                            ssp_clock.wait()
                         mon.begin()
                         loss, count = model.train_device_steps(spc)
                         mon.end()
                         pusher.tick()
+                        if ssp_clock is not None:
+                            ssp_clock.tick()
                         pending_counts.append(count)
                         if log_every and call_no % log_every == 0:
                             done += float(np.sum(
@@ -687,6 +703,9 @@ def train(
                     pending = []
                     mon.end()
                     pusher.tick()
+                    if ssp_clock is not None:
+                        ssp_clock.tick()
+                        ssp_clock.wait()
                     # exact lr-decay progress in word units (reference word_count)
                     model.set_words_trained(
                         epoch * dictionary.train_words + progress["words"])
@@ -706,6 +725,8 @@ def train(
     finally:
         # always detach the remote accumulators (unbounded growth if
         # left installed after a failed run)
+        if ssp_clock is not None:
+            ssp_clock.finish()
         pusher.close()
 
     final_loss = float(loss)

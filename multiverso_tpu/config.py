@@ -202,5 +202,7 @@ define_string("mesh_shape", "", "override logical mesh, e.g. '4,2' for (worker,s
 define_int("sync_frequency", 1, "rounds between parameter synchronisations")
 define_int("async_poll_ms", 20,
            "async PS: drain-thread poll interval (bounds peer-delta staleness)")
+define_int("ssp_staleness", -1,
+           "async PS: SSP round gap bound (-1 = unbounded/plain async)")
 define_string("log_file", "", "optional log sink file")
 define_string("log_level", "info", "debug|info|error|fatal")

@@ -1,0 +1,77 @@
+"""Unit tests for the async-PS wire format (parallel/async_ps.py).
+
+The cross-process behavior is covered by tests/test_multiprocess.py; these
+pin the serialization layer itself — framing, dtype fidelity (incl.
+extension dtypes), option round-trip — without spawning processes.
+"""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.parallel import async_ps
+from multiverso_tpu.quantization import SparseFilter
+from multiverso_tpu.updaters import AddOption
+
+
+def test_dense_record_round_trip():
+    opt = AddOption(worker_id=3, learning_rate=0.125, momentum=0.5,
+                    rho=0.25, lam=0.0625)
+    delta = np.arange(12, dtype=np.float32)
+    blobs = SparseFilter(clip=0.0, dtype=np.float32).filter_in([delta])
+    data = async_ps._serialize(async_ps.DENSE, 7, opt, blobs)
+    kind, table_id, opt2, arrays = async_ps._deserialize(data)
+    assert (kind, table_id) == (async_ps.DENSE, 7)
+    assert opt2.worker_id == 3
+    assert opt2.learning_rate == pytest.approx(0.125)
+    assert opt2.momentum == pytest.approx(0.5)
+    assert opt2.rho == pytest.approx(0.25)
+    assert opt2.lam == pytest.approx(0.0625)
+    out = SparseFilter(clip=0.0, dtype=np.float32).filter_out(arrays)[0]
+    np.testing.assert_array_equal(out, delta)
+
+
+def test_keyed_record_preserves_dtypes():
+    ids = np.array([5, 1, 9], np.int32)
+    vals = np.arange(6, dtype=np.float64).reshape(3, 2) * 0.1
+    data = async_ps._serialize(async_ps.KEYED, 2, None, [ids, vals])
+    kind, table_id, opt, (ids2, vals2) = async_ps._deserialize(data)
+    assert kind == async_ps.KEYED and table_id == 2
+    assert ids2.dtype == np.int32 and vals2.dtype == np.float64
+    np.testing.assert_array_equal(ids2, ids)
+    np.testing.assert_array_equal(vals2, vals)   # f64 bit-exact
+    assert opt.worker_id == 0                    # None option -> defaults
+
+
+def test_bfloat16_wire_round_trip():
+    import ml_dtypes
+
+    arr = np.array([1.5, -2.5, 0.0, 3.0], ml_dtypes.bfloat16)
+    data = async_ps._serialize(async_ps.DENSE, 0, None, [arr])
+    _, _, _, (out,) = async_ps._deserialize(data)
+    assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(out.astype(np.float32),
+                                  arr.astype(np.float32))
+
+
+def test_kv_record():
+    keys = np.array([7, -3], np.int64)
+    vals = np.array([1.0, 0.5], np.float64)
+    data = async_ps._serialize(async_ps.KV, 1, None, [keys, vals])
+    kind, table_id, _, (k2, v2) = async_ps._deserialize(data)
+    assert kind == async_ps.KV
+    np.testing.assert_array_equal(k2, keys)
+    np.testing.assert_array_equal(v2, vals)
+
+
+def test_sparse_filter_compresses_sparse_dense_payload():
+    """A mostly-zero dense delta rides the wire compressed (the reference
+    >50%-small rule) and reconstructs exactly."""
+    delta = np.zeros(1000, np.float32)
+    delta[[3, 500, 999]] = [1.0, -2.0, 0.5]
+    f = SparseFilter(clip=0.0, dtype=np.float32)
+    blobs = f.filter_in([delta])
+    wire = async_ps._serialize(async_ps.DENSE, 0, None, blobs)
+    assert len(wire) < delta.nbytes // 2   # actually compressed
+    _, _, _, arrays = async_ps._deserialize(wire)
+    out = f.filter_out(arrays)[0]
+    np.testing.assert_array_equal(out, delta)

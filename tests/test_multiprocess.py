@@ -405,7 +405,7 @@ _W2V_ASYNC_WORKER = textwrap.dedent("""
 
     rank = int(os.environ["MV_PROCESS_ID"])
     out_dir = os.environ["MV_TEST_OUT"]
-    mv.init(["w2v", "-sync=false", "-sync_frequency=2"])
+    mv.init(["w2v", "-sync=false", "-sync_frequency=2", "-ssp_staleness=2"])
     assert mv.session().async_bus is not None
 
     # each rank trains a DIFFERENT corpus (same 30-word vocab) from the
