@@ -155,10 +155,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         flat = np.concatenate(
             [np.asarray(l, np.float32).ravel() for l in leaves])
         current = flat_table.get()
-        # every process adds delta/size: the sync aggregate (sum over
-        # processes) and the async bus (every peer applies every add)
-        # both reconstruct the delta exactly once on every replica
-        flat_table.add((flat - current) / mv.size())
+        # scale by the add's actual fan-out: under sync aggregation (sum
+        # over processes) and the async bus (every peer applies every add)
+        # each replica receives size copies of the delta; in ma mode or a
+        # bus-less run the add stays local and must not be scaled down
+        sess = mv.session()
+        fanout = (mv.size() if (mv.get_flag("sync")
+                                or sess.async_bus is not None) else 1)
+        flat_table.add((flat - current) / fanout)
 
     t0 = time.perf_counter()
     gen = batches(data, batch, seq, seed=mv.rank())

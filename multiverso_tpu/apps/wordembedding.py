@@ -653,9 +653,14 @@ def train(
                         mon.begin()
                         loss, count = model.train_device_steps(spc)
                         mon.end()
-                        pusher.tick()
                         if ssp_clock is not None:
+                            # a round must END with its deltas visible,
+                            # or the SSP bound silently widens by the
+                            # publish cadence
+                            pusher.tick(force=True)
                             ssp_clock.tick()
+                        else:
+                            pusher.tick()
                         pending_counts.append(count)
                         if log_every and call_no % log_every == 0:
                             done += float(np.sum(
@@ -702,10 +707,12 @@ def train(
                     pairs += sum(batch_examples(b[2]) for b in pending)
                     pending = []
                     mon.end()
-                    pusher.tick()
                     if ssp_clock is not None:
+                        pusher.tick(force=True)
                         ssp_clock.tick()
                         ssp_clock.wait()
+                    else:
+                        pusher.tick()
                     # exact lr-decay progress in word units (reference word_count)
                     model.set_words_trained(
                         epoch * dictionary.train_words + progress["words"])

@@ -52,6 +52,8 @@ def main(argv=None):
     ap.add_argument("--bf16", type=int, default=1)
     ap.add_argument("--oversample", type=float, default=2.5)
     ap.add_argument("--row_mean", type=int, default=1)
+    ap.add_argument("--static", type=int, default=0,
+                    help="row_mean_static (the shipped bench stabiliser)")
     ap.add_argument("--impl", default="scatter",
                     choices=["scatter", "segsum", "split8"])
     ap.add_argument("--trace", default="")
@@ -76,6 +78,7 @@ def main(argv=None):
                          negative=K, batch_size=B, oversample=args.oversample,
                          neg_pool_size=1 << 22,
                          row_mean_updates=bool(args.row_mean),
+                         row_mean_static=bool(args.static),
                          update_impl=args.impl)
     w_in = mv.create_table("matrix", vocab, D, init_value="random",
                            dtype=dtype, name="w_in")
