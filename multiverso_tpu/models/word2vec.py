@@ -73,12 +73,13 @@ class Word2VecConfig:
     # count before applying lr. The reference applies pairs SEQUENTIALLY
     # (one lr-scaled update per pair); a batched scatter SUMS colliding
     # pair grads, so hot (frequent) rows receive thousands-of-pairs-sized
-    # steps and TRAINING DIVERGES once batch_size is large relative to the
-    # vocabulary (e.g. 64k batch on a 5k vocab). Enable for large batches;
-    # None = auto: the train() driver turns it on only when batch_size is
-    # large relative to the vocabulary (>= row_update_cap expected hits per
-    # row); False = reference-equivalent sum always. Falsy when a Word2Vec
-    # is built directly without resolution, i.e. reference semantics.
+    # steps and TRAINING DIVERGES once hot rows collect enough colliding
+    # grads (zipf head words at 64k batch NaN within one dispatch — vocab
+    # SIZE is not what matters, hot-row mass is). Enable for large batches;
+    # None = auto: the train() driver estimates the hottest row's expected
+    # hits from the sampling laws and enables it past ~512 (stable ~150,
+    # divergent ~2300); False = reference-equivalent sum always. Falsy
+    # when a Word2Vec is built directly without resolution.
     row_mean_updates: Optional[bool] = None
     # scatter-apply strategy for the embedding updates:
     #   "scatter"  — XLA scatter-add straight into the (bf16) table;
