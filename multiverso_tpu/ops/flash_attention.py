@@ -209,7 +209,7 @@ def _fa_call(q, k, v, q_base, k_base, *, causal: bool, scale: float,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 1024,
+                    block_q: int = 1024, block_k: int = 1024,
                     interpret: Optional[bool] = None,
                     precision=None) -> jax.Array:
     """Fused exact attention. ``q/k/v: [seq, heads, head_dim]``.
@@ -292,7 +292,7 @@ def flash_attention_partial(
         q: jax.Array, k: jax.Array, v: jax.Array,
         q_base, k_base, causal: bool = False,
         scale: Optional[float] = None,
-        block_q: int = 512, block_k: int = 1024,
+        block_q: int = 1024, block_k: int = 1024,
         interpret: Optional[bool] = None, precision=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Un-normalised flash block: returns ``(acc [s,h,d], m [h,s], l [h,s])``.
