@@ -271,6 +271,7 @@ def iter_pair_batches(
     cbow: bool = False,
     chunk_words: int = 1 << 20,
     progress: Optional[dict] = None,
+    shard: Tuple[int, int] = (0, 1),
 ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Yield fixed-size (centers, contexts, mask) batches.
 
@@ -284,7 +285,14 @@ def iter_pair_batches(
     ``progress``, if given, is updated in place: ``progress["words"]`` counts
     corpus words consumed so far (pre-subsampling — the reference's
     ``word_count`` semantics) for exact lr-decay tracking.
+
+    ``shard=(i, n)`` keeps only every n-th input line starting at line i —
+    the multi-process data partition (the reference hands each process its
+    own data blocks, ``distributed_wordembedding.cpp:146-178``). Sharding is
+    by RAW line number, before subsampling, so the partition is disjoint and
+    deterministic regardless of each rank's RNG.
     """
+    shard_i, shard_n = shard
     rng = np.random.default_rng(seed)
     discard = subsample_probs(np.asarray(dictionary.counts, np.float64), sample)
     vocab_lookup = dictionary.word2id
@@ -339,7 +347,9 @@ def iter_pair_batches(
             leftover_len = rest[0].shape[0]
 
     with TextReader(corpus_path) as reader:
-        for line in reader:
+        for line_no, line in enumerate(reader):
+            if shard_n > 1 and line_no % shard_n != shard_i:
+                continue
             tokens = line.split()
             arr = np.asarray([vocab_lookup[t] for t in tokens
                               if t in vocab_lookup], dtype=np.int32)
@@ -539,10 +549,29 @@ def train(
     # word-count bookkeeping table (reference KV wordcount table)
     wordcount_table = mv.create_table("kv", name="word2vec_wordcount")
 
+    # Multi-process data parallelism: every process must train DIFFERENT
+    # data, like the reference's per-process data-block partition
+    # (``distributed_wordembedding.cpp:146-178``). The partition unit is
+    # the PROCESS (worker lanes inside a process already split each batch
+    # via the mesh worker axis — they share one data stream). Tables above
+    # are seeded with the SHARED cfg.seed (identical init everywhere); the
+    # model's *sampling* seed folds in the rank so subsampling, window
+    # shrink and negative draws decorrelate, and the corpus itself is
+    # partitioned per process below (stream offset + chunk rotation on the
+    # device path, sentence sharding on the host path).
+    part_i = mv.rank()
+    part_n = max(mv.size(), 1)
+    model_cfg = (cfg if part_n == 1
+                 else dataclasses.replace(cfg, seed=cfg.seed + 100003 * part_i))
     huffman = build_huffman(counts, cfg.max_code_length) if cfg.hs else None
-    model = Word2Vec(cfg, input_table, output_table, counts=counts,
+    model = Word2Vec(model_cfg, input_table, output_table, counts=counts,
                      huffman=huffman)
-    model.total_words = dictionary.train_words * max(epochs, 1)
+    # lr decays over the GLOBAL word count (the reference syncs word_count
+    # through the server's wordcount table); with the corpus partitioned
+    # part_n ways, each process's local counter advances 1/n as fast, so
+    # its decay horizon is the partition's share.
+    words_share = -(-dictionary.train_words // part_n)   # per epoch
+    model.total_words = words_share * max(epochs, 1)
 
     def batch_examples(mask: np.ndarray) -> int:
         if cfg.cbow:
@@ -580,17 +609,30 @@ def train(
         every_calls=max(1, int(mv.get_flag("sync_frequency"))))
     # -ssp_staleness=N bounds worker drift: each training call is one SSP
     # round, and the fastest worker blocks once it is > N rounds ahead.
-    # CONTRACT (the reference sync mode's, src/server.cpp:69-222): workers
-    # must perform equal numbers of training calls per epoch (within the
-    # staleness bound) — skew beyond it deadlocks against the epoch
-    # barrier, exactly as unequal Get/Add counts hung the reference.
-    ssp_clock = None
-    ssp = int(mv.get_flag("ssp_staleness"))
-    if ssp >= 0 and pusher.active:
-        from ..parallel import SSPClock
+    # The clock is per-EPOCH: shard sizes differ by a few batches, so a
+    # process that exhausts its shard first releases laggards with
+    # finish() (the reference FinishTrain clock -> INT_MAX,
+    # ``src/server.cpp:82-139``) instead of deadlocking them against the
+    # epoch barrier; the next epoch starts a fresh generation after the
+    # barrier, restoring the bound.
+    use_ssp = int(mv.get_flag("ssp_staleness")) >= 0 and pusher.active
+    ssp_clock = None   # the CURRENT epoch's clock (released in finally)
 
-        ssp_clock = SSPClock(staleness=ssp)
+    def _epoch_clock():
+        nonlocal ssp_clock
+        if use_ssp:
+            from ..parallel import SSPClock
 
+            ssp_clock = SSPClock(staleness=int(mv.get_flag("ssp_staleness")))
+        return ssp_clock
+
+    def _epoch_clock_done():
+        nonlocal ssp_clock
+        if ssp_clock is not None:
+            ssp_clock.finish()
+            ssp_clock = None
+
+    words_done = 0   # host path: exact words this process consumed
     try:
         if device_corpus:
             # -- device-resident fast path: corpus in HBM, sampling + training
@@ -620,7 +662,9 @@ def train(
                          n_enc, n_chunks, chunk_len)
 
             def chunk_arrays(c):
-                lo = c * chunk_len
+                # processes rotate through chunks with a per-rank phase so
+                # concurrent processes hold DIFFERENT chunks (data partition)
+                lo = ((c + part_i) % n_chunks) * chunk_len
                 if lo + chunk_len <= n_enc:
                     sl = slice(lo, lo + chunk_len)
                     return ids[sl], sent_ids[sl]
@@ -629,6 +673,8 @@ def train(
                         np.concatenate([sent_ids[lo:], sent_ids[:wrap]]))
 
             model.load_corpus_chunk(*chunk_arrays(0), discard)
+            # each process streams its own arc of the (cyclic) chunk
+            model.set_stream_pos((part_i * chunk_len) // part_n)
             spc = cfg.steps_per_call
             m_per_step = model._candidate_batch(chunk_len)
             # The device sampler draws ONE (center, context) pair per corpus
@@ -636,10 +682,14 @@ def train(
             # window (expected window+1 pairs per center,
             # ``wordembedding.cpp:214``). Scale passes so one "epoch" trains
             # the reference's pair count. CBOW is one example per center.
+            # The pair budget is split across processes (reference data
+            # blocks): an epoch is the corpus covered once IN AGGREGATE.
             pair_factor = 1 if cfg.cbow else cfg.window + 1
             calls_per_chunk = max(
-                1, -(-(chunk_len * pair_factor) // (spc * m_per_step)))
+                1, -(-(chunk_len * pair_factor)
+                     // (spc * m_per_step * part_n)))
             for epoch in range(epochs):
+                _epoch_clock()
                 done = 0.0   # running pair count, synced once per log point
                 pending_counts = []
                 call_no = 0
@@ -674,7 +724,10 @@ def train(
                                 float(loss))
                 done += float(np.sum([float(c) for c in pending_counts]))
                 pairs += int(done)
-                wordcount_table.add([0], [dictionary.train_words])
+                # each process reports ITS share of the epoch's words (the
+                # reference adds the per-process word_count)
+                wordcount_table.add([0], [words_share])
+                _epoch_clock_done()
                 pusher.tick(force=True)
                 mv.barrier()   # quiesces the bus: all epoch deltas land
             mode = " [device corpus]"
@@ -683,13 +736,16 @@ def train(
             from ..parallel import prefetch_iterator
 
             for epoch in range(epochs):
+                _epoch_clock()
                 progress = {"words": 0}
                 # loader-thread overlap: batch generation runs ahead on a thread
                 batches = prefetch_iterator(
                     iter_pair_batches(corpus_path, dictionary, cfg.window,
                                       cfg.batch_size, sample=sample,
-                                      cbow=cfg.cbow, seed=cfg.seed + epoch,
-                                      progress=progress),
+                                      cbow=cfg.cbow,
+                                      seed=model_cfg.seed + epoch,
+                                      progress=progress,
+                                      shard=(part_i, part_n)),
                     depth=2 * group)
                 pending = []
                 for step_idx, batch in enumerate(batches):
@@ -713,9 +769,11 @@ def train(
                         ssp_clock.wait()
                     else:
                         pusher.tick()
-                    # exact lr-decay progress in word units (reference word_count)
-                    model.set_words_trained(
-                        epoch * dictionary.train_words + progress["words"])
+                    # exact lr-decay progress in word units (reference
+                    # word_count); progress counts this process's shard, and
+                    # finished epochs contribute their EXACT word counts so
+                    # the counter is monotonic across epoch boundaries
+                    model.set_words_trained(words_done + progress["words"])
                     if log_every and (step_idx + 1) % log_every == 0:
                         elapsed = time.perf_counter() - t0
                         Log.info(
@@ -725,15 +783,17 @@ def train(
                 for centers, contexts, mask in pending:  # tail, one dispatch each
                     loss = model.train_batch(centers, contexts, mask)
                     pairs += batch_examples(mask)
-                wordcount_table.add([0], [dictionary.train_words])
+                words_done += progress["words"]
+                # the reference adds each process's ACTUAL word_count
+                wordcount_table.add([0], [progress["words"]])
+                _epoch_clock_done()
                 pusher.tick(force=True)
                 mv.barrier()   # quiesces the bus: all epoch deltas land
             mode = ""
     finally:
         # always detach the remote accumulators (unbounded growth if
         # left installed after a failed run)
-        if ssp_clock is not None:
-            ssp_clock.finish()
+        _epoch_clock_done()
         pusher.close()
 
     final_loss = float(loss)
@@ -743,7 +803,9 @@ def train(
         save_embeddings(output_path, dictionary, input_table.get())
     # words/sec counts corpus words (reference word_count_actual semantics,
     # WE/src/trainer.cpp:45-48); pairs/sec counts device training examples.
-    words = dictionary.train_words * epochs
+    # Multi-process: this process trained its 1/n partition of each epoch —
+    # exact on the host path, the partition share on the device path.
+    words = words_done if words_done else words_share * epochs
     result = TrainResult(words_trained=words, pairs_trained=pairs,
                          elapsed_s=elapsed,
                          words_per_sec=words / max(elapsed, 1e-9),

@@ -226,7 +226,7 @@ def restore_latest(root: str, session: Optional[Session] = None
     if not dirs:
         return None
     step, name = dirs[-1]
-    restore(os.path.join(root, name), session)
+    restore(_join(root, name), session)
     return step
 
 

@@ -76,9 +76,22 @@ class _KvWriteStream(io.BytesIO):
         self._key = key
         self._uri = uri
         self._committed = False
+        self._aborted = False
+
+    def abort(self) -> None:
+        """Discard the buffer: a subsequent close() uploads nothing."""
+        self._aborted = True
+
+    def __exit__(self, exc_type, exc, tb):
+        # a `with` block that raises mid-write must NOT publish the
+        # truncated object (partial garbage accumulating beside the
+        # manifest-last protocol could be mistaken for valid data)
+        if exc_type is not None:
+            self._aborted = True
+        return super().__exit__(exc_type, exc, tb)
 
     def close(self) -> None:
-        if not self._committed and not self.closed:
+        if not self._committed and not self._aborted and not self.closed:
             self._store.write(self._key, self.getvalue()).result()
             self._committed = True
         super().close()

@@ -262,6 +262,10 @@ class Word2Vec:
         self._step = self._build_step()
         self._words_trained = 0.0  # corpus WORDS (not pairs) — see current_lr
         self.total_words = 0       # set by the driver for lr decay
+        # device-corpus stream cursor (position of the next candidate slab);
+        # persists across chunk loads so rotation continues seamlessly —
+        # see set_stream_pos for the multi-process partition hook
+        self._stream_pos = 0
 
     # -- lr schedule (reference UpdateLearningRate, wordembedding.cpp:38) --
     def current_lr(self) -> float:
@@ -282,6 +286,12 @@ class Word2Vec:
     def set_words_trained(self, words: float) -> None:
         """Exact progress hook for drivers that track corpus words."""
         self._words_trained = float(words)
+
+    def set_stream_pos(self, pos: int) -> None:
+        """Place the device-corpus stream cursor (API contract for the
+        multi-process data partition: each process streams its own arc of
+        the cyclic chunk, so drivers offset the cursor per rank)."""
+        self._stream_pos = int(pos)
 
     def _pairs_to_words(self, pairs: float) -> float:
         return pairs / (self.config.window + 1)
@@ -926,7 +936,7 @@ class Word2Vec:
         lr = jnp.float32(self.current_lr())
         g_in = self._g_in if cfg.use_adagrad else None
         g_out = self._g_out if cfg.use_adagrad else None
-        start0 = getattr(self, "_stream_pos", 0) % n
+        start0 = self._stream_pos % n
         self._stream_pos = (start0 + n_steps * M) % n
         # read-and-rebind of table state stays under BOTH table locks so a
         # concurrent async-PS drain apply can never land between the read

@@ -38,6 +38,28 @@ def test_remote_missing_object_raises():
         open_stream("memory://bucket/nope.bin", "rb")
 
 
+def test_write_stream_aborts_on_exception():
+    """A `with` block that raises mid-write must not publish the partial
+    object (ADVICE r2: truncated garbage beside the manifest-last
+    protocol could be mistaken for valid data)."""
+    from multiverso_tpu.io import remote
+    from multiverso_tpu.io.stream import open_stream
+
+    uri = "memory://bucket/partial.bin"
+    with pytest.raises(RuntimeError):
+        with open_stream(uri, "wb") as s:
+            s.write(b"half-written")
+            raise RuntimeError("mid-write failure")
+    assert not remote.exists(uri)
+
+    # explicit abort() has the same effect
+    s = open_stream("memory://bucket/aborted.bin", "wb")
+    s.write(b"junk")
+    s.abort()
+    s.close()
+    assert not remote.exists("memory://bucket/aborted.bin")
+
+
 def test_remote_exists_probe():
     from multiverso_tpu.io import remote
     from multiverso_tpu.io.stream import open_stream
