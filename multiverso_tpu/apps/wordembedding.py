@@ -549,6 +549,33 @@ def train(
     # word-count bookkeeping table (reference KV wordcount table)
     wordcount_table = mv.create_table("kv", name="word2vec_wordcount")
 
+    ids = sent_ids = None
+    if device_corpus is None or device_corpus:
+        ids, sent_ids = encode_corpus(corpus_path, dictionary)
+        n_enc = int(ids.shape[0])
+        # auto-enable when the corpus fits the HBM budget AND is big enough
+        # that the fast-path defaults pay off (the fused sampler also needs
+        # batch + 2*window positions per step); small corpora fall back to
+        # host streaming, where per-batch dispatch cost doesn't matter
+        min_positions = cfg.batch_size + 2 * cfg.window + 2
+        if device_corpus is None:
+            device_corpus = (n_enc <= _DEVICE_CORPUS_MAX_TOKENS
+                             and n_enc >= max(min_positions, 1 << 16))
+        elif n_enc < min_positions:
+            Log.fatal(f"device_corpus needs at least batch_size + 2*window "
+                      f"positions; corpus has {n_enc}")
+        # corpora over the HBM budget run the device path in rotating
+        # equal-length chunks (handled below); nothing to refuse
+    if device_corpus:
+        # fast-path defaults: fuse many steps per dispatch and oversample
+        # candidates unless the caller chose otherwise. Resolved BEFORE
+        # model construction — Word2Vec validates the static-stabiliser
+        # oversample prerequisite at __init__.
+        if cfg.steps_per_call <= 1 and not explicit_spc:
+            cfg.steps_per_call = 32
+        if cfg.oversample <= 1 and not explicit_ovs:
+            cfg.oversample = 2.5
+
     # Multi-process data parallelism: every process must train DIFFERENT
     # data, like the reference's per-process data-block partition
     # (``distributed_wordembedding.cpp:146-178``). The partition unit is
@@ -582,24 +609,6 @@ def train(
     loss = 0.0
     t0 = time.perf_counter()
     mon = Dashboard.get_or_create("W2V_TRAIN_BATCH")
-
-    ids = sent_ids = None
-    if device_corpus is None or device_corpus:
-        ids, sent_ids = encode_corpus(corpus_path, dictionary)
-        n_enc = int(ids.shape[0])
-        # auto-enable when the corpus fits the HBM budget AND is big enough
-        # that the fast-path defaults pay off (the fused sampler also needs
-        # batch + 2*window positions per step); small corpora fall back to
-        # host streaming, where per-batch dispatch cost doesn't matter
-        min_positions = cfg.batch_size + 2 * cfg.window + 2
-        if device_corpus is None:
-            device_corpus = (n_enc <= _DEVICE_CORPUS_MAX_TOKENS
-                             and n_enc >= max(min_positions, 1 << 16))
-        elif n_enc < min_positions:
-            Log.fatal(f"device_corpus needs at least batch_size + 2*window "
-                      f"positions; corpus has {n_enc}")
-        # corpora over the HBM budget run the device path in rotating
-        # equal-length chunks (handled below); nothing to refuse
 
     # async multi-process: publish own-training deltas every
     # -sync_frequency calls (reference AddDeltaParameter cadence); inactive
@@ -636,14 +645,7 @@ def train(
     try:
         if device_corpus:
             # -- device-resident fast path: corpus in HBM, sampling + training
-            #    fused into multi-step dispatches --------------------------------
-            # fast-path defaults: fuse many steps per dispatch and oversample
-            # candidates unless the caller chose otherwise (cfg is read lazily
-            # by the fused builder, so this runs before any compilation)
-            if cfg.steps_per_call <= 1 and not explicit_spc:
-                cfg.steps_per_call = 32
-            if cfg.oversample <= 1 and not explicit_ovs:
-                cfg.oversample = 2.5
+            #    fused into multi-step dispatches (defaults resolved above) --
             discard = subsample_probs(counts, sample).astype(np.float32)
             n_enc = int(ids.shape[0])
             # Corpora over the HBM budget rotate through EQUAL-length chunks
@@ -805,7 +807,7 @@ def train(
     # WE/src/trainer.cpp:45-48); pairs/sec counts device training examples.
     # Multi-process: this process trained its 1/n partition of each epoch —
     # exact on the host path, the partition share on the device path.
-    words = words_done if words_done else words_share * epochs
+    words = words_share * epochs if device_corpus else words_done
     result = TrainResult(words_trained=words, pairs_trained=pairs,
                          elapsed_s=elapsed,
                          words_per_sec=words / max(elapsed, 1e-9),

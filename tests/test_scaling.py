@@ -1,0 +1,42 @@
+"""Scaling-harness floor (VERDICT r2 item 2): the dp weak-scaling sweep
+runs, its efficiency accounting is sane, and the timeshare-normalized
+efficiency clears a floor on the virtual mesh.
+
+The floor is deliberately loose: virtual CPU devices timeshare
+``os.cpu_count()`` real cores, so the normalized number still contains
+the dense grad-table allreduce cost through host memory (see
+docs/DISTRIBUTED.md "Measured" section). On real chips the same sweep
+must clear the BASELINE.json bar (>= 0.9 at 8->64); here the test
+guards the methodology and catches regressions that would tank even the
+rehearsal number (e.g. a sharding change that re-replicates the batch or
+adds a per-step host sync).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+
+def test_w2v_weak_scaling_efficiency_floor():
+    from tools.scaling_bench import quick_sweep
+
+    rows = quick_sweep([1, 8])
+    by_dp = {r["dp"]: r for r in rows}
+    assert by_dp[1]["eff_norm"] == 1.0
+    for r in rows:
+        assert np.isfinite(r["pairs_per_sec"]) and r["pairs_per_sec"] > 0
+        assert 0.0 < r["eff_raw"] <= 1.0 + 1e-9
+    # floor: sharding/collective overhead must not exceed ~3x ideal
+    assert by_dp[8]["eff_norm"] >= 0.3, rows
+
+
+def test_collective_sweep_bandwidths_sane():
+    from tools.scaling_bench import collective_sweep
+
+    rows = collective_sweep([1, 8], payload_mb=1.0, repeats=3, inner=4)
+    assert {(r["op"], r["dp"]) for r in rows} == {
+        ("psum", 1), ("psum", 8), ("all_gather", 1), ("all_gather", 8)}
+    for r in rows:
+        assert r["time_ms"] > 0 and np.isfinite(r["algbw_gbps"])
+        assert r["algbw_gbps"] > 0

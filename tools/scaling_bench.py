@@ -1,0 +1,334 @@
+"""Virtual-mesh dp scaling harness (VERDICT r2 item 2).
+
+Rehearses the BASELINE.json scaling methodology (docs/DISTRIBUTED.md
+"Scaling methodology") with MEASURED numbers instead of prose: runs the
+jitted word2vec train step and raw `psum`/`all_gather` collectives at
+dp = 1/2/4/8 on the virtual CPU mesh and reports weak-scaling efficiency
+and collective time/byte.
+
+Honesty note baked into the output: this host exposes N virtual devices
+over `os.cpu_count()` real cores. When cores < devices the devices
+TIMESHARE the cores, so raw weak-scaling efficiency is bounded by
+cores/dp regardless of framework overhead. The number that transfers to
+real hardware (one core/chip per device) is the *normalized* efficiency
+
+    eff_norm(dp) = dp * T(1) / (min(dp, cores) * T(dp))
+
+which charges the unavoidable compute timesharing to the machine and
+leaves sharding/collective overhead — the thing the framework controls —
+in the measurement. On a real pod (cores >= dp) eff_norm == raw
+efficiency, i.e. the reference's 3.40x/4-worker-style number
+(`binding/python/docs/BENCHMARK.md:54-57`).
+
+Usage:
+  python tools/scaling_bench.py [--devices 8] [--json] [--quick]
+  python tools/scaling_bench.py --out docs/DISTRIBUTED.md   # rewrite table
+
+The same sweep (tiny shapes) runs inside ``__graft_entry__.dryrun_multichip``
+so every round's MULTICHIP_r*.json records the numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+if __name__ == "__main__":
+    # CLI runs own the process: pin the virtual CPU mesh BEFORE the jax
+    # import below fixes the backend. Library importers (the dryrun, the
+    # tests) already configured their platform — mutating it for them
+    # mid-process would silently retarget all their jax work.
+    _i = sys.argv.index("--devices") if "--devices" in sys.argv else -1
+    _n = sys.argv[_i + 1] if 0 <= _i < len(sys.argv) - 1 else "8"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count={_n}").strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _best_of(fn, repeats: int) -> float:
+    """Best-of-N wall time of ``fn()`` (each call must block)."""
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def collective_sweep(dps, payload_mb: float = 4.0, repeats: int = 5,
+                     inner: int = 4):
+    """Time `psum` and `all_gather` on a fixed PER-DEVICE payload at each dp.
+
+    Returns rows with per-op wall time and algorithmic bandwidth
+    (payload / time — the BASELINE methodology's `mv.aggregate` probe,
+    step 2). ``inner`` chained ops per dispatch amortise dispatch cost.
+    """
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n_elem = int(payload_mb * (1 << 20) / 4)
+    rows = []
+    for dp in dps:
+        devs = np.array(jax.devices()[:dp])
+        mesh = Mesh(devs, ("dp",))
+        x = jax.device_put(
+            np.ones((dp, n_elem), np.float32),
+            jax.sharding.NamedSharding(mesh, P("dp")))
+
+        @jax.jit
+        def psum_n(x):
+            def one(v):
+                # re-introduce per-shard variance (0*idx) so the scan carry
+                # stays device-varying after the collective reduces it
+                idx = jax.lax.axis_index("dp").astype(v.dtype)
+
+                def body(c, _):
+                    return jax.lax.psum(c, "dp") / dp + 0.0 * idx, None
+                return jax.lax.scan(body, v, None, length=inner)[0]
+            f = shard_map(one, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+            return f(x)
+
+        @jax.jit
+        def gather_n(x):
+            def one(v):
+                idx = jax.lax.axis_index("dp").astype(v.dtype)
+
+                # fold the gathered axis back down so the carry shape is
+                # stable under scan (sum stands in for "consume the copy")
+                def body(c, _):
+                    g = jax.lax.all_gather(c, "dp")      # [dp, n]
+                    return g.sum(axis=0) / dp + 0.0 * idx, None
+                return jax.lax.scan(body, v, None, length=inner)[0]
+            f = shard_map(one, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+            return f(x)
+
+        for name, fn in (("psum", psum_n), ("all_gather", gather_n)):
+            fn(x).block_until_ready()       # compile
+            t = _best_of(lambda: fn(x).block_until_ready(), repeats) / inner
+            rows.append({
+                "op": name, "dp": dp, "payload_mb": payload_mb,
+                "time_ms": t * 1e3,
+                # algorithmic bandwidth: bytes reduced/gathered per second
+                "algbw_gbps": (payload_mb / 1024) / t,
+            })
+    return rows
+
+
+def w2v_weak_scaling(dps, per_dev_batch: int = 2048, vocab: int = 20000,
+                     dim: int = 128, steps: int = 4, repeats: int = 5):
+    """Weak-scaling sweep of the REAL jitted word2vec train step.
+
+    Fixed per-device batch; the batch axis is sharded over the mesh
+    ``worker`` axis and the replicated tables force XLA to insert the dp
+    gradient-sync collectives — the exact program a dp pod runs
+    (BASELINE methodology step 1, per-step form).
+    """
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
+    from multiverso_tpu.runtime import Session
+
+    rows = []
+    for dp in dps:
+        Session._instance = None
+        mv.set_flag("mesh_shape", f"{dp},1")
+        mv.init([f"scale{dp}", "-log_level=error"])
+        try:
+            batch = per_dev_batch * dp
+            cfg = Word2VecConfig(vocab_size=vocab, embedding_size=dim,
+                                 negative=5, batch_size=batch,
+                                 steps_per_call=steps, seed=3)
+            w_in = mv.create_table("matrix", vocab, dim, init_value="random")
+            w_out = mv.create_table("matrix", vocab, dim)
+            model = Word2Vec(cfg, w_in, w_out,
+                             counts=np.ones(vocab, np.float64))
+            rng = np.random.default_rng(dp)
+            centers = rng.integers(0, vocab, (steps, batch)).astype(np.int32)
+            contexts = rng.integers(0, vocab, (steps, batch)).astype(np.int32)
+            mask = np.ones((steps, batch), np.float32)
+
+            def run():
+                float(model.train_batches(centers, contexts, mask))
+
+            run()                            # compile
+            t = _best_of(run, repeats)
+            rows.append({
+                "dp": dp, "batch": batch, "time_ms": t * 1e3,
+                "pairs_per_sec": steps * batch / t,
+            })
+        finally:
+            mv.shutdown()
+            mv.set_flag("mesh_shape", "")
+            Session._instance = None
+    return rows
+
+
+def efficiencies(rows, cores: int):
+    """Raw + timeshare-normalized weak-scaling efficiency vs the dp=1 row.
+
+    Ideal weak-scaling wall time with C cores timesharing dp devices is
+    ``T(1) * dp / min(dp, C)`` (total compute scales with dp; at most
+    min(dp, C) cores execute it). eff = ideal / actual.
+    """
+    t1 = next(r["time_ms"] for r in rows if r["dp"] == 1)
+    out = []
+    for r in rows:
+        dp = r["dp"]
+        raw = t1 / r["time_ms"]
+        norm = dp * t1 / (min(dp, cores) * r["time_ms"])
+        # measurement noise can push either ratio past 1 on fast hosts
+        out.append({**r, "eff_raw": min(raw, 1.0), "eff_norm": min(norm, 1.0),
+                    "overhead_frac": max(0.0, 1.0 - norm)})
+    return out
+
+
+def quick_sweep(dps):
+    """The ONE quick-shape rehearsal parameterization — shared by the
+    dryrun (`__graft_entry__.dryrun_multichip`), the test floor
+    (`tests/test_scaling.py`) and `run_sweep(quick=True)`, so all three
+    measure the same program."""
+    return efficiencies(
+        w2v_weak_scaling(dps, per_dev_batch=512, vocab=4096, dim=64,
+                         steps=4, repeats=3),
+        os.cpu_count() or 1)
+
+
+def run_sweep(n_devices: int = 8, quick: bool = False):
+    dps = [d for d in (1, 2, 4, 8) if d <= n_devices]
+    cores = os.cpu_count() or 1
+    if quick:
+        w2v = quick_sweep(dps)
+    else:
+        w2v = efficiencies(
+            w2v_weak_scaling(dps, per_dev_batch=2048, vocab=20000,
+                             dim=128, repeats=5),
+            cores)
+    coll = collective_sweep(dps, payload_mb=1.0 if quick else 4.0,
+                            repeats=3 if quick else 5)
+    return {"cores": cores, "devices": n_devices, "w2v": w2v,
+            "collectives": coll}
+
+
+_BEGIN = "<!-- scaling_bench:begin -->"
+_END = "<!-- scaling_bench:end -->"
+
+
+def render_markdown(res) -> str:
+    cores = res["cores"]
+    lines = [
+        _BEGIN,
+        "### Measured: virtual-mesh dp weak scaling (this host)",
+        "",
+        f"`tools/scaling_bench.py` on {res['devices']} virtual CPU devices "
+        f"over **{cores} real core(s)**. With cores < dp the devices",
+        "timeshare the cores, so raw efficiency is bounded by cores/dp",
+        "by construction; `eff_norm = dp*T(1)/(min(dp, cores)*T(dp))`",
+        "charges that to the machine and isolates the framework's",
+        "sharding + collective overhead — the quantity the ≥90%",
+        "8→64-chip target is about (each real chip has its own compute).",
+        "",
+        "word2vec jitted train step, fixed per-device batch "
+        "(weak scaling):",
+        "",
+        "| dp | global batch | step ms | pairs/s | eff_raw | eff_norm | "
+        "sync overhead |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in res["w2v"]:
+        lines.append(
+            f"| {r['dp']} | {r['batch']} | {r['time_ms']:.1f} "
+            f"| {r['pairs_per_sec']:.3g} | {r['eff_raw']:.2f} "
+            f"| {r['eff_norm']:.2f} | {r['overhead_frac'] * 100:.0f}% |")
+    lines += [
+        "",
+        "Raw collectives, fixed per-device payload "
+        f"({res['collectives'][0]['payload_mb']:g} MB f32):",
+        "",
+        "| op | dp | time/op ms | algbw GB/s |",
+        "|---|---|---|---|",
+    ]
+    for r in res["collectives"]:
+        lines.append(f"| {r['op']} | {r['dp']} | {r['time_ms']:.2f} "
+                     f"| {r['algbw_gbps']:.2f} |")
+    lines += [
+        "",
+        "The dominant overhead term is the dense grad-table allreduce the "
+        "replicated-table dp program implies (2 tables x vocab x dim x 4 B "
+        "per fused step — tens of MB/call at these shapes) squeezed "
+        "through a one-core memcpy at the psum rates above; the sparse "
+        "path (`get_dirty_rows` keyed publication) exists precisely to cut "
+        "that term, and on-chip ICI moves it at 2-3 orders of magnitude "
+        "higher bandwidth. On real v5e the same sweep runs unchanged per "
+        "chip count (methodology steps 1-2 above); the CPU-mesh numbers "
+        "validate that the framework side of the loop (sharding, program, "
+        "collectives) holds its overhead budget before pod time is spent.",
+        _END,
+    ]
+    return "\n".join(lines)
+
+
+def splice_into(path: str, block: str) -> None:
+    with open(path) as f:
+        text = f.read()
+    markers_ok = (_BEGIN in text and _END in text
+                  and text.index(_BEGIN) < text.index(_END))
+    if markers_ok:
+        pre = text[:text.index(_BEGIN)]
+        post = text[text.index(_END) + len(_END):]
+        text = pre + block + post
+    else:
+        # insert after the "Scaling methodology" numbered list (before the
+        # next ## heading)
+        anchor = "## Failure recovery"
+        if anchor in text:
+            text = text.replace(anchor, block + "\n\n" + anchor)
+        else:
+            text = text.rstrip() + "\n\n" + block + "\n"
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="print the sweep as one JSON object")
+    ap.add_argument("--out", default="",
+                    help="markdown file to splice the results table into")
+    args = ap.parse_args(argv)
+
+    res = run_sweep(args.devices, quick=args.quick)
+    if args.json:
+        print(json.dumps(res))
+    else:
+        for r in res["w2v"]:
+            print(f"w2v dp={r['dp']}: {r['time_ms']:.1f} ms "
+                  f"eff_raw {r['eff_raw']:.2f} eff_norm {r['eff_norm']:.2f}",
+                  flush=True)
+        for r in res["collectives"]:
+            print(f"{r['op']} dp={r['dp']}: {r['time_ms']:.2f} ms "
+                  f"({r['algbw_gbps']:.2f} GB/s)", flush=True)
+    if args.out:
+        splice_into(args.out, render_markdown(res))
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
