@@ -6,11 +6,15 @@ Metric: word2vec skip-gram negative-sampling training pairs/sec at the
 reference's NAMED configuration shape — text8: ~71k vocabulary, 200-dim
 embeddings (BASELINE.json config 2; the corpus itself is synthesised with a
 zipf unigram law because this environment has no network egress, but vocab
-size, dimensionality, window, negatives and subsampling all match). Exact
-per-pair negative draws; updates use the capped row-mean stabiliser
-(quality parity documented in docs/EMBEDDING_QUALITY.md) because raw
-summed updates DIVERGE at 64k batch on a zipf corpus — see the auto rule
-in apps/wordembedding.py.
+size, dimensionality, window, negatives and subsampling all match).
+Negative draws are group-shared at G=4 (the round-3 default: the largest
+group size at quality parity on the docs/EMBEDDING_QUALITY.md probe —
+purity within 0.02, cos-gap within 10% of the reference-semantics
+baseline; exact per-pair draws remain one flag away,
+`-shared_negatives=0`). Updates use the capped row-mean stabiliser
+(quality parity in the same doc) because raw summed updates DIVERGE at
+64k batch on a zipf corpus — see the auto rule in apps/wordembedding.py.
+Config provenance/freeze: BASELINE.md "bench.py config provenance".
 
 ``vs_baseline`` is the ratio against 1.0M pairs/sec, the ballpark of the
 reference C++ implementation's per-host throughput on its published hardware
@@ -57,11 +61,12 @@ def main() -> int:
                                                    subsample_probs)
     from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
 
-    # default = exact per-pair negative draws (reference semantics).
-    # `python bench.py -shared_negatives=8` reproduces the faster
-    # group-shared sampling mode documented in the README (parsed by the
-    # framework's own flag registry, like every other option).
-    mv.define_int("shared_negatives", 0,
+    # default = G=4 group-shared draws (largest G at measured quality
+    # parity — docs/EMBEDDING_QUALITY.md); `-shared_negatives=0` restores
+    # exact per-pair reference semantics, `=8` the faster mode outside
+    # the parity bar (parsed by the framework's own flag registry, like
+    # every other option).
+    mv.define_int("shared_negatives", 4,
                   "share each K-negative draw across G consecutive pairs")
 
     corpus = "/tmp/mv_bench_corpus_text8.txt"
