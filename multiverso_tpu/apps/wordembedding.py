@@ -454,8 +454,10 @@ class _AsyncDeltaPusher:
                 own = cur - self._snaps[i] - t._remote_accum
                 t._remote_accum[...] = 0.0
                 self._snaps[i] = cur
-            self.bus.publish_dense(t.table_id, own.astype(t.dtype),
-                                   self._option)
+            # keyed touched-row publication when movement is sparse (the
+            # bus picks; a -sync_frequency=1 w2v epoch touches most rows,
+            # larger cadences and sparse models publish only what moved)
+            self.bus.publish_delta(t, own.astype(t.dtype), self._option)
 
     def close(self) -> None:
         if not self.active:

@@ -204,5 +204,11 @@ define_int("async_poll_ms", 20,
            "async PS: drain-thread poll interval (bounds peer-delta staleness)")
 define_int("ssp_staleness", -1,
            "async PS: SSP round gap bound (-1 = unbounded/plain async)")
+define_int("async_max_record_kb", 1024,
+           "async PS: wire records larger than this split into parts "
+           "(coordination-service gRPC message-size safety)")
+define_int("async_max_inflight_mb", 64,
+           "async PS: publisher backpressure watermark — publish blocks "
+           "while un-acked published bytes exceed this")
 define_string("log_file", "", "optional log sink file")
 define_string("log_level", "info", "debug|info|error|fatal")

@@ -59,9 +59,14 @@ class Monitor:
             return
         elapsed = (time.perf_counter() - t0) * 1e3
         self._local.t0 = None
+        self.record(elapsed)
+
+    def record(self, elapsed_ms: float) -> None:
+        """Fold an externally-measured duration (e.g. a cross-process
+        publish->apply latency carried in a wire record)."""
         with self._lock:
             self.count += 1
-            self.total_ms += elapsed
+            self.total_ms += elapsed_ms
 
     def average_ms(self) -> float:
         with self._lock:

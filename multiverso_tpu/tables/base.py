@@ -265,6 +265,20 @@ class TableBase:
                 accum += np.asarray(host, accum.dtype)
             self._apply_dense(host, option)
 
+    def _apply_remote_keyed(self, ids: np.ndarray, vals: np.ndarray,
+                            option: AddOption) -> None:
+        """Bus entry point for a peer's keyed (touched-row) delta. Like
+        :meth:`_apply_remote_dense`, it must feed the remote-delta
+        accumulator — atomically with the apply, or a concurrent pusher
+        snapshot would count the peer rows as own movement and republish
+        them (echo amplification)."""
+        with self._lock:
+            accum = getattr(self, "_remote_accum", None)
+            if accum is not None:
+                np.add.at(accum, np.asarray(ids, np.int64).ravel(),
+                          np.asarray(vals, accum.dtype))
+            self._dispatch_keyed(ids, vals, option)
+
     def _apply_dense(self, host: np.ndarray, option: AddOption) -> None:
         """Fold a logical-shape host delta into the replica (jitted updater
         step on the sharded state). Shared by local Adds and the async-PS
@@ -297,8 +311,9 @@ class TableBase:
             host = host.copy()
             self._sess.aggregate(host)
         elif self._sess.async_bus is not None:
-            # async PS: peers fold this delta via their drain threads
-            self._sess.async_bus.publish_dense(self.table_id, host, option)
+            # async PS: peers fold this delta via their drain threads; the
+            # bus picks keyed touched-row or dense representation
+            self._sess.async_bus.publish_delta(self, host, option)
         self._apply_dense(host, option)
         return self._add_handle()
 

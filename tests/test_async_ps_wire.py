@@ -19,7 +19,7 @@ def test_dense_record_round_trip():
     delta = np.arange(12, dtype=np.float32)
     blobs = SparseFilter(clip=0.0, dtype=np.float32).filter_in([delta])
     data = async_ps._serialize(async_ps.DENSE, 7, opt, blobs)
-    kind, table_id, opt2, arrays = async_ps._deserialize(data)
+    kind, table_id, opt2, arrays, ts = async_ps._deserialize(data)
     assert (kind, table_id) == (async_ps.DENSE, 7)
     assert opt2.worker_id == 3
     assert opt2.learning_rate == pytest.approx(0.125)
@@ -34,7 +34,7 @@ def test_keyed_record_preserves_dtypes():
     ids = np.array([5, 1, 9], np.int32)
     vals = np.arange(6, dtype=np.float64).reshape(3, 2) * 0.1
     data = async_ps._serialize(async_ps.KEYED, 2, None, [ids, vals])
-    kind, table_id, opt, (ids2, vals2) = async_ps._deserialize(data)
+    kind, table_id, opt, (ids2, vals2), ts = async_ps._deserialize(data)
     assert kind == async_ps.KEYED and table_id == 2
     assert ids2.dtype == np.int32 and vals2.dtype == np.float64
     np.testing.assert_array_equal(ids2, ids)
@@ -47,7 +47,7 @@ def test_bfloat16_wire_round_trip():
 
     arr = np.array([1.5, -2.5, 0.0, 3.0], ml_dtypes.bfloat16)
     data = async_ps._serialize(async_ps.DENSE, 0, None, [arr])
-    _, _, _, (out,) = async_ps._deserialize(data)
+    _, _, _, (out,), _ = async_ps._deserialize(data)
     assert out.dtype == np.dtype(ml_dtypes.bfloat16)
     np.testing.assert_array_equal(out.astype(np.float32),
                                   arr.astype(np.float32))
@@ -57,10 +57,45 @@ def test_kv_record():
     keys = np.array([7, -3], np.int64)
     vals = np.array([1.0, 0.5], np.float64)
     data = async_ps._serialize(async_ps.KV, 1, None, [keys, vals])
-    kind, table_id, _, (k2, v2) = async_ps._deserialize(data)
+    kind, table_id, _, (k2, v2), _ = async_ps._deserialize(data)
     assert kind == async_ps.KV
     np.testing.assert_array_equal(k2, keys)
     np.testing.assert_array_equal(v2, vals)
+
+
+def test_part_records_reassemble_to_one_apply():
+    """Wire chunking: PART records at consecutive seqs reassemble into ONE
+    logical record and apply exactly once; an out-of-order part drops the
+    partial buffer instead of corrupting the stream."""
+    opt = AddOption(worker_id=1)
+    vals = np.arange(64, dtype=np.float32)
+    payload = async_ps._serialize(async_ps.KEYED, 5, opt,
+                                  [np.arange(8, dtype=np.int32), vals])
+    maxb = 16
+    n_parts = -(-len(payload) // maxb)
+    parts = [async_ps._PART_HEADER.pack(async_ps.PART, i, n_parts)
+             + payload[i * maxb:(i + 1) * maxb] for i in range(n_parts)]
+
+    bus = object.__new__(async_ps.AsyncDeltaBus)
+    bus._parts = {}
+    applied = []
+    bus._apply = applied.append
+    for p in parts:
+        bus._consume(0, p)
+    assert applied == [payload]           # one apply, exact bytes
+    assert bus._parts[0] == []
+
+    # out-of-order part (index 1 first) is rejected, buffer stays clean
+    bus._consume(0, parts[1])
+    assert applied == [payload]
+    bus._consume(0, parts[0])             # restart from index 0 works
+    for p in parts[1:]:
+        bus._consume(0, p)
+    assert applied == [payload, payload]
+
+    # non-PART records pass straight through
+    bus._consume(0, payload)
+    assert applied == [payload, payload, payload]
 
 
 def test_sparse_filter_compresses_sparse_dense_payload():
@@ -72,6 +107,6 @@ def test_sparse_filter_compresses_sparse_dense_payload():
     blobs = f.filter_in([delta])
     wire = async_ps._serialize(async_ps.DENSE, 0, None, blobs)
     assert len(wire) < delta.nbytes // 2   # actually compressed
-    _, _, _, arrays = async_ps._deserialize(wire)
+    _, _, _, arrays, _ = async_ps._deserialize(wire)
     out = f.filter_out(arrays)[0]
     np.testing.assert_array_equal(out, delta)

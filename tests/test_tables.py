@@ -239,3 +239,29 @@ def test_uneven_array_with_stateful_updater(mv_session):
     assert got.shape == (n,)
     # adagrad moves every logical element identically (uniform delta)
     assert np.allclose(got, got[0]) and got[0] < 0
+
+
+def test_apply_remote_keyed_feeds_remote_accum(mv_session):
+    """Keyed bus applies must feed the remote-delta accumulator exactly like
+    dense ones (r3 review: a keyed peer delta missing from _remote_accum is
+    counted as own movement by the async pusher and republished — echo
+    amplification)."""
+    import numpy as np
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.updaters import AddOption
+
+    t = mv.create_table("matrix", 8, 4)
+    t._remote_accum = np.zeros((8, 4), np.float32)
+    ids = np.array([1, 6, 1], np.int32)           # repeated id accumulates
+    vals = np.full((3, 4), 0.5, np.float32)
+    t._apply_remote_keyed(ids, vals, AddOption())
+    got = t.get()
+    assert np.allclose(got[1], 1.0) and np.allclose(got[6], 0.5)
+    assert np.allclose(t._remote_accum[1], 1.0)
+    assert np.allclose(t._remote_accum[6], 0.5)
+    assert np.allclose(t._remote_accum[0], 0.0)
+    # own-movement computation nets out the peer delta exactly
+    own = np.asarray(t.get(), np.float32) - 0.0 - t._remote_accum
+    assert np.allclose(own, 0.0)
+    t._remote_accum = None
