@@ -1,0 +1,568 @@
+// Lua 5.1 syntax checker for the binding sources (VERDICT r2 item 7).
+//
+// No Lua interpreter ships in this environment, so binding/lua/*.lua could
+// not be parsed by anything in CI — a syntax error would ship silently
+// (the ABI replay, cpp/mvtpu/lua_abi_replay.cc, covers the C-ABI semantics
+// but never reads the .lua files). This is a full lexer + recursive-descent
+// parser for the Lua 5.1 grammar (reference manual §8); it accepts exactly
+// the syntactically valid programs and reports the first error per file
+// with line numbers. Run: lua_check FILE... (exit 1 on any error).
+//
+// Reference counterpart: the reference runs binding/lua/test.lua under
+// torch/LuaJIT (binding/lua/README.md), which implies a parse.
+
+#include <cctype>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace {
+
+enum TokKind {
+  TK_EOF, TK_NAME, TK_NUMBER, TK_STRING,
+  // keywords
+  TK_AND, TK_BREAK, TK_DO, TK_ELSE, TK_ELSEIF, TK_END, TK_FALSE, TK_FOR,
+  TK_FUNCTION, TK_IF, TK_IN, TK_LOCAL, TK_NIL, TK_NOT, TK_OR, TK_REPEAT,
+  TK_RETURN, TK_THEN, TK_TRUE, TK_UNTIL, TK_WHILE,
+  // symbols
+  TK_PLUS, TK_MINUS, TK_STAR, TK_SLASH, TK_PERCENT, TK_CARET, TK_HASH,
+  TK_EQ, TK_NE, TK_LE, TK_GE, TK_LT, TK_GT, TK_ASSIGN, TK_LPAREN, TK_RPAREN,
+  TK_LBRACE, TK_RBRACE, TK_LBRACKET, TK_RBRACKET, TK_SEMI, TK_COLON,
+  TK_COMMA, TK_DOT, TK_CONCAT, TK_ELLIPSIS,
+};
+
+struct Token {
+  TokKind kind;
+  std::string text;
+  int line;
+};
+
+struct SyntaxError : std::runtime_error {
+  explicit SyntaxError(const std::string& m) : std::runtime_error(m) {}
+};
+
+class Lexer {
+ public:
+  Lexer(const std::string& src, const std::string& file)
+      : s_(src), file_(file) {}
+
+  Token next() {
+    skip_space_and_comments();
+    Token t;
+    t.line = line_;
+    if (pos_ >= s_.size()) { t.kind = TK_EOF; return t; }
+    char c = s_[pos_];
+    if (std::isalpha(static_cast<unsigned char>(c)) || c == '_')
+      return name_or_keyword();
+    if (std::isdigit(static_cast<unsigned char>(c)) ||
+        (c == '.' && pos_ + 1 < s_.size() &&
+         std::isdigit(static_cast<unsigned char>(s_[pos_ + 1]))))
+      return number();
+    if (c == '"' || c == '\'') return short_string();
+    if (c == '[') {
+      size_t lvl;
+      if (long_bracket_level(&lvl)) return long_string(lvl);
+      ++pos_; t.kind = TK_LBRACKET; return t;
+    }
+    return symbol();
+  }
+
+  [[noreturn]] void err(int line, const std::string& msg) const {
+    std::ostringstream os;
+    os << file_ << ":" << line << ": " << msg;
+    throw SyntaxError(os.str());
+  }
+
+ private:
+  void skip_space_and_comments() {
+    for (;;) {
+      while (pos_ < s_.size() &&
+             std::isspace(static_cast<unsigned char>(s_[pos_]))) {
+        if (s_[pos_] == '\n') ++line_;
+        ++pos_;
+      }
+      if (pos_ + 1 < s_.size() && s_[pos_] == '-' && s_[pos_ + 1] == '-') {
+        pos_ += 2;
+        size_t lvl;
+        if (pos_ < s_.size() && s_[pos_] == '[' && long_bracket_level(&lvl)) {
+          long_string(lvl);   // long comment body
+        } else {
+          while (pos_ < s_.size() && s_[pos_] != '\n') ++pos_;
+        }
+        continue;
+      }
+      return;
+    }
+  }
+
+  // at '[': true iff an opening long bracket '[' '='* '[' starts here
+  bool long_bracket_level(size_t* lvl) const {
+    size_t p = pos_ + 1, eq = 0;
+    while (p < s_.size() && s_[p] == '=') { ++eq; ++p; }
+    if (p < s_.size() && s_[p] == '[') { *lvl = eq; return true; }
+    return false;
+  }
+
+  Token long_string(size_t lvl) {
+    Token t; t.kind = TK_STRING; t.line = line_;
+    pos_ += 2 + lvl;                       // consume '[' '='* '['
+    if (pos_ < s_.size() && s_[pos_] == '\n') { ++line_; ++pos_; }
+    std::string close = "]" + std::string(lvl, '=') + "]";
+    for (;;) {
+      if (pos_ >= s_.size()) err(t.line, "unterminated long string/comment");
+      if (s_[pos_] == ']' && s_.compare(pos_, close.size(), close) == 0) {
+        pos_ += close.size();
+        return t;
+      }
+      if (s_[pos_] == '\n') ++line_;
+      ++pos_;
+    }
+  }
+
+  Token short_string() {
+    Token t; t.kind = TK_STRING; t.line = line_;
+    char quote = s_[pos_++];
+    for (;;) {
+      if (pos_ >= s_.size() || s_[pos_] == '\n')
+        err(t.line, "unterminated string");
+      char c = s_[pos_++];
+      if (c == quote) return t;
+      if (c == '\\') {
+        if (pos_ >= s_.size()) err(t.line, "unterminated string escape");
+        if (s_[pos_] == '\n') ++line_;
+        ++pos_;                            // any escaped char (incl. \n)
+      }
+    }
+  }
+
+  Token number() {
+    Token t; t.kind = TK_NUMBER; t.line = line_;
+    size_t start = pos_;
+    if (s_[pos_] == '0' && pos_ + 1 < s_.size() &&
+        (s_[pos_ + 1] == 'x' || s_[pos_ + 1] == 'X')) {
+      pos_ += 2;
+      while (pos_ < s_.size() &&
+             std::isxdigit(static_cast<unsigned char>(s_[pos_]))) ++pos_;
+      if (pos_ == start + 2) err(t.line, "malformed hex number");
+      return t;
+    }
+    bool seen_dot = false, seen_exp = false;
+    while (pos_ < s_.size()) {
+      char c = s_[pos_];
+      if (std::isdigit(static_cast<unsigned char>(c))) { ++pos_; continue; }
+      if (c == '.' && !seen_dot && !seen_exp) { seen_dot = true; ++pos_; continue; }
+      if ((c == 'e' || c == 'E') && !seen_exp) {
+        seen_exp = true; ++pos_;
+        if (pos_ < s_.size() && (s_[pos_] == '+' || s_[pos_] == '-')) ++pos_;
+        if (pos_ >= s_.size() ||
+            !std::isdigit(static_cast<unsigned char>(s_[pos_])))
+          err(t.line, "malformed number exponent");
+        continue;
+      }
+      break;
+    }
+    if (pos_ < s_.size() &&
+        (std::isalpha(static_cast<unsigned char>(s_[pos_])) || s_[pos_] == '_'))
+      err(t.line, "malformed number");
+    return t;
+  }
+
+  Token name_or_keyword() {
+    Token t; t.line = line_;
+    size_t start = pos_;
+    while (pos_ < s_.size() &&
+           (std::isalnum(static_cast<unsigned char>(s_[pos_])) ||
+            s_[pos_] == '_'))
+      ++pos_;
+    t.text = s_.substr(start, pos_ - start);
+    static const struct { const char* w; TokKind k; } kw[] = {
+        {"and", TK_AND}, {"break", TK_BREAK}, {"do", TK_DO},
+        {"else", TK_ELSE}, {"elseif", TK_ELSEIF}, {"end", TK_END},
+        {"false", TK_FALSE}, {"for", TK_FOR}, {"function", TK_FUNCTION},
+        {"if", TK_IF}, {"in", TK_IN}, {"local", TK_LOCAL}, {"nil", TK_NIL},
+        {"not", TK_NOT}, {"or", TK_OR}, {"repeat", TK_REPEAT},
+        {"return", TK_RETURN}, {"then", TK_THEN}, {"true", TK_TRUE},
+        {"until", TK_UNTIL}, {"while", TK_WHILE},
+    };
+    t.kind = TK_NAME;
+    for (const auto& e : kw)
+      if (t.text == e.w) { t.kind = e.k; break; }
+    return t;
+  }
+
+  Token symbol() {
+    Token t; t.line = line_;
+    char c = s_[pos_++];
+    char n = pos_ < s_.size() ? s_[pos_] : '\0';
+    switch (c) {
+      case '+': t.kind = TK_PLUS; return t;
+      case '-': t.kind = TK_MINUS; return t;
+      case '*': t.kind = TK_STAR; return t;
+      case '/': t.kind = TK_SLASH; return t;
+      case '%': t.kind = TK_PERCENT; return t;
+      case '^': t.kind = TK_CARET; return t;
+      case '#': t.kind = TK_HASH; return t;
+      case '(': t.kind = TK_LPAREN; return t;
+      case ')': t.kind = TK_RPAREN; return t;
+      case '{': t.kind = TK_LBRACE; return t;
+      case '}': t.kind = TK_RBRACE; return t;
+      case ']': t.kind = TK_RBRACKET; return t;
+      case ';': t.kind = TK_SEMI; return t;
+      case ':': t.kind = TK_COLON; return t;
+      case ',': t.kind = TK_COMMA; return t;
+      case '=':
+        if (n == '=') { ++pos_; t.kind = TK_EQ; } else t.kind = TK_ASSIGN;
+        return t;
+      case '~':
+        if (n == '=') { ++pos_; t.kind = TK_NE; return t; }
+        err(line_, "unexpected '~'");
+      case '<':
+        if (n == '=') { ++pos_; t.kind = TK_LE; } else t.kind = TK_LT;
+        return t;
+      case '>':
+        if (n == '=') { ++pos_; t.kind = TK_GE; } else t.kind = TK_GT;
+        return t;
+      case '.':
+        if (n == '.') {
+          ++pos_;
+          if (pos_ < s_.size() && s_[pos_] == '.') { ++pos_; t.kind = TK_ELLIPSIS; }
+          else t.kind = TK_CONCAT;
+        } else {
+          t.kind = TK_DOT;
+        }
+        return t;
+      default: {
+        std::ostringstream os;
+        os << "unexpected character '" << c << "'";
+        err(line_, os.str());
+      }
+    }
+  }
+
+  const std::string& s_;
+  std::string file_;
+  size_t pos_ = 0;
+  int line_ = 1;
+};
+
+class Parser {
+ public:
+  Parser(const std::string& src, const std::string& file)
+      : lex_(src, file) { advance(); }
+
+  void parse_chunk_eof() {
+    block();
+    expect(TK_EOF, "<eof>");
+  }
+
+ private:
+  void advance() { tok_ = lex_.next(); }
+
+  bool check(TokKind k) const { return tok_.kind == k; }
+
+  bool accept(TokKind k) {
+    if (!check(k)) return false;
+    advance();
+    return true;
+  }
+
+  void expect(TokKind k, const char* what) {
+    if (!check(k)) {
+      std::ostringstream os;
+      os << "expected " << what;
+      lex_.err(tok_.line, os.str());
+    }
+    advance();
+  }
+
+  static bool block_follow(TokKind k) {
+    return k == TK_EOF || k == TK_END || k == TK_ELSE || k == TK_ELSEIF ||
+           k == TK_UNTIL;
+  }
+
+  // block ::= {stat [';']} [laststat [';']]
+  void block() {
+    for (;;) {
+      if (check(TK_RETURN)) {
+        advance();
+        if (!block_follow(tok_.kind) && !check(TK_SEMI)) explist();
+        accept(TK_SEMI);
+        if (!block_follow(tok_.kind))
+          lex_.err(tok_.line, "statement after return");
+        return;
+      }
+      if (check(TK_BREAK)) {
+        advance();
+        accept(TK_SEMI);
+        if (!block_follow(tok_.kind))
+          lex_.err(tok_.line, "statement after break");
+        return;
+      }
+      if (block_follow(tok_.kind)) return;
+      statement();
+      accept(TK_SEMI);
+    }
+  }
+
+  void statement() {
+    switch (tok_.kind) {
+      case TK_DO:
+        advance(); block(); expect(TK_END, "'end'"); return;
+      case TK_WHILE:
+        advance(); expr(); expect(TK_DO, "'do'"); block();
+        expect(TK_END, "'end'"); return;
+      case TK_REPEAT:
+        advance(); block(); expect(TK_UNTIL, "'until'"); expr(); return;
+      case TK_IF:
+        advance(); expr(); expect(TK_THEN, "'then'"); block();
+        while (accept(TK_ELSEIF)) { expr(); expect(TK_THEN, "'then'"); block(); }
+        if (accept(TK_ELSE)) block();
+        expect(TK_END, "'end'"); return;
+      case TK_FOR: {
+        advance();
+        expect(TK_NAME, "name");
+        if (accept(TK_ASSIGN)) {           // numeric for
+          expr(); expect(TK_COMMA, "','"); expr();
+          if (accept(TK_COMMA)) expr();
+        } else {                           // generic for
+          while (accept(TK_COMMA)) expect(TK_NAME, "name");
+          expect(TK_IN, "'in' or '='");
+          explist();
+        }
+        expect(TK_DO, "'do'"); block(); expect(TK_END, "'end'");
+        return;
+      }
+      case TK_FUNCTION: {
+        advance();
+        expect(TK_NAME, "function name");
+        while (accept(TK_DOT)) expect(TK_NAME, "name");
+        if (accept(TK_COLON)) expect(TK_NAME, "method name");
+        funcbody();
+        return;
+      }
+      case TK_LOCAL:
+        advance();
+        if (accept(TK_FUNCTION)) {
+          expect(TK_NAME, "function name");
+          funcbody();
+          return;
+        }
+        expect(TK_NAME, "name");
+        while (accept(TK_COMMA)) expect(TK_NAME, "name");
+        if (accept(TK_ASSIGN)) explist();
+        return;
+      default: {
+        // exprstat: either a function call or an assignment to vars
+        int line = tok_.line;
+        bool is_call = suffixedexp();
+        if (check(TK_ASSIGN) || check(TK_COMMA)) {
+          if (is_call) lex_.err(line, "cannot assign to function call");
+          while (accept(TK_COMMA)) {
+            if (suffixedexp())
+              lex_.err(tok_.line, "cannot assign to function call");
+          }
+          expect(TK_ASSIGN, "'='");
+          explist();
+        } else if (!is_call) {
+          lex_.err(line, "syntax error (expression is not a statement)");
+        }
+        return;
+      }
+    }
+  }
+
+  void funcbody() {
+    expect(TK_LPAREN, "'('");
+    if (!check(TK_RPAREN)) {
+      for (;;) {
+        if (accept(TK_ELLIPSIS)) break;
+        expect(TK_NAME, "parameter name");
+        if (!accept(TK_COMMA)) break;
+      }
+    }
+    expect(TK_RPAREN, "')'");
+    block();
+    expect(TK_END, "'end'");
+  }
+
+  void explist() {
+    expr();
+    while (accept(TK_COMMA)) expr();
+  }
+
+  // primaryexp ::= Name | '(' exp ')'
+  void primaryexp() {
+    if (accept(TK_NAME)) return;
+    if (accept(TK_LPAREN)) {
+      expr();
+      expect(TK_RPAREN, "')'");
+      return;
+    }
+    lex_.err(tok_.line, "unexpected symbol");
+  }
+
+  // suffixedexp ::= primaryexp { '.' Name | '[' exp ']' | ':' Name args | args }
+  // returns true iff the whole expression is a function/method call
+  bool suffixedexp() {
+    primaryexp();
+    bool is_call = false;
+    for (;;) {
+      switch (tok_.kind) {
+        case TK_DOT:
+          advance(); expect(TK_NAME, "field name"); is_call = false; break;
+        case TK_LBRACKET:
+          advance(); expr(); expect(TK_RBRACKET, "']'"); is_call = false; break;
+        case TK_COLON:
+          advance(); expect(TK_NAME, "method name"); args(); is_call = true;
+          break;
+        case TK_LPAREN: case TK_LBRACE: case TK_STRING:
+          args(); is_call = true; break;
+        default:
+          return is_call;
+      }
+    }
+  }
+
+  void args() {
+    if (accept(TK_STRING)) return;
+    if (check(TK_LBRACE)) { tablector(); return; }
+    expect(TK_LPAREN, "function arguments");
+    if (!check(TK_RPAREN)) explist();
+    expect(TK_RPAREN, "')'");
+  }
+
+  void tablector() {
+    expect(TK_LBRACE, "'{'");
+    while (!check(TK_RBRACE)) {
+      if (check(TK_LBRACKET)) {
+        advance(); expr(); expect(TK_RBRACKET, "']'");
+        expect(TK_ASSIGN, "'='"); expr();
+      } else if (check(TK_NAME)) {
+        // Name '=' exp, or an expression starting with a Name — need the
+        // one-token lookahead on '=' vs anything else
+        Token save = tok_;
+        advance();
+        if (accept(TK_ASSIGN)) {
+          expr();
+        } else {
+          // re-parse as expression continuing from the consumed Name:
+          // run the suffix/operator tail with the Name as primary
+          expr_after_name();
+          (void)save;
+        }
+      } else {
+        expr();
+      }
+      if (!accept(TK_COMMA) && !accept(TK_SEMI)) break;
+    }
+    expect(TK_RBRACE, "'}'");
+  }
+
+  // operator precedence (Lua 5.1 manual §2.5.6)
+  struct OpPrio { int left, right; };
+  static bool binop_prio(TokKind k, OpPrio* p) {
+    switch (k) {
+      case TK_OR: *p = {1, 1}; return true;
+      case TK_AND: *p = {2, 2}; return true;
+      case TK_LT: case TK_GT: case TK_LE: case TK_GE:
+      case TK_NE: case TK_EQ: *p = {3, 3}; return true;
+      case TK_CONCAT: *p = {5, 4}; return true;     // right assoc
+      case TK_PLUS: case TK_MINUS: *p = {6, 6}; return true;
+      case TK_STAR: case TK_SLASH: case TK_PERCENT: *p = {7, 7}; return true;
+      case TK_CARET: *p = {10, 9}; return true;     // right assoc
+      default: return false;
+    }
+  }
+  static constexpr int kUnaryPrio = 8;
+
+  void expr(int limit = 0) {
+    simpleexp(limit);
+    OpPrio p;
+    while (binop_prio(tok_.kind, &p) && p.left > limit) {
+      advance();
+      expr(p.right);
+    }
+  }
+
+  // like expr(), but the leading Name was already consumed (tablector)
+  void expr_after_name() {
+    suffix_tail();
+    OpPrio p;
+    while (binop_prio(tok_.kind, &p)) {
+      advance();
+      expr(p.right);
+    }
+  }
+
+  void suffix_tail() {
+    for (;;) {
+      switch (tok_.kind) {
+        case TK_DOT: advance(); expect(TK_NAME, "field name"); break;
+        case TK_LBRACKET: advance(); expr(); expect(TK_RBRACKET, "']'"); break;
+        case TK_COLON: advance(); expect(TK_NAME, "method name"); args(); break;
+        case TK_LPAREN: case TK_LBRACE: case TK_STRING: args(); break;
+        default: return;
+      }
+    }
+  }
+
+  void simpleexp(int limit) {
+    (void)limit;
+    switch (tok_.kind) {
+      case TK_NIL: case TK_TRUE: case TK_FALSE: case TK_NUMBER:
+      case TK_STRING: case TK_ELLIPSIS:
+        advance(); return;
+      case TK_FUNCTION:
+        advance(); funcbody(); return;
+      case TK_LBRACE:
+        tablector(); return;
+      case TK_NOT: case TK_HASH: case TK_MINUS:
+        advance(); expr(kUnaryPrio); return;
+      default:
+        suffixedexp(); return;
+    }
+  }
+
+  Lexer lex_;
+  Token tok_;
+};
+
+}  // namespace
+
+int main(int argc, char* argv[]) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s FILE.lua...\n", argv[0]);
+    return 2;
+  }
+  int failures = 0;
+  for (int i = 1; i < argc; ++i) {
+    std::ifstream in(argv[i], std::ios::binary);
+    if (!in) {
+      std::fprintf(stderr, "%s: cannot open\n", argv[i]);
+      ++failures;
+      continue;
+    }
+    std::ostringstream buf;
+    buf << in.rdbuf();
+    std::string src = buf.str();
+    // skip a shebang line, like the Lua loader does
+    if (src.size() >= 1 && src[0] == '#') {
+      size_t nl = src.find('\n');
+      src = nl == std::string::npos ? std::string() : src.substr(nl);
+    }
+    try {
+      Parser p(src, argv[i]);
+      p.parse_chunk_eof();
+      std::printf("%s: syntax OK\n", argv[i]);
+    } catch (const SyntaxError& e) {
+      std::fprintf(stderr, "%s\n", e.what());
+      ++failures;
+    }
+  }
+  if (failures == 0) std::printf("lua syntax check: OK\n");
+  return failures ? 1 : 0;
+}

@@ -51,9 +51,12 @@ class TransformerConfig:
     learning_rate: float = 0.1
     momentum: float = 0.9
     seed: int = 0
-    # attention implementation: "reference" (jnp, XLA-fused) or "flash"
-    # (the Pallas TPU kernel, ops/flash_attention.py — trains through its
-    # custom VJP; runs in interpret mode off-TPU, so tests stay hermetic)
+    # attention implementation: "reference" (jnp, XLA-fused), "flash"
+    # (crossover dispatch — Pallas kernel at/above the measured ~1.5k-seq
+    # win threshold, XLA below; never slower than reference), or
+    # "flash_force" (always the Pallas kernel, fwd+bwd;
+    # ops/flash_attention.py — runs in interpret mode off-TPU, so tests
+    # stay hermetic)
     attention: str = "reference"
 
 
@@ -126,20 +129,25 @@ def _attention(q, k, v, n_heads: int, impl: str = "reference"):
 
     ``impl="reference"``: :func:`ops.reference_attention` vmapped over
     batch — one causal-attention implementation shared by the model, the
-    sequence-parallel ops, and the tests. ``impl="flash"``: the Pallas
-    flash kernel (:func:`ops.flash_attention`), online-softmax tiles in
-    VMEM with a custom VJP for training.
+    sequence-parallel ops, and the tests. ``impl="flash"``: crossover
+    dispatch (:func:`ops.flash_attention.best_attention`) — the Pallas
+    flash kernel at/above the measured ~1.5k-seq win threshold, the
+    XLA-fused reference below it, so picking "flash" can never slow a
+    model down. ``impl="flash_force"`` pins the Pallas kernel
+    (online-softmax tiles in VMEM, Pallas fwd+bwd via custom VJP).
     """
     B, T, D = q.shape
     dh = D // n_heads
     split = lambda x: x.reshape(B, T, n_heads, dh)
     if impl == "flash":
+        from ..ops.flash_attention import best_attention as fn
+    elif impl == "flash_force":
         from ..ops.flash_attention import flash_attention as fn
     elif impl == "reference":
         from ..ops.ring_attention import reference_attention as fn
     else:
         Log.fatal(f"unknown attention impl {impl!r} "
-                  "(expected 'reference' or 'flash')")
+                  "(expected 'reference', 'flash' or 'flash_force')")
     out = jax.vmap(partial(fn, causal=True))(split(q), split(k), split(v))
     return out.reshape(B, T, D)
 

@@ -236,56 +236,233 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
     return out, (q, k, v, out, m, l)
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, scale: float, causal: bool, kv_len: int,
+                   block_q: int, block_k: int, precision):
+    """dq pass: grid (h, q-block, k-block); dq accumulates in VMEM over the
+    innermost k dimension. Probabilities recompute from the saved row
+    logsumexp — the flash backward's no-[s,s]-buffer property."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    qi = pl.program_id(1)
+    k_local0 = ki * block_k
+    run = jnp.logical_or(not causal,
+                         (qi + 1) * block_q - 1 >= k_local0)
+    run = jnp.logical_and(run, k_local0 < kv_len)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]                                    # [bq, d]
+        k = k_ref[0]                                    # [bk, d]
+        v = v_ref[0]
+        g = g_ref[0]
+        lse = lse_ref[0, 0][0]                          # [bq]
+        delta = delta_ref[0, 0][0]                      # [bq]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), precision=precision,
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        k_pos = k_local0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < kv_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())), precision=precision,
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale: float, causal: bool, kv_len: int,
+                    block_q: int, block_k: int, precision):
+    """dk/dv pass: grid (h, k-block, q-block); both accumulate in VMEM over
+    the innermost q dimension."""
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    ki = pl.program_id(1)
+    k_local0 = ki * block_k
+    # causal: q blocks strictly above the diagonal contribute nothing
+    run = jnp.logical_or(not causal,
+                         (qi + 1) * block_q - 1 >= k_local0)
+    run = jnp.logical_and(run, k_local0 < kv_len)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        g = g_ref[0]
+        lse = lse_ref[0, 0][0]
+        delta = delta_ref[0, 0][0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), precision=precision,
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        k_pos = k_local0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < kv_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())), precision=precision,
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta[:, None]) * scale           # [bq, bk]
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _stat_tiles(x, h, n_blocks, block: int):
+    """[h, s] row statistic -> [h, n_blocks, 8, block] blocked tiles (row 0
+    carries the payload; 8 sublanes is the minimal f32 tile height)."""
+    xp = _pad_to(x, n_blocks * block, 1).reshape(h, n_blocks, 1, block)
+    return jnp.broadcast_to(xp, (h, n_blocks, 8, block))
+
+
 def _flash_bwd(causal, scale, block_q, block_k, interpret, precision, res, g):
-    """Blockwise XLA backward from saved row stats (no [s,s] buffer).
+    """Pallas blockwise backward from saved row stats (no [s,s] buffer).
 
     Standard flash backward: with row logsumexp ``L = m + log l`` the
     probabilities of any k-block recompute as ``exp(s - L)``; then
     ``dv = p^T g``, ``ds = p * (g v^T - rowsum(g*o))``, ``dq = ds k``,
-    ``dk = ds^T q``, scanned over k blocks.
+    ``dk = ds^T q`` — dq in one kernel (k innermost), dk/dv in a second
+    (q innermost), both accumulating in VMEM scratch.
     """
+    if interpret is None:
+        interpret = _interpret_default()
     q, k, v, out, m, l = res
     s_scale = _resolve_scale(q, scale)
     sq, h, d = q.shape
     sk = k.shape[0]
-    bk = min(block_k, max(1, sk))
-    n_blocks = -(-sk // bk)
-    sk_p = n_blocks * bk
+    block_q = min(block_q, max(8, 1 << (sq - 1).bit_length()))
+    block_k = min(block_k, max(_LANES, 1 << (sk - 1).bit_length()))
+    sq_p = -(-sq // block_q) * block_q
+    sk_p = -(-sk // block_k) * block_k
+    d_p = -(-d // _LANES) * _LANES
+    nq = sq_p // block_q
+    nk = sk_p // block_k
 
-    kp = _pad_to(k, sk_p, 0)
-    vp = _pad_to(v, sk_p, 0)
-    lse = (m + jnp.log(jnp.maximum(l, 1e-20))).transpose(1, 0)  # [sq, h]
-    delta = jnp.sum(g * out, axis=-1)                           # [sq, h]
-    q_pos = jnp.arange(sq)
+    qt = _pad_to(_pad_to(jnp.transpose(q, (1, 0, 2)), sq_p, 1), d_p, 2)
+    kt = _pad_to(_pad_to(jnp.transpose(k, (1, 0, 2)), sk_p, 1), d_p, 2)
+    vt = _pad_to(_pad_to(jnp.transpose(v, (1, 0, 2)), sk_p, 1), d_p, 2)
+    gt = _pad_to(_pad_to(jnp.transpose(g, (1, 0, 2)), sq_p, 1), d_p, 2)
+    # lse per q row; padded rows get +LARGE so their recomputed p == 0
+    lse = m + jnp.log(jnp.maximum(l, 1e-20))                    # [h, sq]
+    lse_p = jnp.where((jnp.arange(sq_p) < sq)[None, :],
+                      _pad_to(lse, sq_p, 1), -_NEG_INF)
+    lse_t = _stat_tiles(lse_p, h, nq, block_q)
+    delta = jnp.einsum("shd,shd->hs", g.astype(jnp.float32),
+                       out.astype(jnp.float32))                 # [h, sq]
+    delta_t = _stat_tiles(_pad_to(delta, sq_p, 1), h, nq, block_q)
 
-    def body(carry, blk):
-        dq = carry
-        k_blk, v_blk, k0 = blk
-        k_pos = k0 + jnp.arange(bk)
-        s = jnp.einsum("qhd,khd->qhk", q, k_blk) * s_scale      # [sq, h, bk]
-        mask = (k_pos < sk)[None, None, :]
-        if causal:
-            mask = jnp.logical_and(mask,
-                                   (k_pos[None, :] <= q_pos[:, None])[:, None, :])
-        p = jnp.where(mask, jnp.exp(s - lse[:, :, None]), 0.0)
-        dv_blk = jnp.einsum("qhk,qhd->khd", p, g)
-        dp = jnp.einsum("qhd,khd->qhk", g, v_blk)
-        ds = p * (dp - delta[:, :, None]) * s_scale
-        dq = dq + jnp.einsum("qhk,khd->qhd", ds, k_blk)
-        dk_blk = jnp.einsum("qhk,qhd->khd", ds, q)
-        return dq, (dk_blk, dv_blk)
+    q_spec = pl.BlockSpec((1, block_q, d_p), lambda hi, a, b: (hi, a, 0))
+    k_spec = pl.BlockSpec((1, block_k, d_p), lambda hi, a, b: (hi, b, 0))
+    stat_spec = pl.BlockSpec((1, 1, 8, block_q), lambda hi, a, b: (hi, a, 0, 0))
 
-    k_blocks = kp.reshape(n_blocks, bk, h, d)
-    v_blocks = vp.reshape(n_blocks, bk, h, d)
-    k0s = jnp.arange(n_blocks) * bk
-    dq, (dk_b, dv_b) = jax.lax.scan(
-        body, jnp.zeros_like(q), (k_blocks, v_blocks, k0s))
-    dk = dk_b.reshape(sk_p, h, d)[:sk]
-    dv = dv_b.reshape(sk_p, h, d)[:sk]
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=s_scale, causal=causal,
+                          kv_len=sk, block_q=block_q, block_k=block_k,
+                          precision=precision),
+        grid=(h, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, stat_spec, stat_spec],
+        out_specs=pl.BlockSpec((1, block_q, d_p), lambda hi, a, b: (hi, a, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, sq_p, d_p), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse_t, delta_t)
+
+    # dk/dv grid: second axis is the K block, innermost is the Q block
+    q_spec2 = pl.BlockSpec((1, block_q, d_p), lambda hi, a, b: (hi, b, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d_p), lambda hi, a, b: (hi, a, 0))
+    stat_spec2 = pl.BlockSpec((1, 1, 8, block_q),
+                              lambda hi, a, b: (hi, b, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=s_scale, causal=causal,
+                          kv_len=sk, block_q=block_q, block_k=block_k,
+                          precision=precision),
+        grid=(h, nk, nq),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, stat_spec2, stat_spec2],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d_p), lambda hi, a, b: (hi, a, 0)),
+            pl.BlockSpec((1, block_k, d_p), lambda hi, a, b: (hi, a, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, sk_p, d_p), jnp.float32),
+            jax.ShapeDtypeStruct((h, sk_p, d_p), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
+                        pltpu.VMEM((block_k, d_p), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse_t, delta_t)
+
+    dq = jnp.transpose(dq[:, :sq, :d], (1, 0, 2)).astype(q.dtype)
+    dk = jnp.transpose(dk[:, :sk, :d], (1, 0, 2)).astype(k.dtype)
+    dv = jnp.transpose(dv[:, :sk, :d], (1, 0, 2)).astype(v.dtype)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# Measured on-chip crossover (docs/TPU_VALIDATE.json): XLA-fused reference
+# attention wins below ~1.5k sequence, the Pallas kernel above. Override by
+# passing min_flash_seq to best_attention (or monkeypatching this).
+FLASH_CROSSOVER_SEQ = 1536
+
+
+def best_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = False, scale: Optional[float] = None,
+                   min_flash_seq: Optional[int] = None,
+                   **flash_kwargs) -> jax.Array:
+    """Crossover dispatch: never slower than XLA at any sequence length.
+
+    Below the measured crossover the XLA-fused reference attention is
+    faster than the Pallas kernel (kernel launch + un-fused epilogue
+    dominate at small seq); at/above it the flash schedule's O(seq) memory
+    and tiling win 3-5x. ``TransformerConfig(attention="flash")`` routes
+    here so users can't be slowed down by picking the kernel at short
+    sequences; ``attention="flash_force"`` pins the kernel.
+    """
+    thr = FLASH_CROSSOVER_SEQ if min_flash_seq is None else int(min_flash_seq)
+    if max(q.shape[0], k.shape[0]) < thr:
+        from .ring_attention import reference_attention
+
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           **flash_kwargs)
 
 
 def flash_attention_partial(

@@ -235,12 +235,28 @@ class AsyncDeltaBus:
         if self._inflight_bytes + len(payload) > self._max_inflight // 2:
             self._reap_acks()
         warned = False
+        deadline = time.monotonic() + 600.0
         while (self._outstanding
                and self._inflight_bytes + len(payload) > self._max_inflight):
             if not warned:
                 Log.debug("async PS: backpressure at %.1f MB in flight",
                           self._inflight_bytes / 1e6)
                 warned = True
+            if self._stop.is_set():
+                # shutdown raced a blocked publish; don't wedge teardown
+                Log.error("async PS: publish abandoned at shutdown "
+                          "(%.1f MB un-acked)", self._inflight_bytes / 1e6)
+                break
+            if time.monotonic() > deadline:
+                # same liveness posture as drain()'s 600 s barriers and
+                # the SSP wait: a peer that stops consuming is a failure,
+                # not a reason to hang the training thread forever while
+                # holding _pub_lock
+                Log.fatal(
+                    f"async PS backpressure timed out: {self._inflight_bytes / 1e6:.1f} "
+                    f"MB un-acked after 600 s (peer dead? see "
+                    f"parallel.FailureDetector); oldest seq "
+                    f"{self._outstanding[0][0]}")
             time.sleep(self._interval)
             self._reap_acks()
         seq = _published
@@ -302,10 +318,11 @@ class AsyncDeltaBus:
         them would change semantics) or (b) nearly every row moved, where
         keyed would just add the id column on top of the dense payload.
         """
-        delta = np.ascontiguousarray(delta)
+        delta = np.asarray(delta)
         if (delta.ndim == 2 and table.updater.name == "default"
                 and hasattr(table, "num_col")):
-            rows = np.flatnonzero(np.any(delta != 0, axis=1))
+            # .any(axis=1) reduces without the table-sized `!= 0` temporary
+            rows = np.flatnonzero(delta.any(axis=1))
             if rows.size <= 0.9 * delta.shape[0]:
                 if rows.size:
                     self.publish_keyed(table.table_id, rows.astype(np.int32),

@@ -138,6 +138,15 @@ class MatrixTable(TableBase):
             self._mark_dirty(np.arange(self.num_row), wid)
         return super().add_async(delta, option)
 
+    def _apply_remote_dense(self, host: np.ndarray, option: AddOption) -> None:
+        # a peer's whole-table delta dirties every row for local pullers,
+        # exactly like a local whole-table add (the reference server runs
+        # UpdateAddState for EVERY add; keyed remote applies mark their
+        # touched rows via _dispatch_keyed, so the two wire forms agree)
+        if self._dirty is not None:
+            self._mark_dirty(np.arange(self.num_row), option.worker_id)
+        super()._apply_remote_dense(host, option)
+
     # -- sparse dirty-row protocol ----------------------------------------
     def _mark_dirty(self, rows: np.ndarray, adding_worker: int) -> None:
         """``UpdateAddState``: rows become dirty for every *other* worker

@@ -56,6 +56,41 @@ def test_flash_grads_match_reference(causal):
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_flash_grads_cross_lengths_and_ragged():
+    """Backward kernels over unequal, non-power-of-two q/k lengths (pads
+    both grid axes; padded rows/keys must contribute zero grad)."""
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.standard_normal((40, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((72, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((72, 2, 16)), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_best_attention_crossover_dispatch():
+    """attention="flash" must never be slower than XLA: below the measured
+    crossover it routes to reference_attention, above to the kernel; both
+    produce the same numbers."""
+    from multiverso_tpu.ops.flash_attention import best_attention
+
+    q, k, v = _qkv(64, heads=2, dim=16, seed=4)
+    ref = reference_attention(q, k, v, causal=True)
+    # 64 < default threshold -> XLA path (identical)
+    np.testing.assert_array_equal(
+        np.asarray(best_attention(q, k, v, causal=True)), np.asarray(ref))
+    # forced low threshold -> kernel path (numerically close)
+    np.testing.assert_allclose(
+        np.asarray(best_attention(q, k, v, causal=True, min_flash_seq=1)),
+        np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
 def test_partial_merge_equals_full():
     q, k, v = _qkv(64, heads=2, dim=16, seed=2)
     half = 32

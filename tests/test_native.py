@@ -299,3 +299,33 @@ def test_lua_abi_replay():
                             timeout=120)
     assert result.returncode == 0, (result.stdout, result.stderr)
     assert "lua ABI replay: OK" in result.stdout
+
+
+def test_lua_syntax_check(tmp_path):
+    """VERDICT r2 item 7: the shipped .lua files are actually PARSED in CI
+    (full Lua 5.1 lexer+parser, cpp/mvtpu/lua_check.cc), and a deliberately
+    broken handler file fails the check."""
+    import glob
+    import subprocess
+
+    binary = os.path.join(REPO, "cpp", "lua_check")
+    if not os.path.exists(binary):
+        build = subprocess.run(["make", "-s", "lua_check"],
+                               cwd=os.path.join(REPO, "cpp"),
+                               capture_output=True, text=True)
+        assert build.returncode == 0, build.stderr[-2000:]
+
+    lua_files = sorted(glob.glob(os.path.join(REPO, "binding", "lua",
+                                              "**", "*.lua"), recursive=True))
+    assert len(lua_files) >= 5, lua_files   # handlers + init + util + test
+    result = subprocess.run([binary] + lua_files, capture_output=True,
+                            text=True, timeout=60)
+    assert result.returncode == 0, (result.stdout, result.stderr)
+    assert "lua syntax check: OK" in result.stdout
+
+    broken = tmp_path / "broken.lua"
+    broken.write_text("local t = { function oops( end\n")
+    result = subprocess.run([binary, str(broken)], capture_output=True,
+                            text=True, timeout=60)
+    assert result.returncode == 1
+    assert "broken.lua" in result.stderr

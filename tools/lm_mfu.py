@@ -1,0 +1,171 @@
+"""LM training MFU on the real chip (VERDICT r2 item 5).
+
+Measures the TransformerLM train step's DEVICE time via xprof (wall
+clocks lie under the tunneled device — see tools/tpu_validate.py) and
+divides the step's matmul FLOPs by v5e bf16 peak to report MFU at
+seq 1024/2048 with reference vs flash attention.
+
+FLOP accounting (causal-aware, so MFU is not inflated by counting work
+the kernels skip):
+
+* matmul params N = L*(4*d^2 + 2*d*d_ff) + d*vocab (the logits head;
+  the embedding lookup is a gather, not a matmul);
+* forward = 2*N FLOPs/token + attention 2*2*(T/2)*d per layer
+  (QK^T and PV over an average causal span of T/2);
+* training = 3x forward (bwd does ~2x fwd's matmul work).
+
+Usage: python tools/lm_mfu.py [--out docs/LM_MFU.md] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# v5e: 197 TFLOP/s bf16 per chip (public spec)
+PEAK_FLOPS = 197e12
+_VOCAB = 256
+
+
+def train_flops_per_step(d_model: int, n_layers: int, d_ff: int,
+                         vocab: int, batch: int, seq: int) -> float:
+    n_matmul = n_layers * (4 * d_model * d_model + 2 * d_model * d_ff) \
+        + d_model * vocab
+    per_token = 6 * n_matmul + 3 * 4 * (seq / 2) * d_model * n_layers
+    return per_token * batch * seq
+
+
+def _measure_one(argv) -> None:
+    """Subprocess entry: ONE xprof trace of the jitted train step."""
+    import glob
+    import gzip
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+
+    d_model, n_layers, n_heads, d_ff, batch, seq, attn, dtype = argv
+    cfg = TransformerConfig(
+        vocab_size=_VOCAB, d_model=int(d_model), n_heads=int(n_heads),
+        n_layers=int(n_layers), d_ff=int(d_ff), max_seq=int(seq),
+        attention=attn,
+        dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32)
+    lm = TransformerLM(cfg)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, _VOCAB, (int(batch), int(seq))).astype(np.int32)
+    loss = lm.train_batch(toks)
+    float(loss)                                   # compile + land
+    trace_dir = tempfile.mkdtemp(prefix="lmmfu_")
+    jax.profiler.start_trace(trace_dir)
+    iters = 5
+    for _ in range(iters):
+        loss = lm.train_batch(toks)
+    float(loss)
+    jax.profiler.stop_trace()
+    path = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                     recursive=True)[0]
+    with gzip.open(path) as fh:
+        events = json.load(fh)["traceEvents"]
+    total = sum(int(e["args"]["device_duration_ps"]) / 1e9 for e in events
+                if e.get("ph") == "X"
+                and "device_duration_ps" in e.get("args", {})
+                and "while" not in e.get("name", "")
+                and not e.get("name", "").startswith("jit_"))
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    print(f"DEVICE_MS {total / iters:.6f}")
+
+
+def measure(d_model, n_layers, n_heads, d_ff, batch, seq, attn, dtype
+            ) -> float:
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--_one",
+         str(d_model), str(n_layers), str(n_heads), str(d_ff),
+         str(batch), str(seq), attn, dtype],
+        capture_output=True, text=True, timeout=900)
+    for line in out.stdout.splitlines():
+        if line.startswith("DEVICE_MS "):
+            return float(line.split()[1])
+    raise RuntimeError(f"measure failed:\n{out.stdout[-2000:]}\n"
+                       f"{out.stderr[-2000:]}")
+
+
+def main(argv=None) -> int:
+    if argv is None and len(sys.argv) >= 2 and sys.argv[1] == "--_one":
+        _measure_one(sys.argv[2:])
+        return 0
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+
+    # flagship-ish size: 85M matmul params — big enough that the MXU, not
+    # dispatch, is the limiter on one chip
+    d_model, n_layers, n_heads = 768, 12, 12
+    d_ff = 4 * d_model
+    rows = []
+    seqs = (1024,) if args.quick else (1024, 2048)
+    for seq in seqs:
+        batch = max(1, (8 * 1024) // seq)         # ~8k tokens/step
+        for attn in ("reference", "flash"):
+            for dtype in ("bf16",):
+                ms = measure(d_model, n_layers, n_heads, d_ff, batch, seq,
+                             attn, dtype)
+                flops = train_flops_per_step(d_model, n_layers, d_ff,
+                                             _VOCAB, batch, seq)
+                mfu = flops / (ms / 1e3) / PEAK_FLOPS
+                tok_s = batch * seq / (ms / 1e3)
+                rows.append({"seq": seq, "batch": batch, "attention": attn,
+                             "dtype": dtype, "step_ms": ms,
+                             "tok_per_s": tok_s, "mfu": mfu})
+                print(f"seq={seq} batch={batch} attn={attn} {dtype}: "
+                      f"{ms:.2f} ms/step, {tok_s:,.0f} tok/s, "
+                      f"MFU {mfu * 100:.1f}%", flush=True)
+
+    if args.out:
+        n_params = n_layers * (4 * d_model ** 2 + 2 * d_model * d_ff) \
+            + d_model * _VOCAB
+        lines = [
+            "# LM training MFU (one v5e chip, device-time via xprof)",
+            "",
+            f"`tools/lm_mfu.py` — byte-level TransformerLM, d_model "
+            f"{d_model}, {n_layers} layers, {n_heads} heads, d_ff {d_ff} "
+            f"({n_params / 1e6:.0f}M matmul params), bf16 params, ~8k "
+            "tokens/step. MFU = causal-aware matmul FLOPs / device time "
+            f"/ {PEAK_FLOPS / 1e12:.0f} TFLOP/s (v5e bf16 peak); the "
+            "attention column is TransformerConfig.attention.",
+            "",
+            "| seq | batch | attention | step ms | tok/s | MFU |",
+            "|---|---|---|---|---|---|",
+        ]
+        for r in rows:
+            lines.append(
+                f"| {r['seq']} | {r['batch']} | {r['attention']} "
+                f"| {r['step_ms']:.2f} | {r['tok_per_s']:,.0f} "
+                f"| {r['mfu'] * 100:.1f}% |")
+        lines += [
+            "",
+            "The flash rows dispatch through `best_attention` exactly as "
+            "`attention=\"flash\"` users get it (crossover at seq "
+            "1536: the 1024 row IS the XLA path, by design).",
+            "",
+        ]
+        with open(args.out, "w") as f:
+            f.write("\n".join(lines))
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

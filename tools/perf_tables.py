@@ -238,22 +238,61 @@ def _lightlda(rows: int, cols: int, rounds: int) -> int:
     for w in range(workers):
         table.get_dirty_rows(w)
 
-    pushed = pulled = 0
-    push_t = pull_t = 0.0
-    t0 = _time.perf_counter()
-    for r in range(rounds):
-        for w in range(workers):
-            ids, vals = pushes[r][w]
-            t1 = _time.perf_counter()
+    def run_blocking():
+        """Reference LightLDA loop shape: push, then a BLOCKING filtered
+        pull per worker — every pull pays a full host<->device round
+        trip before the next worker proceeds."""
+        pushed = pulled = 0
+        push_t = pull_t = 0.0
+        t0 = _time.perf_counter()
+        for r in range(rounds):
+            for w in range(workers):
+                ids, vals = pushes[r][w]
+                t1 = _time.perf_counter()
+                table.add_rows(ids, vals, AddOption(worker_id=w))
+                push_t += _time.perf_counter() - t1
+                pushed += ids.size
+            for w in range(workers):
+                t1 = _time.perf_counter()
+                dirty_ids, dirty_rows = table.get_dirty_rows(w)
+                pull_t += _time.perf_counter() - t1
+                pulled += dirty_ids.size
+        return _time.perf_counter() - t0, push_t, pull_t, pushed, pulled
+
+    def run_pipelined():
+        """Reference ``GetPipelineTable`` pattern (``ps_model.cpp:236``)
+        on :class:`parallel.PipelinedGetter` (the ``ASyncBuffer``
+        double-buffer): round r's pulls run on background threads while
+        round r+1's pushes dispatch, and the workers' pulls overlap each
+        other — the host-link round trips that dominate the blocking
+        loop ride concurrently."""
+        from multiverso_tpu.parallel import PipelinedGetter
+
+        getters = [PipelinedGetter(table.get_dirty_rows)
+                   for _ in range(workers)]
+        pushed = pulled = 0
+        t0 = _time.perf_counter()
+        for w in range(workers):                   # round 0 pushes
+            ids, vals = pushes[0][w]
             table.add_rows(ids, vals, AddOption(worker_id=w))
-            push_t += _time.perf_counter() - t1
             pushed += ids.size
-        for w in range(workers):
-            t1 = _time.perf_counter()
-            dirty_ids, dirty_rows = table.get_dirty_rows(w)
-            pull_t += _time.perf_counter() - t1
+        for w in range(workers):                   # start round 0 pulls
+            getters[w].prime(w)
+        for r in range(1, rounds):
+            for w in range(workers):               # overlaps r-1 pulls
+                ids, vals = pushes[r][w]
+                table.add_rows(ids, vals, AddOption(worker_id=w))
+                pushed += ids.size
+            for w in range(workers):               # collect r-1, start r
+                dirty_ids, _ = getters[w].get(w)
+                pulled += dirty_ids.size
+        for w in range(workers):                   # collect the last round
+            dirty_ids, _ = getters[w].get()
             pulled += dirty_ids.size
-    total = _time.perf_counter() - t0
+        return _time.perf_counter() - t0, pushed, pulled
+
+    total, push_t, pull_t, pushed, pulled = run_blocking()
+    p_total, p_pushed, p_pulled = run_pipelined()
 
     dense_bytes = rows * cols * 4
     # measured mean rows per push (unique zipf draws < doc_words)
@@ -266,8 +305,13 @@ def _lightlda(rows: int, cols: int, rounds: int) -> int:
     print(f"wire: touched-row push = {push_bytes / 1e6:.1f} MB vs dense "
           f"{dense_bytes / 1e6:.0f} MB ({dense_bytes / push_bytes:,.0f}x "
           f"smaller)")
-    print(f"total: {rounds} rounds x {workers} workers in {total:.2f}s "
-          f"({rounds * workers / total:.1f} worker-iterations/s)")
+    print(f"total (blocking): {rounds} rounds x {workers} workers in "
+          f"{total:.2f}s ({rounds * workers / total:.1f} "
+          f"worker-iterations/s)")
+    print(f"total (pipelined): {rounds} rounds x {workers} workers in "
+          f"{p_total:.2f}s ({rounds * workers / p_total:.1f} "
+          f"worker-iterations/s) — {total / p_total:.2f}x vs blocking "
+          f"(double-buffered get_dirty_rows, {p_pulled} rows pulled)")
     # correctness probe: global count conservation (every +1 has a -1,
     # so the table sums to ~0)
     probe = float(np.sum(table.get_rows(np.arange(0, rows,

@@ -28,12 +28,15 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 
-def _device_ms_one(impl: str, seq: int) -> None:
+def _device_ms_one(impl: str, seq: int, mode: str = "fwd") -> None:
     """Subprocess entry: trace ONE implementation at ONE shape and print
     the hardware-measured device ms/call. Wall clocks are unreliable on a
     tunneled device (dispatch acks return early), and repeated
     start_trace/stop_trace in one process hangs — hence one measurement
-    per process, device_duration_ps from the trace."""
+    per process, device_duration_ps from the trace.
+
+    ``mode="fwd"`` times the forward; ``mode="fwdbwd"`` times a full
+    value+grad step (the training-step attention cost)."""
     import glob
     import gzip
     import shutil
@@ -47,11 +50,15 @@ def _device_ms_one(impl: str, seq: int) -> None:
     rng = np.random.default_rng(0)
     h, d = 8, 128
     q = jnp.asarray(rng.standard_normal((seq, h, d)), jnp.float32)
-    if impl == "flash":
-        fn = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    base = flash_attention if impl == "flash" else reference_attention
+    if mode == "fwdbwd":
+        def step(q, k, v):
+            return jax.grad(
+                lambda q, k, v: jnp.sum(base(q, k, v, causal=True) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+        fn = jax.jit(step)
     else:
-        fn = jax.jit(
-            lambda q, k, v: reference_attention(q, k, v, causal=True))
+        fn = jax.jit(lambda q, k, v: base(q, k, v, causal=True))
     out = fn(q, q, q)
     jax.block_until_ready(out)           # compile outside the trace
     trace_dir = tempfile.mkdtemp(prefix="tpuval_")
@@ -60,7 +67,8 @@ def _device_ms_one(impl: str, seq: int) -> None:
     for _ in range(iters):
         out = fn(q, q, q)
     jax.block_until_ready(out)
-    float(out[0, 0, 0])
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf.reshape(-1)[0])
     jax.profiler.stop_trace()
     path = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
                      recursive=True)[0]
@@ -75,23 +83,24 @@ def _device_ms_one(impl: str, seq: int) -> None:
     print(f"DEVICE_MS {total / iters:.6f}")
 
 
-def _device_ms(impl: str, seq: int) -> float:
+def _device_ms(impl: str, seq: int, mode: str = "fwd") -> float:
     import subprocess
 
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--_one", impl,
-         str(seq)],
+         str(seq), mode],
         capture_output=True, text=True, timeout=400)
     for line in out.stdout.splitlines():
         if line.startswith("DEVICE_MS "):
             return float(line.split()[1])
-    raise RuntimeError(f"device timing failed ({impl}, {seq}):\n"
+    raise RuntimeError(f"device timing failed ({impl}, {seq}, {mode}):\n"
                        f"{out.stdout[-1500:]}\n{out.stderr[-1500:]}")
 
 
 def main(argv=None):
     if argv is None and len(sys.argv) >= 4 and sys.argv[1] == "--_one":
-        _device_ms_one(sys.argv[2], int(sys.argv[3]))
+        _device_ms_one(sys.argv[2], int(sys.argv[3]),
+                       sys.argv[4] if len(sys.argv) > 4 else "fwd")
         return 0
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="docs/TPU_VALIDATE.json")
@@ -121,27 +130,58 @@ def main(argv=None):
                               interpret=result["interpret"])
         ref = reference_attention(q, k, v, causal=causal)
         err = float(jnp.max(jnp.abs(out - ref)))
+        # backward: both Pallas kernels (dq and dk/dv) vs XLA autodiff
+        gf = jax.grad(lambda *a: jnp.sum(flash_attention(
+            *a, causal=causal, interpret=result["interpret"]) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: jnp.sum(reference_attention(
+            *a, causal=causal) ** 2), argnums=(0, 1, 2))(q, k, v)
+        # RELATIVE to the grad scale: the sum-of-squares probe loss makes
+        # grad magnitudes grow with seq, so an absolute bar would conflate
+        # bf16 MXU rounding with real error (CPU f32 interpret matches to
+        # 1e-4; on-chip default-precision passes land ~1e-3 relative)
+        gerr = max(
+            float(jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(b)) + 1e-9))
+            for a, b in zip(gf, gr))
         case = {"seq": seq, "heads": h, "head_dim": d, "causal": causal,
-                "max_abs_err": err}
+                "max_abs_err": err, "max_grad_rel_err": gerr}
         result["cases"].append(case)
-        status = "ok" if err < 2e-2 else "FAIL"
+        status = "ok" if err < 2e-2 and gerr < 2e-2 else "FAIL"
         print(f"flash seq={seq} h={h} d={d} causal={causal}: "
-              f"err {err:.3e} [{status}]", flush=True)
-        assert err < 2e-2, case
+              f"err {err:.3e} grad-rel-err {gerr:.3e} [{status}]", flush=True)
+        assert err < 2e-2 and gerr < 2e-2, case
 
     # timing: kernel vs XLA reference, HARDWARE-measured (one subprocess
-    # trace per point — see _device_ms_one for why wall clocks are out)
+    # trace per point — see _device_ms_one for why wall clocks are out).
+    # fwd alone AND fwd+bwd (the training-step attention cost — both
+    # directions are Pallas kernels).
     if not result["interpret"]:
-        for seq in (1024, 2048, 4096):
-            t_fa = _device_ms("flash", seq)
-            t_ra = _device_ms("reference", seq)
-            row = {"seq": seq, "heads": 8, "head_dim": 128,
-                   "flash_ms": t_fa, "reference_ms": t_ra,
-                   "speedup": t_ra / t_fa, "timing": "device (xprof)"}
-            result["bench"].append(row)
-            print(f"bench seq={seq}: flash {t_fa:.3f} ms, "
-                  f"xla-ref {t_ra:.3f} ms, speedup {t_ra/t_fa:.2f}x "
-                  f"(device time)", flush=True)
+        from multiverso_tpu.ops.flash_attention import FLASH_CROSSOVER_SEQ
+
+        for mode in ("fwd", "fwdbwd"):
+            for seq in (512, 1024, 2048, 4096):
+                t_fa = _device_ms("flash", seq, mode)
+                t_ra = _device_ms("reference", seq, mode)
+                row = {"seq": seq, "heads": 8, "head_dim": 128,
+                       "mode": mode, "flash_ms": t_fa, "reference_ms": t_ra,
+                       "speedup": t_ra / t_fa, "timing": "device (xprof)",
+                       "dispatch": ("flash" if seq >= FLASH_CROSSOVER_SEQ
+                                    else "reference")}
+                result["bench"].append(row)
+                print(f"bench {mode} seq={seq}: flash {t_fa:.3f} ms, "
+                      f"xla-ref {t_ra:.3f} ms, speedup {t_ra/t_fa:.2f}x "
+                      f"(device time; attention='flash' dispatches "
+                      f"{row['dispatch']})", flush=True)
+        # the crossover constant must make attention="flash" never slower:
+        # every swept point picks the faster implementation
+        bad = [r for r in result["bench"]
+               if (r["speedup"] >= 1.0) != (r["dispatch"] == "flash")
+               and abs(r["speedup"] - 1.0) > 0.15]
+        result["crossover_seq"] = FLASH_CROSSOVER_SEQ
+        result["crossover_ok"] = not bad
+        if bad:
+            print(f"WARNING: crossover {FLASH_CROSSOVER_SEQ} misdispatches: "
+                  f"{bad}", flush=True)
 
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
