@@ -1,0 +1,153 @@
+"""``hdfs://`` stream backend over WebHDFS (fsspec, pure HTTP).
+
+The reference's HDFS stream binds libhdfs through a JVM
+(``src/io/hdfs_stream.cpp``, ``include/multiverso/io/hdfs_stream.h:24`` in
+the Multiverso reference, gated by ``MULTIVERSO_USE_HDFS``). TPU VMs ship
+no JVM, so the native analogue is the WebHDFS REST gateway every namenode
+exposes (``dfs.webhdfs.enabled``): fsspec's ``WebHDFS`` filesystem speaks
+it with plain ``requests`` — no new dependencies.
+
+URI form: ``hdfs://namenode[:port]/path`` (port defaults to fsspec's
+WebHDFS default). Authentication: set ``MV_HDFS_USER`` for simple
+user.name auth; Kerberos deployments use the standard fsspec config
+mechanisms.
+
+Stream semantics match the other remote backends (``io/remote.py``):
+writes buffer locally and commit ONE file at close — the same
+commit-on-close the reference's HDFS stream performs on ``Flush`` — and a
+``with`` block that raises mid-write aborts instead of publishing a
+truncated file. Reads fetch the file once and serve from memory.
+
+Tested against a hermetic in-process WebHDFS protocol double
+(``tests/test_hdfs_stream.py``) — the same strategy the reference uses of
+testing streams without a live cluster.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import BinaryIO
+
+from ..log import Log
+
+
+def _fs_for(host_port: str):
+    """fsspec WebHDFS filesystem for ``namenode[:port]`` (instance-cached
+    by fsspec)."""
+    from fsspec.implementations.webhdfs import WebHDFS
+
+    if not host_port:
+        Log.fatal("hdfs:// URI needs a namenode host: hdfs://host[:port]/path")
+    host, _, port = host_port.partition(":")
+    kwargs = {"host": host}
+    if port:
+        kwargs["port"] = int(port)
+    user = os.environ.get("MV_HDFS_USER")
+    if user:
+        kwargs["user"] = user
+    if os.environ.get("MV_HDFS_USE_HTTPS", "") in ("1", "true"):
+        kwargs["use_https"] = True
+    return WebHDFS(**kwargs)
+
+
+class _HdfsReadStream(io.BytesIO):
+    """Whole-file read stream (reference HDFSStream read mode)."""
+
+    def __init__(self, fs, path: str, uri: str) -> None:
+        try:
+            data = fs.cat_file(path)
+        except FileNotFoundError:
+            raise FileNotFoundError(uri)
+        except Exception as exc:
+            raise FileNotFoundError(f"{uri}: {exc}") from exc
+        super().__init__(bytes(data))
+
+
+class _HdfsWriteStream(io.BytesIO):
+    """Buffered write stream; commits ONE file at close (the reference
+    HDFS stream's commit-on-Flush), with the abort-on-exception contract
+    of the object-store streams."""
+
+    def __init__(self, fs, path: str, uri: str) -> None:
+        super().__init__()
+        self._fs = fs
+        self._path = path
+        self._uri = uri
+        self._committed = False
+        self._aborted = False
+
+    def abort(self) -> None:
+        """Discard the buffer: a subsequent close() uploads nothing."""
+        self._aborted = True
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self._aborted = True
+        return super().__exit__(exc_type, exc, tb)
+
+    def close(self) -> None:
+        if not self._committed and not self._aborted and not self.closed:
+            self._fs.pipe_file(self._path, self.getvalue())
+            self._committed = True
+        super().close()
+
+
+def open_hdfs(uri, mode: str) -> BinaryIO:
+    """Scheme opener signature for :func:`io.stream.register_scheme`."""
+    fs = _fs_for(uri.host)
+    if "w" in mode:
+        return _HdfsWriteStream(fs, uri.path, uri.uri)
+    if "a" in mode:
+        Log.fatal(f"append mode unsupported on the hdfs:// backend: "
+                  f"{uri.uri}")
+    return _HdfsReadStream(fs, uri.path, uri.uri)
+
+
+# -- checkpoint helpers (same trio io/remote.py provides for gs://) --------
+
+def exists(uri_str: str) -> bool:
+    from .stream import URI
+
+    uri = URI(uri_str)
+    try:
+        return bool(_fs_for(uri.host).exists(uri.path))
+    except Exception:
+        return False
+
+
+def list_subdirs_with(root_uri: str, filename: str):
+    """Immediate subdirectory names under ``root_uri`` containing
+    ``filename`` (checkpoint-step discovery)."""
+    from .stream import URI
+
+    uri = URI(root_uri)
+    fs = _fs_for(uri.host)
+    names = []
+    try:
+        entries = fs.ls(uri.path, detail=True)
+    except FileNotFoundError:
+        return []
+    for e in entries:
+        if e.get("type") == "directory":
+            name = e["name"].rstrip("/").rsplit("/", 1)[-1]
+            if fs.exists(e["name"].rstrip("/") + "/" + filename):
+                names.append(name)
+    return sorted(names)
+
+
+def delete_prefix(dir_uri: str) -> None:
+    """Delete the directory tree (remote checkpoint pruning)."""
+    from .stream import URI
+
+    uri = URI(dir_uri)
+    try:
+        _fs_for(uri.host).rm(uri.path, recursive=True)
+    except FileNotFoundError:
+        pass
+
+
+def register() -> None:
+    from .stream import register_scheme
+
+    register_scheme("hdfs", open_hdfs)

@@ -107,10 +107,25 @@ def open_remote(uri, mode: str) -> BinaryIO:
     return _KvReadStream(store, key, uri.uri)
 
 
+def _hdfs_if_hdfs(uri_str: str):
+    """The checkpoint helpers dispatch per scheme: hdfs:// roots route to
+    the WebHDFS backend, everything else to the tensorstore KvStores."""
+    from .stream import URI
+
+    if URI(uri_str).scheme == "hdfs":
+        from . import hdfs
+
+        return hdfs
+    return None
+
+
 def exists(uri_str: str) -> bool:
     """Object existence probe (manifest checks on remote checkpoints)."""
     from .stream import URI
 
+    alt = _hdfs_if_hdfs(uri_str)
+    if alt is not None:
+        return alt.exists(uri_str)
     uri = URI(uri_str)
     store, key = _kvstore_for(uri)
     try:
@@ -125,6 +140,9 @@ def list_subdirs_with(root_uri: str, filename: str):
     "directories" are key prefixes)."""
     from .stream import URI
 
+    alt = _hdfs_if_hdfs(root_uri)
+    if alt is not None:
+        return alt.list_subdirs_with(root_uri, filename)
     store, prefix = _kvstore_for(URI(root_uri))
     prefix = prefix.rstrip("/")
     prefix = prefix + "/" if prefix else ""
@@ -145,6 +163,9 @@ def delete_prefix(dir_uri: str) -> None:
 
     from .stream import URI
 
+    alt = _hdfs_if_hdfs(dir_uri)
+    if alt is not None:
+        return alt.delete_prefix(dir_uri)
     store, prefix = _kvstore_for(URI(dir_uri))
     prefix = prefix.rstrip("/") + "/"
     # exclusive max = prefix with '/' bumped to the next code point, i.e.

@@ -70,6 +70,12 @@ def open_stream(uri: str, mode: str = "rb") -> BinaryIO:
 
         remote.register()
         opener = _OPENERS.get(parsed.scheme)
+    elif opener is None and parsed.scheme == "hdfs":
+        # WebHDFS backend (fsspec) — the JVM-free hdfs:// analogue
+        from . import hdfs
+
+        hdfs.register()
+        opener = _OPENERS.get(parsed.scheme)
     if opener is None:
         Log.fatal(f"no stream handler for scheme {parsed.scheme!r} ({uri})")
     if "b" not in mode:

@@ -1,0 +1,246 @@
+"""hdfs:// stream backend (VERDICT r2 item 9 / missing 3).
+
+Runs against a hermetic in-process WebHDFS protocol double: a tiny HTTP
+server implementing the REST subset fsspec's WebHDFS driver speaks
+(GETFILESTATUS / LISTSTATUS / OPEN / CREATE+redirect / APPEND+redirect /
+MKDIRS / DELETE). This covers the full client path — URI dispatch,
+fsspec driver, commit-on-close, abort-on-exception, checkpoint helpers —
+without a cluster, the same way the reference tests streams against
+local files.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+import pytest
+
+
+class _FakeHdfs:
+    """In-memory namespace: path -> bytes (files) or None (dirs)."""
+
+    def __init__(self):
+        self.files = {}
+        self.dirs = {"/"}
+        self.lock = threading.Lock()
+
+
+def _make_handler(state: _FakeHdfs, port_box: dict):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):   # quiet
+            pass
+
+        def _send(self, code, body=b"", headers=()):
+            self.send_response(code)
+            for k, v in headers:
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def _json(self, obj, code=200):
+            self._send(code, json.dumps(obj).encode(),
+                       [("Content-Type", "application/json")])
+
+        def _not_found(self, path):
+            self._json({"RemoteException": {
+                "exception": "FileNotFoundException",
+                "message": f"not found: {path}"}}, 404)
+
+        def _path_op(self):
+            u = urlparse(self.path)
+            q = parse_qs(u.query)
+            assert u.path.startswith("/webhdfs/v1") or \
+                u.path.startswith("/data"), u.path
+            if u.path.startswith("/webhdfs/v1"):
+                p = u.path[len("/webhdfs/v1"):] or "/"
+            else:
+                p = u.path[len("/data"):] or "/"
+            return p, q.get("op", [""])[0].upper(), u
+
+        def _status(self, p):
+            if p in state.files:
+                return {"pathSuffix": p.rsplit("/", 1)[-1], "type": "FILE",
+                        "length": len(state.files[p])}
+            if p in state.dirs:
+                return {"pathSuffix": p.rstrip("/").rsplit("/", 1)[-1],
+                        "type": "DIRECTORY", "length": 0}
+            return None
+
+        def do_GET(self):
+            p, op, _u = self._path_op()
+            with state.lock:
+                if op == "GETFILESTATUS":
+                    st = self._status(p)
+                    if st is None:
+                        return self._not_found(p)
+                    return self._json({"FileStatus": st})
+                if op == "LISTSTATUS":
+                    if p in state.files:
+                        return self._json(
+                            {"FileStatuses": {"FileStatus":
+                                              [self._status(p)]}})
+                    if p not in state.dirs:
+                        return self._not_found(p)
+                    base = p.rstrip("/")
+                    kids = set()
+                    for f in list(state.files) + list(state.dirs):
+                        if f != base + "/" and f.startswith(base + "/"):
+                            kids.add(base + "/" + f[len(base) + 1:]
+                                     .split("/")[0])
+                    return self._json({"FileStatuses": {"FileStatus": [
+                        self._status(k) for k in sorted(kids)
+                        if self._status(k)]}})
+                if op == "OPEN":
+                    if p not in state.files:
+                        return self._not_found(p)
+                    # direct content (no datanode redirect) — allowed form
+                    return self._send(200, state.files[p])
+            self._json({"RemoteException": {
+                "exception": "UnsupportedOperationException",
+                "message": op}}, 400)
+
+        def do_PUT(self):
+            p, op, u = self._path_op()
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n) if n else b""
+            with state.lock:
+                if u.path.startswith("/data"):
+                    # datanode leg of CREATE: write the (empty) file
+                    state.files[p] = body
+                    self._ensure_parents(p)
+                    return self._send(201)
+                if op == "CREATE":
+                    loc = (f"http://127.0.0.1:{port_box['port']}/data{p}"
+                           f"?op=CREATE")
+                    return self._send(307, headers=[("Location", loc)])
+                if op == "MKDIRS":
+                    state.dirs.add(p.rstrip("/") or "/")
+                    self._ensure_parents(p.rstrip("/") + "/x")
+                    return self._json({"boolean": True})
+            self._json({"RemoteException": {
+                "exception": "UnsupportedOperationException",
+                "message": op}}, 400)
+
+        def do_POST(self):
+            p, op, u = self._path_op()
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n) if n else b""
+            with state.lock:
+                if u.path.startswith("/data"):
+                    # datanode leg of APPEND
+                    state.files[p] = state.files.get(p, b"") + body
+                    return self._send(200)
+                if op == "APPEND":
+                    loc = (f"http://127.0.0.1:{port_box['port']}/data{p}"
+                           f"?op=APPEND")
+                    return self._send(307, headers=[("Location", loc)])
+            self._json({"RemoteException": {
+                "exception": "UnsupportedOperationException",
+                "message": op}}, 400)
+
+        def do_DELETE(self):
+            p, op, _u = self._path_op()
+            with state.lock:
+                if op == "DELETE":
+                    doomed = [f for f in state.files
+                              if f == p or f.startswith(p.rstrip("/") + "/")]
+                    for f in doomed:
+                        del state.files[f]
+                    state.dirs = {d for d in state.dirs
+                                  if not (d == p or d.startswith(
+                                      p.rstrip("/") + "/"))}
+                    return self._json({"boolean": bool(doomed)})
+            self._json({"RemoteException": {
+                "exception": "UnsupportedOperationException",
+                "message": op}}, 400)
+
+        def _ensure_parents(self, p):
+            parts = p.split("/")[1:-1]
+            cur = ""
+            for part in parts:
+                cur += "/" + part
+                state.dirs.add(cur)
+
+    return Handler
+
+
+@pytest.fixture()
+def fake_hdfs():
+    state = _FakeHdfs()
+    port_box = {}
+    server = ThreadingHTTPServer(("127.0.0.1", 0),
+                                 _make_handler(state, port_box))
+    port_box["port"] = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    # fsspec caches filesystem instances by (host, port); the random port
+    # makes each test's instance unique
+    yield f"127.0.0.1:{port_box['port']}", state
+    server.shutdown()
+
+
+def test_hdfs_stream_round_trip(fake_hdfs):
+    from multiverso_tpu.io.stream import open_stream, read_array, write_array
+
+    hostport, state = fake_hdfs
+    uri = f"hdfs://{hostport}/data/dir/rec.bin"
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    with open_stream(uri, "wb") as s:
+        write_array(s, arr)
+    assert "/data/dir/rec.bin" in state.files
+    with open_stream(uri, "rb") as s:
+        got = read_array(s)
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_hdfs_missing_file_raises(fake_hdfs):
+    from multiverso_tpu.io.stream import open_stream
+
+    hostport, _ = fake_hdfs
+    with pytest.raises(FileNotFoundError):
+        open_stream(f"hdfs://{hostport}/nope.bin", "rb")
+
+
+def test_hdfs_write_aborts_on_exception(fake_hdfs):
+    from multiverso_tpu.io.stream import open_stream
+
+    hostport, state = fake_hdfs
+    with pytest.raises(RuntimeError):
+        with open_stream(f"hdfs://{hostport}/partial.bin", "wb") as s:
+            s.write(b"half")
+            raise RuntimeError("mid-write")
+    assert "/partial.bin" not in state.files
+
+
+def test_hdfs_checkpoint_helpers(fake_hdfs):
+    from multiverso_tpu.io import remote
+    from multiverso_tpu.io.stream import open_stream
+
+    hostport, state = fake_hdfs
+    root = f"hdfs://{hostport}/ckpt"
+    for step in (3, 7):
+        with open_stream(f"{root}/step_{step}/manifest.json", "wb") as s:
+            s.write(b"{}")
+    with open_stream(f"{root}/step_9/other.bin", "wb") as s:
+        s.write(b"x")                       # no manifest -> not a step
+    assert remote.exists(f"{root}/step_3/manifest.json")
+    assert not remote.exists(f"{root}/step_4/manifest.json")
+    assert remote.list_subdirs_with(root, "manifest.json") == \
+        ["step_3", "step_7"]
+    remote.delete_prefix(f"{root}/step_3")
+    assert remote.list_subdirs_with(root, "manifest.json") == ["step_7"]
+
+
+def test_hdfs_text_reader(fake_hdfs):
+    from multiverso_tpu.io.stream import TextReader, open_stream
+
+    hostport, _ = fake_hdfs
+    uri = f"hdfs://{hostport}/corpus.txt"
+    with open_stream(uri, "wb") as s:
+        s.write(b"hello world\nsecond line\n")
+    with TextReader(uri) as reader:
+        assert list(reader) == ["hello world", "second line"]
