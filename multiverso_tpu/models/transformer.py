@@ -51,6 +51,9 @@ class TransformerConfig:
     learning_rate: float = 0.1
     momentum: float = 0.9
     seed: int = 0
+    # scan over the layer stack instead of unrolling: cheaper compiles
+    # for very deep models, ~30% slower steps (see forward())
+    scan_layers: bool = False
     # attention implementation: "reference" (jnp, XLA-fused), "flash"
     # (crossover dispatch — Pallas kernel at/above the measured ~1.5k-seq
     # win threshold, XLA below; never slower than reference), or
@@ -141,6 +144,16 @@ def _attention(q, k, v, n_heads: int, impl: str = "reference"):
     split = lambda x: x.reshape(B, T, n_heads, dh)
     if impl == "flash":
         from ..ops.flash_attention import best_attention as fn
+
+        if B > 1:
+            # batched (vmapped) calls amortise the kernel's launch and
+            # epilogue over B x heads programs, and the surrounding model
+            # denies XLA the fusions that make its attention cheap
+            # standalone: measured in-model (12 layers, ~8k tok/step,
+            # d_model 768), flash TIES reference at seq 512 and wins
+            # 1.5x/2x at 1024/2048 — so the batched crossover is 512,
+            # not the standalone 1536 (tools/lm_mfu.py numbers).
+            fn = partial(fn, min_flash_seq=512)
     elif impl == "flash_force":
         from ..ops.flash_attention import flash_attention as fn
     elif impl == "reference":
@@ -167,7 +180,17 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
         h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
         return h, None
 
-    h, _ = jax.lax.scan(block, h, params["layers"])
+    if cfg.scan_layers:
+        # O(1) compile size for very deep stacks, at a measured ~30%
+        # device-time cost (the scan's per-layer param slices and backward
+        # grad-stack dynamic-update-slices are real HBM traffic)
+        h, _ = jax.lax.scan(block, h, params["layers"])
+    else:
+        # unrolled (default): XLA schedules each layer's matmuls directly
+        # with no carry copies — 112 ms -> 79 ms grad step at the
+        # tools/lm_mfu.py flagship shape
+        for i in range(cfg.n_layers):
+            h, _ = block(h, jax.tree.map(lambda a: a[i], params["layers"]))
     h = _rmsnorm(h, params["ln_f_g"])
     return jnp.einsum("btd,vd->btv", h, params["embed"],
                       preferred_element_type=jnp.float32)

@@ -243,10 +243,12 @@ class AsyncDeltaBus:
                           self._inflight_bytes / 1e6)
                 warned = True
             if self._stop.is_set():
-                # shutdown raced a blocked publish; don't wedge teardown
-                Log.error("async PS: publish abandoned at shutdown "
+                # shutdown raced a blocked publish: DROP the record (the
+                # transport is being torn down; publishing past the
+                # watermark into it could block forever)
+                Log.error("async PS: publish dropped at shutdown "
                           "(%.1f MB un-acked)", self._inflight_bytes / 1e6)
-                break
+                return
             if time.monotonic() > deadline:
                 # same liveness posture as drain()'s 600 s barriers and
                 # the SSP wait: a peer that stops consuming is a failure,

@@ -308,10 +308,17 @@ def _lightlda(rows: int, cols: int, rounds: int) -> int:
     print(f"total (blocking): {rounds} rounds x {workers} workers in "
           f"{total:.2f}s ({rounds * workers / total:.1f} "
           f"worker-iterations/s)")
+    # background pulls may coalesce two rounds' dirty rows (the pull races
+    # the next round's pushes); report both pulled counts so the speedup
+    # can be read against equal work — a large delta would mean the win is
+    # partly "fewer rows moved", not overlap
+    work_delta = abs(pulled - p_pulled) / max(pulled, 1)
     print(f"total (pipelined): {rounds} rounds x {workers} workers in "
           f"{p_total:.2f}s ({rounds * workers / p_total:.1f} "
           f"worker-iterations/s) — {total / p_total:.2f}x vs blocking "
-          f"(double-buffered get_dirty_rows, {p_pulled} rows pulled)")
+          f"(double-buffered get_dirty_rows; pulled {p_pulled} rows vs "
+          f"blocking {pulled}, {work_delta * 100:.1f}% work delta"
+          f"{', NOT comparable' if work_delta > 0.05 else ''})")
     # correctness probe: global count conservation (every +1 has a -1,
     # so the table sums to ~0)
     probe = float(np.sum(table.get_rows(np.arange(0, rows,

@@ -37,15 +37,11 @@ def _device_ms_one(impl: str, seq: int, mode: str = "fwd") -> None:
 
     ``mode="fwd"`` times the forward; ``mode="fwdbwd"`` times a full
     value+grad step (the training-step attention cost)."""
-    import glob
-    import gzip
-    import shutil
-    import tempfile
-
     import jax
     import jax.numpy as jnp
 
     from multiverso_tpu.ops import flash_attention, reference_attention
+    from tools.xprof_util import trace_device_ms
 
     rng = np.random.default_rng(0)
     h, d = 8, 128
@@ -59,28 +55,9 @@ def _device_ms_one(impl: str, seq: int, mode: str = "fwd") -> None:
         fn = jax.jit(step)
     else:
         fn = jax.jit(lambda q, k, v: base(q, k, v, causal=True))
-    out = fn(q, q, q)
-    jax.block_until_ready(out)           # compile outside the trace
-    trace_dir = tempfile.mkdtemp(prefix="tpuval_")
-    jax.profiler.start_trace(trace_dir)
-    iters = 5
-    for _ in range(iters):
-        out = fn(q, q, q)
-    jax.block_until_ready(out)
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    float(leaf.reshape(-1)[0])
-    jax.profiler.stop_trace()
-    path = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
-                     recursive=True)[0]
-    with gzip.open(path) as fh:
-        events = json.load(fh)["traceEvents"]
-    total = sum(int(e["args"]["device_duration_ps"]) / 1e9 for e in events
-                if e.get("ph") == "X"
-                and "device_duration_ps" in e.get("args", {})
-                and "while" not in e.get("name", "")
-                and not e.get("name", "").startswith("jit_"))
-    shutil.rmtree(trace_dir, ignore_errors=True)
-    print(f"DEVICE_MS {total / iters:.6f}")
+    jax.block_until_ready(fn(q, q, q))   # compile outside the trace
+    ms = trace_device_ms(lambda: fn(q, q, q))
+    print(f"DEVICE_MS {ms:.6f}")
 
 
 def _device_ms(impl: str, seq: int, mode: str = "fwd") -> float:

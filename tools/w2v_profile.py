@@ -56,6 +56,8 @@ def main(argv=None):
                     help="row_mean_static (the shipped bench stabiliser)")
     ap.add_argument("--impl", default="scatter",
                     choices=["scatter", "segsum", "split8"])
+    ap.add_argument("--shared", type=int, default=0,
+                    help="shared_negatives group size G (bench default 4)")
     ap.add_argument("--trace", default="")
     args = ap.parse_args(argv)
 
@@ -79,7 +81,8 @@ def main(argv=None):
                          neg_pool_size=1 << 22,
                          row_mean_updates=bool(args.row_mean),
                          row_mean_static=bool(args.static),
-                         update_impl=args.impl)
+                         update_impl=args.impl,
+                         shared_negatives=args.shared)
     w_in = mv.create_table("matrix", vocab, D, init_value="random",
                            dtype=dtype, name="w_in")
     w_out = mv.create_table("matrix", vocab, D, dtype=dtype, name="w_out")
@@ -120,9 +123,11 @@ def main(argv=None):
 
     # ---- roofline -------------------------------------------------------
     itemsize = np.dtype(np.float32).itemsize // 2 if args.bf16 else 4
-    # per pair: in-row gather + scatter-add (read+write), (1+K) out rows
-    # gather + scatter-add; scatter-add = read + write of the row
-    rows_moved = (1 + 2) + (1 + K) * (1 + 2)
+    # per pair: in-row gather + scatter-add (read+write), (1+K/G) out rows
+    # gather + scatter-add (G pairs share one K-negative draw);
+    # scatter-add = read + write of the row
+    G = max(args.shared, 1)
+    rows_moved = (1 + 2) + (1 + K / G) * (1 + 2)
     bytes_per_pair = rows_moved * D * itemsize
     HBM = 819e9   # v5e ~819 GB/s
     bound = HBM / bytes_per_pair

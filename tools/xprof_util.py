@@ -1,0 +1,54 @@
+"""Shared xprof device-time measurement for the perf tools.
+
+Wall clocks are unreliable on a tunneled device (dispatch acks return
+early) and repeated start_trace/stop_trace in one process hangs — so
+every measurement is ONE trace (callers run one measurement per
+subprocess) and the reported time is hardware ``device_duration_ps``.
+
+Accounting rule (one place, on purpose — tools/lm_mfu.py and
+tools/tpu_validate.py previously disagreed): sum the ``jit_*`` program
+spans. The flat trace also carries per-run parent rows and the leaf ops,
+so summing everything double-counts ~2x; the program span covers the
+whole dispatched step on device, for single-op jits and full train steps
+alike.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+
+
+def trace_device_ms(run_fn, iters: int = 5) -> float:
+    """Average device ms per call of ``run_fn`` over ``iters`` traced calls.
+
+    ``run_fn()`` must dispatch the program under test and return a value
+    whose completion the caller's final fetch forces; this helper blocks
+    via ``jax.block_until_ready`` + a scalar fetch after the loop.
+    Call the function once BEFORE this (compile outside the trace).
+    """
+    import jax
+
+    trace_dir = tempfile.mkdtemp(prefix="xprof_")
+    jax.profiler.start_trace(trace_dir)
+    out = None
+    for _ in range(iters):
+        out = run_fn()
+    jax.block_until_ready(out)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf.reshape(-1)[0])
+    jax.profiler.stop_trace()
+    path = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                     recursive=True)[0]
+    with gzip.open(path) as fh:
+        events = json.load(fh)["traceEvents"]
+    total = sum(int(e["args"]["device_duration_ps"]) / 1e9 for e in events
+                if e.get("ph") == "X"
+                and "device_duration_ps" in e.get("args", {})
+                and e.get("name", "").startswith("jit_"))
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    return total / iters
