@@ -145,14 +145,17 @@ def _attention(q, k, v, n_heads: int, impl: str = "reference"):
     if impl == "flash":
         from ..ops.flash_attention import best_attention as fn
 
-        if B > 1:
-            # batched (vmapped) calls amortise the kernel's launch and
-            # epilogue over B x heads programs, and the surrounding model
-            # denies XLA the fusions that make its attention cheap
-            # standalone: measured in-model (12 layers, ~8k tok/step,
-            # d_model 768), flash TIES reference at seq 512 and wins
-            # 1.5x/2x at 1024/2048 — so the batched crossover is 512,
-            # not the standalone 1536 (tools/lm_mfu.py numbers).
+        if B * n_heads >= 64:
+            # many-program calls amortise the kernel's launch and epilogue
+            # over B x heads programs, and the surrounding model denies
+            # XLA the fusions that make its attention cheap standalone:
+            # measured in-model (12 layers, ~8k tok/step, d_model 768,
+            # 96-192 programs), flash TIES reference at seq 512 and wins
+            # 1.5x/2x at 1024/2048 — so the crossover drops to 512 there.
+            # Few-program calls (the standalone 8-program sweep ran
+            # 0.44-0.63x below seq 1536, docs/TPU_VALIDATE.json) keep the
+            # 1536 default; the 64-program gate is the measured boundary's
+            # conservative side.
             fn = partial(fn, min_flash_seq=512)
     elif impl == "flash_force":
         from ..ops.flash_attention import flash_attention as fn

@@ -5,12 +5,15 @@ early) and repeated start_trace/stop_trace in one process hangs — so
 every measurement is ONE trace (callers run one measurement per
 subprocess) and the reported time is hardware ``device_duration_ps``.
 
-Accounting rule (one place, on purpose — tools/lm_mfu.py and
-tools/tpu_validate.py previously disagreed): sum the ``jit_*`` program
-spans. The flat trace also carries per-run parent rows and the leaf ops,
-so summing everything double-counts ~2x; the program span covers the
-whole dispatched step on device, for single-op jits and full train steps
-alike.
+Accounting rule (one place, on purpose): sum the ``jit_*`` program
+spans. This CHANGED the methodology in round 3 — the tools previously
+summed the non-``jit_``/non-``while`` leaf ops, which double-counts
+multi-level traces (per-run parent rows + leaves) on big programs and
+reads lower than the program span on single-op jits (the r3 regeneration
+of TPU_VALIDATE.json re-measured every row under spans). The program
+span covers the whole dispatched step on device, for single-op jits and
+full train steps alike, and is the number wall-clock comparisons
+reproduce.
 """
 
 from __future__ import annotations
