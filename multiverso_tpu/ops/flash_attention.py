@@ -236,13 +236,17 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
     return out, (q, k, v, out, m, l)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr,
                    *, scale: float, causal: bool, kv_len: int,
                    block_q: int, block_k: int, precision):
     """dq pass: grid (h, q-block, k-block); dq accumulates in VMEM over the
     innermost k dimension. Probabilities recompute from the saved row
-    logsumexp — the flash backward's no-[s,s]-buffer property."""
+    logsumexp — the flash backward's no-[s,s]-buffer property.
+
+    ``offs_ref`` (scalar prefetch) holds ``[q_base, k_base]`` — global
+    position offsets, zeros for whole-sequence backward, shard offsets for
+    one ring step (mirrors the forward kernel's contract)."""
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -250,10 +254,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
+    q_base = offs_ref[0]
+    k_base = offs_ref[1]
     qi = pl.program_id(1)
     k_local0 = ki * block_k
-    run = jnp.logical_or(not causal,
-                         (qi + 1) * block_q - 1 >= k_local0)
+    run = jnp.logical_or(
+        not causal,
+        q_base + (qi + 1) * block_q - 1 >= k_base + k_local0)
     run = jnp.logical_and(run, k_local0 < kv_len)
 
     @pl.when(run)
@@ -273,7 +280,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            mask = jnp.logical_and(mask, k_pos <= q_pos)
+            mask = jnp.logical_and(mask, k_base + k_pos <= q_base + q_pos)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(
             g, v, (((1,), (1,)), ((), ())), precision=precision,
@@ -288,12 +295,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
                     *, scale: float, causal: bool, kv_len: int,
                     block_q: int, block_k: int, precision):
     """dk/dv pass: grid (h, k-block, q-block); both accumulate in VMEM over
-    the innermost q dimension."""
+    the innermost q dimension. ``offs_ref`` as in :func:`_bwd_dq_kernel`."""
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -302,11 +309,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
+    q_base = offs_ref[0]
+    k_base = offs_ref[1]
     ki = pl.program_id(1)
     k_local0 = ki * block_k
     # causal: q blocks strictly above the diagonal contribute nothing
-    run = jnp.logical_or(not causal,
-                         (qi + 1) * block_q - 1 >= k_local0)
+    run = jnp.logical_or(
+        not causal,
+        q_base + (qi + 1) * block_q - 1 >= k_base + k_local0)
     run = jnp.logical_and(run, k_local0 < kv_len)
 
     @pl.when(run)
@@ -326,7 +336,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            mask = jnp.logical_and(mask, k_pos <= q_pos)
+            mask = jnp.logical_and(mask, k_base + k_pos <= q_base + q_pos)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # [bq, bk]
         dv_scr[:] += jax.lax.dot_general(
             p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
@@ -352,19 +362,20 @@ def _stat_tiles(x, h, n_blocks, block: int):
     return jnp.broadcast_to(xp, (h, n_blocks, 8, block))
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, precision, res, g):
-    """Pallas blockwise backward from saved row stats (no [s,s] buffer).
+def _bwd_call(q, k, v, g, lse, delta, q_base, k_base, *, causal: bool,
+              scale: float, block_q: int, block_k: int,
+              interpret: Optional[bool], precision=None):
+    """Backward of one (q rows x k rows) attention block pair.
 
-    Standard flash backward: with row logsumexp ``L = m + log l`` the
-    probabilities of any k-block recompute as ``exp(s - L)``; then
-    ``dv = p^T g``, ``ds = p * (g v^T - rowsum(g*o))``, ``dq = ds k``,
-    ``dk = ds^T q`` — dq in one kernel (k innermost), dk/dv in a second
-    (q innermost), both accumulating in VMEM scratch.
+    ``lse``/``delta`` are the q rows' logsumexp and ``rowsum(g*out)``
+    ([h, sq]); ``q_base``/``k_base`` are the rows' global positions for
+    causal masking (zeros = whole-sequence). Returns f32
+    ``(dq [sq,h,d], dk [sk,h,d], dv [sk,h,d])`` — the contribution of
+    THIS k-block to dq and of this q-block to dk/dv, so ring callers can
+    accumulate across steps.
     """
     if interpret is None:
         interpret = _interpret_default()
-    q, k, v, out, m, l = res
-    s_scale = _resolve_scale(q, scale)
     sq, h, d = q.shape
     sk = k.shape[0]
     block_q = min(block_q, max(8, 1 << (sq - 1).bit_length()))
@@ -379,59 +390,112 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, precision, res, g):
     kt = _pad_to(_pad_to(jnp.transpose(k, (1, 0, 2)), sk_p, 1), d_p, 2)
     vt = _pad_to(_pad_to(jnp.transpose(v, (1, 0, 2)), sk_p, 1), d_p, 2)
     gt = _pad_to(_pad_to(jnp.transpose(g, (1, 0, 2)), sq_p, 1), d_p, 2)
-    # lse per q row; padded rows get +LARGE so their recomputed p == 0
-    lse = m + jnp.log(jnp.maximum(l, 1e-20))                    # [h, sq]
+    # padded q rows get +LARGE lse so their recomputed p == 0
     lse_p = jnp.where((jnp.arange(sq_p) < sq)[None, :],
                       _pad_to(lse, sq_p, 1), -_NEG_INF)
     lse_t = _stat_tiles(lse_p, h, nq, block_q)
-    delta = jnp.einsum("shd,shd->hs", g.astype(jnp.float32),
-                       out.astype(jnp.float32))                 # [h, sq]
     delta_t = _stat_tiles(_pad_to(delta, sq_p, 1), h, nq, block_q)
+    offs = jnp.asarray([q_base, k_base], jnp.int32)
 
-    q_spec = pl.BlockSpec((1, block_q, d_p), lambda hi, a, b: (hi, a, 0))
-    k_spec = pl.BlockSpec((1, block_k, d_p), lambda hi, a, b: (hi, b, 0))
-    stat_spec = pl.BlockSpec((1, 1, 8, block_q), lambda hi, a, b: (hi, a, 0, 0))
+    q_spec = pl.BlockSpec((1, block_q, d_p),
+                          lambda hi, a, b, offs: (hi, a, 0))
+    k_spec = pl.BlockSpec((1, block_k, d_p),
+                          lambda hi, a, b, offs: (hi, b, 0))
+    stat_spec = pl.BlockSpec((1, 1, 8, block_q),
+                             lambda hi, a, b, offs: (hi, a, 0, 0))
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=s_scale, causal=causal,
-                          kv_len=sk, block_q=block_q, block_k=block_k,
-                          precision=precision),
+    dq_grid = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(h, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, stat_spec, stat_spec],
-        out_specs=pl.BlockSpec((1, block_q, d_p), lambda hi, a, b: (hi, a, 0)),
-        out_shape=jax.ShapeDtypeStruct((h, sq_p, d_p), jnp.float32),
+        out_specs=pl.BlockSpec((1, block_q, d_p),
+                               lambda hi, a, b, offs: (hi, a, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d_p), jnp.float32)],
-        interpret=interpret,
-    )(qt, kt, vt, gt, lse_t, delta_t)
-
-    # dk/dv grid: second axis is the K block, innermost is the Q block
-    q_spec2 = pl.BlockSpec((1, block_q, d_p), lambda hi, a, b: (hi, b, 0))
-    k_spec2 = pl.BlockSpec((1, block_k, d_p), lambda hi, a, b: (hi, a, 0))
-    stat_spec2 = pl.BlockSpec((1, 1, 8, block_q),
-                              lambda hi, a, b: (hi, b, 0, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=s_scale, causal=causal,
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           kv_len=sk, block_q=block_q, block_k=block_k,
                           precision=precision),
+        grid_spec=dq_grid,
+        out_shape=jax.ShapeDtypeStruct((h, sq_p, d_p), jnp.float32),
+        interpret=interpret,
+    )(offs, qt, kt, vt, gt, lse_t, delta_t)
+
+    # dk/dv grid: second axis is the K block, innermost is the Q block
+    q_spec2 = pl.BlockSpec((1, block_q, d_p),
+                           lambda hi, a, b, offs: (hi, b, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d_p),
+                           lambda hi, a, b, offs: (hi, a, 0))
+    stat_spec2 = pl.BlockSpec((1, 1, 8, block_q),
+                              lambda hi, a, b, offs: (hi, b, 0, 0))
+    dkv_grid = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(h, nk, nq),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, stat_spec2, stat_spec2],
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, stat_spec2,
+                  stat_spec2],
         out_specs=[
-            pl.BlockSpec((1, block_k, d_p), lambda hi, a, b: (hi, a, 0)),
-            pl.BlockSpec((1, block_k, d_p), lambda hi, a, b: (hi, a, 0)),
+            pl.BlockSpec((1, block_k, d_p),
+                         lambda hi, a, b, offs: (hi, a, 0)),
+            pl.BlockSpec((1, block_k, d_p),
+                         lambda hi, a, b, offs: (hi, a, 0)),
         ],
+        scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
+                        pltpu.VMEM((block_k, d_p), jnp.float32)],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          kv_len=sk, block_q=block_q, block_k=block_k,
+                          precision=precision),
+        grid_spec=dkv_grid,
         out_shape=[
             jax.ShapeDtypeStruct((h, sk_p, d_p), jnp.float32),
             jax.ShapeDtypeStruct((h, sk_p, d_p), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((block_k, d_p), jnp.float32),
-                        pltpu.VMEM((block_k, d_p), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, gt, lse_t, delta_t)
+    )(offs, qt, kt, vt, gt, lse_t, delta_t)
 
-    dq = jnp.transpose(dq[:, :sq, :d], (1, 0, 2)).astype(q.dtype)
-    dk = jnp.transpose(dk[:, :sk, :d], (1, 0, 2)).astype(k.dtype)
-    dv = jnp.transpose(dv[:, :sk, :d], (1, 0, 2)).astype(v.dtype)
+    dq = jnp.transpose(dq[:, :sq, :d], (1, 0, 2))
+    dk = jnp.transpose(dk[:, :sk, :d], (1, 0, 2))
+    dv = jnp.transpose(dv[:, :sk, :d], (1, 0, 2))
     return dq, dk, dv
+
+
+def flash_attention_partial_bwd(q, k, v, g, lse, delta, q_base, k_base,
+                                causal: bool = False,
+                                scale: Optional[float] = None,
+                                block_q: int = 1024, block_k: int = 1024,
+                                interpret: Optional[bool] = None,
+                                precision=None):
+    """One ring step's backward: Pallas dq/dk/dv for a (q-shard, k-shard)
+    pair in GLOBAL coordinates (the gradient twin of
+    :func:`flash_attention_partial`). ``lse = m + log l`` comes from the
+    forward ring's merged statistics; ``delta = rowsum(g * out)`` from the
+    normalized output. Returns f32 partials for the caller to accumulate.
+    """
+    s = _resolve_scale(q, scale)
+    return _bwd_call(q, k, v, g, lse, delta, q_base, k_base, causal=causal,
+                     scale=s, block_q=block_q, block_k=block_k,
+                     interpret=interpret, precision=precision)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, precision, res, g):
+    """Pallas blockwise backward from saved row stats (no [s,s] buffer).
+
+    Standard flash backward: with row logsumexp ``L = m + log l`` the
+    probabilities of any k-block recompute as ``exp(s - L)``; then
+    ``dv = p^T g``, ``ds = p * (g v^T - rowsum(g*o))``, ``dq = ds k``,
+    ``dk = ds^T q`` — dq in one kernel (k innermost), dk/dv in a second
+    (q innermost), both accumulating in VMEM scratch.
+    """
+    q, k, v, out, m, l = res
+    s_scale = _resolve_scale(q, scale)
+    lse = m + jnp.log(jnp.maximum(l, 1e-20))                    # [h, sq]
+    delta = jnp.einsum("shd,shd->hs", g.astype(jnp.float32),
+                       out.astype(jnp.float32))                 # [h, sq]
+    dq, dk, dv = _bwd_call(q, k, v, g, lse, delta, 0, 0, causal=causal,
+                           scale=s_scale, block_q=block_q, block_k=block_k,
+                           interpret=interpret, precision=precision)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -72,8 +72,14 @@ def ring_attention(
 
     ``impl="pallas"`` runs each ring step's block attention as the Pallas
     flash kernel (``ops.flash_attention_partial``) — the MXU-heavy part —
-    with the cheap running-max merge in XLA while ``ppermute`` rotates K/V;
-    forward-only (use the default XLA impl when differentiating through).
+    with the cheap running-max merge in XLA while ``ppermute`` rotates K/V.
+    Differentiable: the custom VJP runs a SECOND ring that rotates
+    ``(k, v, dk, dv)`` together while the Pallas backward kernels
+    (``flash_attention_partial_bwd``) produce each (q-shard, k-shard)
+    pair's gradient contribution — dk/dv accumulators arrive back home
+    after a full revolution, and activation memory stays O(seq/n) per
+    device (only the forward's row statistics are saved; probabilities
+    recompute blockwise from the logsumexp).
     """
     if impl not in ("xla", "pallas"):
         raise ValueError(f"unknown ring attention impl {impl!r}")
@@ -86,10 +92,8 @@ def ring_attention(
     spec = P(axis, None, None)
     perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
 
-    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-             check_vma=False)
-    def _ring(q_blk, k_blk, v_blk):
-        my_idx = jax.lax.axis_index(axis)
+    def _fwd_shard(q_blk, k_blk, v_blk, my_idx):
+        """One shard's forward ring; returns (out, lse [h, block])."""
         h = q_blk.shape[1]
         q_pos = my_idx * block + jnp.arange(block)
         # f32 carry regardless of input dtype: both impls produce f32
@@ -120,11 +124,79 @@ def ring_attention(
         m, l, acc, _, _ = jax.lax.fori_loop(
             0, n_blocks, body, (m0, l0, acc0, k_blk, v_blk))
         denom = jnp.maximum(l, 1e-20).transpose(1, 0)[:, :, None]
+        lse = m + jnp.log(jnp.maximum(l, 1e-20))
         # keep the two impls interchangeable: partial-merge math runs in
         # f32, but the contract is out.dtype == q.dtype
-        return (acc / denom).astype(q_blk.dtype)
+        return (acc / denom).astype(q_blk.dtype), lse
 
-    return _ring(q, k, v)
+    if impl == "xla":
+        @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=spec, check_vma=False)
+        def _ring(q_blk, k_blk, v_blk):
+            my_idx = jax.lax.axis_index(axis)
+            return _fwd_shard(q_blk, k_blk, v_blk, my_idx)[0]
+
+        return _ring(q, k, v)
+
+    # -- Pallas impl: custom VJP with a backward ring -----------------------
+    from .flash_attention import flash_attention_partial_bwd
+
+    lse_spec = P(None, axis)   # [h, seq] row statistics, seq-sharded
+
+    @jax.custom_vjp
+    def _ring_pallas(q, k, v):
+        return _ring_pallas_fwd(q, k, v)[0]
+
+    def _ring_pallas_fwd(q, k, v):
+        @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=(spec, lse_spec), check_vma=False)
+        def _fwd(q_blk, k_blk, v_blk):
+            my_idx = jax.lax.axis_index(axis)
+            return _fwd_shard(q_blk, k_blk, v_blk, my_idx)
+
+        out, lse = _fwd(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def _ring_pallas_bwd(res, g):
+        q, k, v, out, lse = res
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(spec, spec, spec, spec, spec, lse_spec),
+                 out_specs=(spec, spec, spec), check_vma=False)
+        def _bwd(q_blk, k_blk, v_blk, out_blk, g_blk, lse_blk):
+            my_idx = jax.lax.axis_index(axis)
+            delta = jnp.einsum("shd,shd->hs", g_blk.astype(jnp.float32),
+                               out_blk.astype(jnp.float32))   # [h, block]
+
+            def body(step, carry):
+                dq, k_cur, v_cur, dk_cur, dv_cur = carry
+                src = jnp.mod(my_idx - step, n_blocks)
+                dq_p, dk_p, dv_p = flash_attention_partial_bwd(
+                    q_blk, k_cur, v_cur, g_blk, lse_blk, delta,
+                    my_idx * block, src * block, causal=causal, scale=scale)
+                dq = dq + dq_p
+                dk_cur = dk_cur + dk_p
+                dv_cur = dv_cur + dv_p
+                # dk/dv accumulators TRAVEL WITH their k/v block: after a
+                # full revolution they are back home carrying every
+                # q-shard's contribution
+                k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+                v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+                dk_nxt = jax.lax.ppermute(dk_cur, axis, perm)
+                dv_nxt = jax.lax.ppermute(dv_cur, axis, perm)
+                return dq, k_nxt, v_nxt, dk_nxt, dv_nxt
+
+            dq0 = jnp.zeros(q_blk.shape, jnp.float32)
+            dkv0 = jnp.zeros(k_blk.shape, jnp.float32)
+            dq, _, _, dk, dv = jax.lax.fori_loop(
+                0, n_blocks, body, (dq0, k_blk, v_blk, dkv0, dkv0))
+            return (dq.astype(q_blk.dtype), dk.astype(k_blk.dtype),
+                    dv.astype(v_blk.dtype))
+
+        return _bwd(q, k, v, out, g, lse)
+
+    _ring_pallas.defvjp(_ring_pallas_fwd, _ring_pallas_bwd)
+    return _ring_pallas(q, k, v)
 
 
 def reference_attention(q, k, v, causal: bool = False,

@@ -114,3 +114,38 @@ def test_ring_attention_pallas_impl(causal):
     ref = reference_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_pallas_grads(causal):
+    """The Pallas ring's custom VJP (backward ring rotating (k,v,dk,dv)
+    with the partial backward kernels) must match grads of the unsharded
+    oracle — long-context SP training at kernel speed."""
+    n = jax.device_count()
+    mesh = make_mesh((n,), axis_names=(SEQ_AXIS,))
+    q, k, v = _qkv(16 * n, heads=2, dim=16, seed=6)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            ring_attention(q, k, v, mesh, causal=causal,
+                           impl="pallas") ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_ring_attention_pallas_grads_under_jit():
+    n = jax.device_count()
+    mesh = make_mesh((n,), axis_names=(SEQ_AXIS,))
+    q, k, v = _qkv(16 * n, heads=2, dim=16, seed=7)
+    step = jax.jit(jax.grad(lambda q, k, v: jnp.sum(ring_attention(
+        q, k, v, mesh, causal=True, impl="pallas") ** 2),
+        argnums=(0, 1, 2)))
+    gq, gk, gv = step(q, k, v)
+    assert all(np.isfinite(np.asarray(g)).all() for g in (gq, gk, gv))

@@ -712,3 +712,70 @@ def test_two_process_bigbus_chunked_backpressure(tmp_path):
         assert proc.returncode == 0, f"rank {rank}:\n{out[-3000:]}"
         assert f"RANK{rank}_BIGBUS_OK" in out
     print(outs[0].strip().splitlines()[-1])
+
+
+_SSP_UNEQ_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    sys.path.insert(0, %r)
+    import multiverso_tpu as mv
+    from multiverso_tpu.apps.wordembedding import Dictionary, train
+    from multiverso_tpu.models.word2vec import Word2VecConfig
+
+    rank = int(os.environ["MV_PROCESS_ID"])
+    corpus = os.environ["MV_TEST_CORPUS"]
+    # staleness 0 = tightest gating: any per-round skew must block
+    mv.init(["w", "-sync=false", "-ssp_staleness=0", "-log_level=error"])
+    d = Dictionary.build(corpus, min_count=1)
+    cfg = Word2VecConfig(embedding_size=8, window=2, negative=2,
+                         batch_size=64, steps_per_call=1, seed=13)
+    res = train(corpus, cfg=cfg, epochs=2, min_count=1, dictionary=d,
+                device_corpus=False, log_every=0)
+    assert res.pairs_trained > 0
+    print(f"RANK{rank}_SSPUNEQ_OK words={res.words_trained}", flush=True)
+    mv.shutdown()
+""")
+
+
+def test_two_process_ssp_unequal_shards_no_deadlock(tmp_path):
+    """r3 regression: per-epoch SSP clocks + FinishTrain release. Line-mod
+    sharding gives the two workers UNEQUAL batch counts per epoch (odd
+    line count, varying line lengths); with -ssp_staleness=0 the old
+    epoch-global clock deadlocked the faster worker against the epoch
+    barrier; the per-epoch clock releases laggards via finish()."""
+    rng = __import__("random").Random(5)
+    words = [f"w{i}" for i in range(30)]
+    corpus = tmp_path / "uneq.txt"
+    with open(corpus, "w") as f:
+        for i in range(151):                     # odd -> shards differ
+            n = 4 + (i * 7) % 9                  # varying line lengths
+            f.write(" ".join(rng.choice(words) for _ in range(n)) + "\n")
+    port = _free_port()
+    script = tmp_path / "ssp_uneq_worker.py"
+    script.write_text(_SSP_UNEQ_WORKER % _REPO)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "MV_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "MV_NUM_PROCESSES": "2",
+            "MV_PROCESS_ID": str(rank),
+            "MV_TEST_CORPUS": str(corpus),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"rank {rank} timed out (SSP unequal-shard "
+                        "deadlock regressed)")
+        assert proc.returncode == 0, f"rank {rank}:\n{out[-3000:]}"
+        assert f"RANK{rank}_SSPUNEQ_OK" in out
