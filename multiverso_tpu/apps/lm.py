@@ -11,7 +11,7 @@ Usage::
 
     python -m multiverso_tpu.apps.lm -train_file corpus.txt \
         [-d_model 256] [-n_layers 4] [-n_heads 4] [-seq 256] [-batch 32]
-        [-steps 1000] [-lr 0.1] [-attention reference|flash]
+        [-steps 1000] [-lr 0.1] [-attention flash|reference|flash_force]
         [-ckpt DIR] [-ckpt_every 200] [-sample 128]
 """
 
@@ -91,7 +91,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     batch = opt("batch", 32, int)
     steps = opt("steps", 1000, int)
     lr = opt("lr", 0.1, float)
-    attention = opt("attention", "reference")
+    # flash = crossover dispatch, never slower than reference at any
+    # shape (docs/LM_MFU.md: 1.5-2x faster at seq >= 1024 in-model)
+    attention = opt("attention", "flash")
     ckpt = opt("ckpt", "")
     ckpt_every = opt("ckpt_every", 200, int)
     n_sample = opt("sample", 0, int)
@@ -99,7 +101,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not train_file:
         print("usage: lm -train_file FILE [-d_model N] [-n_layers N] "
               "[-n_heads N] [-seq N] [-batch N] [-steps N] [-lr F] "
-              "[-attention reference|flash] [-ckpt DIR] [-ckpt_every N] "
+              "[-attention flash|reference|flash_force] [-ckpt DIR] [-ckpt_every N] "
               "[-sample N]")
         return 2
 

@@ -85,9 +85,12 @@ def test_best_attention_crossover_dispatch():
     # 64 < default threshold -> XLA path (identical)
     np.testing.assert_array_equal(
         np.asarray(best_attention(q, k, v, causal=True)), np.asarray(ref))
-    # forced low threshold -> kernel path (numerically close)
+    # forced low threshold + explicit interpret -> kernel path (off-TPU
+    # the dispatch otherwise always answers XLA; interpret=True is the
+    # test override)
     np.testing.assert_allclose(
-        np.asarray(best_attention(q, k, v, causal=True, min_flash_seq=1)),
+        np.asarray(best_attention(q, k, v, causal=True, min_flash_seq=1,
+                                  interpret=True)),
         np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
