@@ -43,6 +43,8 @@ def ulysses_attention(
     axis: str = SEQ_AXIS,
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "xla",
+    **attn_kwargs,
 ) -> jax.Array:
     """Exact attention with sequence sharded over ``axis`` via all_to_all.
 
@@ -50,7 +52,19 @@ def ulysses_attention(
     requires ``heads % mesh.shape[axis] == 0`` and
     ``seq % mesh.shape[axis] == 0``. Returns the same shape/sharding as
     ``q``. Matches :func:`ring_attention` / :func:`reference_attention`.
+
+    ``impl="flash"`` runs the local per-head full-sequence attention
+    through the crossover dispatch (:func:`ops.flash_attention.
+    best_attention`) — at long sequences (the regime Ulysses exists for)
+    that is the Pallas kernel fwd AND bwd, never slower than the XLA path
+    at any length. Extra ``attn_kwargs`` (``min_flash_seq``,
+    ``interpret``, block sizes) pass through to the dispatch, which is
+    how CI exercises the kernel branch off-TPU (interpret mode).
     """
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"unknown ulysses impl {impl!r}")
+    if impl == "xla" and attn_kwargs:
+        raise ValueError("attn_kwargs only apply to impl='flash'")
     n_shards = int(mesh.shape[axis])
     seq, heads = int(q.shape[0]), int(q.shape[1])
     if heads % n_shards != 0:
@@ -61,6 +75,10 @@ def ulysses_attention(
         raise ValueError(f"seq {seq} must divide over {n_shards} shards")
     scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
     spec = P(axis, None, None)
+    if impl == "flash":
+        from .flash_attention import best_attention as _local_attn
+    else:
+        _local_attn = reference_attention
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
@@ -72,9 +90,10 @@ def ulysses_attention(
                                       tiled=True)
 
         qf, kf, vf = to_heads(q_blk), to_heads(k_blk), to_heads(v_blk)
-        # the local per-head computation IS the oracle: one exact-attention
-        # implementation shared with the tests (f32 accumulation inside)
-        out = reference_attention(qf, kf, vf, causal=causal, scale=scale)
+        # the local per-head computation IS the oracle (xla impl) or the
+        # crossover-dispatched kernel (flash impl); f32 accumulation inside
+        out = _local_attn(qf, kf, vf, causal=causal, scale=scale,
+                          **attn_kwargs)
         # [seq, H/S, d] -> [seq/S, H, d]
         return jax.lax.all_to_all(out, axis, split_axis=0, concat_axis=1,
                                   tiled=True).astype(q_blk.dtype)

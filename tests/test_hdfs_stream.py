@@ -244,3 +244,28 @@ def test_hdfs_text_reader(fake_hdfs):
         s.write(b"hello world\nsecond line\n")
     with TextReader(uri) as reader:
         assert list(reader) == ["hello world", "second line"]
+
+
+def test_checkpoint_save_restore_hdfs(fake_hdfs, mv_session):
+    """Full checkpoint round trip + restore_latest over hdfs:// — the
+    reference stored tables on the cluster FS through its HDFS stream
+    (src/io/hdfs_stream.cpp); this drives the same contract end-to-end
+    against the WebHDFS protocol double."""
+    from multiverso_tpu.io import checkpoint
+
+    hostport, _ = fake_hdfs
+    mv = mv_session
+    t = mv.create_table("array", 16)
+    t.add(np.arange(16, dtype=np.float32))
+
+    root = f"hdfs://{hostport}/ckpts"
+    checkpoint.save(f"{root}/step_000002")
+    t.add(np.full(16, 50.0, np.float32))
+    checkpoint.save(f"{root}/step_000005")
+
+    t.add(np.ones(16, np.float32))               # clobber
+    step = checkpoint.restore_latest(root)
+    assert step == 5
+    np.testing.assert_allclose(
+        t.get(), np.arange(16, dtype=np.float32) + 50.0)
+    assert checkpoint.list_steps(root) == [2, 5]
