@@ -73,7 +73,22 @@ def main() -> int:
     if not os.path.exists(corpus):
         make_corpus(corpus)
 
-    mv.init(["bench", "-log_level=error"] + sys.argv[1:])
+    rest = mv.init(["bench", "-log_level=error"] + sys.argv[1:])
+    # bench has no app-layer flags beyond the registry: anything left over
+    # is a typo or a bad value ('-oversample=2' once silently measured the
+    # default config). Distinguish the two — a known key lands here when
+    # its value failed coercion.
+    leftover = [t for t in rest if t != "bench"]
+    if leftover:
+        from multiverso_tpu import config as _cfg
+
+        for tok in leftover:
+            key = tok.lstrip("-").partition("=")[0]
+            kind = ("bad value for flag" if _cfg.registry().known(key)
+                    else "unknown flag")
+            print(f"bench: {kind}: {tok}", file=sys.stderr)
+        mv.shutdown()
+        return 2
     shared_neg = mv.get_flag("shared_negatives")
     dictionary = Dictionary.build(corpus, min_count=1)
     # TPU-native settings: bf16 embedding tables (f32 score/grad
