@@ -140,11 +140,17 @@ def main() -> int:
     mv.shutdown()
 
     value = pairs / elapsed
+    # the negative-draw mode rides in the output line so every recorded
+    # number is self-describing: G>1 group-shares draws (an algorithmic
+    # relaxation over the reference's exact per-pair semantics — disclosed
+    # in BASELINE.md, parity-gated in docs/EMBEDDING_QUALITY.md)
     print(json.dumps({
         "metric": "word2vec_train_pairs_per_sec",
         "value": round(value, 1),
         "unit": "pairs/sec",
         "vs_baseline": round(value / _BASELINE_PAIRS_PER_SEC, 4),
+        "negatives": ("exact" if shared_neg in (0, 1)
+                      else f"group-shared G={shared_neg}"),
     }))
     return 0
 

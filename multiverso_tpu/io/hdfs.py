@@ -112,7 +112,11 @@ def exists(uri_str: str) -> bool:
     uri = URI(uri_str)
     try:
         return bool(_fs_for(uri.host).exists(uri.path))
-    except Exception:
+    except FileNotFoundError:
+        # only a definite "not there" reads as absence; a transient
+        # WebHDFS/namenode failure must NOT — restore_latest probes
+        # manifests through here, and failure-as-absence would silently
+        # skip a valid checkpoint
         return False
 
 

@@ -130,7 +130,11 @@ def exists(uri_str: str) -> bool:
     store, key = _kvstore_for(uri)
     try:
         return str(store.read(key).result().state) != "missing"
-    except Exception:
+    except FileNotFoundError:
+        # only a definite "not there" reads as absence — a transient
+        # object-store/auth failure must NOT (restore_latest probes
+        # manifests through here; failure-as-absence would silently skip
+        # a valid checkpoint). Same contract as io/hdfs.py exists().
         return False
 
 

@@ -13,6 +13,7 @@ code can attach handlers; the reference-facing API surface is preserved.
 from __future__ import annotations
 
 import logging
+import os
 import sys
 import threading
 from enum import IntEnum
@@ -101,7 +102,13 @@ class Logger:
         rendered = msg % args if args else msg
         self._logger.critical(rendered)
         if self._kill_fatal:
-            sys.exit(1)
+            if threading.current_thread() is threading.main_thread():
+                sys.exit(1)
+            # sys.exit in a worker thread raises SystemExit that threading
+            # swallows — the process would keep training past a fatal
+            # invariant violation. Kill for real (message already flushed
+            # through the critical handler above).
+            os._exit(1)
         raise FatalError(rendered)
 
 

@@ -99,6 +99,10 @@ _PART_HEADER = struct.Struct("<BII")   # kind=PART, part_index, n_parts
 _published = 0
 _consumed: dict = {}
 _state_lock = threading.Lock()
+# the counters above are rank-keyed and process-wide, which is only sound
+# for ONE live bus per process (documented lifecycle); a second concurrent
+# Session would silently share them — refuse loudly instead
+_active_bus: Optional["AsyncDeltaBus"] = None
 
 
 def _serialize(kind: int, table_id: int, option, arrays: Sequence[np.ndarray]
@@ -164,7 +168,13 @@ class AsyncDeltaBus:
         self._mon_pub = Dashboard.get_or_create("ASYNC_BUS[PUBLISH]")
         self._mon_apply = Dashboard.get_or_create("ASYNC_BUS[APPLY]")
         self._mon_lat = Dashboard.get_or_create("ASYNC_BUS[LATENCY]")
+        global _active_bus
         with _state_lock:
+            if _active_bus is not None:
+                Log.fatal("async PS: a second AsyncDeltaBus in one process "
+                          "would share the module-level sequence counters; "
+                          "stop() the first bus before starting another")
+            _active_bus = self
             for r in range(self._size):
                 _consumed.setdefault(r, 0)
         self._thread = threading.Thread(
@@ -194,9 +204,24 @@ class AsyncDeltaBus:
 
     def stop(self) -> None:
         """Collective: drain everything in flight, then stop the thread."""
-        self.drain()
-        self._stop.set()
-        self._thread.join(timeout=30)
+        global _active_bus
+        try:
+            self.drain()
+        finally:
+            # deregister even when drain() fails (the bus is dead either
+            # way and a supervised restart must be able to start a new
+            # one) — but ONLY once the drain thread is actually gone: a
+            # still-running thread would race a successor bus on the
+            # module-level _consumed counters
+            self._stop.set()
+            self._thread.join(timeout=30)
+            with _state_lock:
+                if self._thread.is_alive():
+                    Log.error("async PS: drain thread failed to stop in "
+                              "30 s; bus stays registered (a new bus would "
+                              "race it on the sequence counters)")
+                elif _active_bus is self:
+                    _active_bus = None
 
     # -- publish (worker -> group) ----------------------------------------
     def _acks_for(self, seq: int) -> int:
@@ -243,12 +268,14 @@ class AsyncDeltaBus:
                           self._inflight_bytes / 1e6)
                 warned = True
             if self._stop.is_set():
-                # shutdown raced a blocked publish: DROP the record (the
-                # transport is being torn down; publishing past the
-                # watermark into it could block forever)
-                Log.error("async PS: publish dropped at shutdown "
-                          "(%.1f MB un-acked)", self._inflight_bytes / 1e6)
-                return
+                # shutdown raced a blocked publish. Dropping the record
+                # would permanently diverge peers that consumed earlier
+                # records from this rank, with no hard signal — so this is
+                # a caller error (stop() drains collectively first; publish
+                # concurrently with shutdown breaks that contract).
+                Log.fatal("async PS: publish raced shutdown with "
+                          f"{self._inflight_bytes / 1e6:.1f} MB un-acked — "
+                          "callers must drain() before stopping the bus")
             if time.monotonic() > deadline:
                 # same liveness posture as drain()'s 600 s barriers and
                 # the SSP wait: a peer that stops consuming is a failure,
@@ -380,11 +407,14 @@ class AsyncDeltaBus:
             _, idx, n_parts = _PART_HEADER.unpack(data[:_PART_HEADER.size])
             buf = self._parts.setdefault(publisher, [])
             if idx != len(buf):
-                Log.error("async PS: part %d/%d from rank %d arrived at "
-                          "position %d; dropping partial record",
-                          idx, n_parts, publisher, len(buf))
-                buf.clear()
-                return
+                # parts ride consecutive sequence numbers consumed in order,
+                # so an out-of-position part means the transport ordering
+                # invariant itself broke — applying around it would silently
+                # diverge this replica (the record is gone but peers count
+                # it as delivered). Fail loudly instead.
+                Log.fatal(f"async PS: part {idx}/{n_parts} from rank "
+                          f"{publisher} arrived at position {len(buf)} — "
+                          "consecutive-seq reassembly invariant broken")
             buf.append(data[_PART_HEADER.size:])
             if len(buf) < n_parts:
                 return
@@ -393,9 +423,17 @@ class AsyncDeltaBus:
         self._apply(data)
 
     def _drain_loop(self) -> None:
+        from ..log import FatalError
+
         while not self._stop.wait(self._interval):
             try:
                 self.poll_once()
+            except FatalError:
+                # invariant violations (e.g. PART reassembly order) are
+                # already logged at critical; stop consuming so drain()'s
+                # quiesce wedges loudly instead of passing with a missing
+                # delta
+                raise
             except Exception as exc:   # pragma: no cover - transport races
                 if not self._stop.is_set():
                     Log.error("async PS drain error: %s", exc)

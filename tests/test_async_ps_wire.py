@@ -65,8 +65,13 @@ def test_kv_record():
 
 def test_part_records_reassemble_to_one_apply():
     """Wire chunking: PART records at consecutive seqs reassemble into ONE
-    logical record and apply exactly once; an out-of-order part drops the
-    partial buffer instead of corrupting the stream."""
+    logical record and apply exactly once; an out-of-order part is a broken
+    transport invariant and fails LOUDLY (applying around it would silently
+    diverge the replica — advisor r3)."""
+    import pytest
+
+    from multiverso_tpu.log import FatalError
+
     opt = AddOption(worker_id=1)
     vals = np.arange(64, dtype=np.float32)
     payload = async_ps._serialize(async_ps.KEYED, 5, opt,
@@ -85,17 +90,15 @@ def test_part_records_reassemble_to_one_apply():
     assert applied == [payload]           # one apply, exact bytes
     assert bus._parts[0] == []
 
-    # out-of-order part (index 1 first) is rejected, buffer stays clean
-    bus._consume(0, parts[1])
-    assert applied == [payload]
-    bus._consume(0, parts[0])             # restart from index 0 works
-    for p in parts[1:]:
-        bus._consume(0, p)
-    assert applied == [payload, payload]
+    # out-of-order part (index 1 first) = broken consecutive-seq invariant
+    with pytest.raises(FatalError):
+        bus._consume(0, parts[1])
+    assert applied == [payload]           # nothing half-applied
 
     # non-PART records pass straight through
+    bus._parts = {}
     bus._consume(0, payload)
-    assert applied == [payload, payload, payload]
+    assert applied == [payload, payload]
 
 
 def test_sparse_filter_compresses_sparse_dense_payload():
