@@ -36,6 +36,31 @@ from ..topology import SERVER_AXIS, WORKER_AXIS
 _ADAGRAD_EPS = 1e-8
 
 
+def _dp_enter(key, tables):
+    """Enter a ``dp_sync="dispatch"`` manual-worker region: advance the
+    replicated key once (the dispatch's global stream), fold the worker
+    index into the local draw key (decorrelated sampling per worker), and
+    mark the table copies worker-varying so local training may diverge
+    until :func:`_dp_exchange`. Returns (local_key, key_out, tables)."""
+    key_out = jax.random.split(key)[0]
+    lkey = jax.random.fold_in(key, jax.lax.axis_index(WORKER_AXIS))
+    varying = tuple(
+        None if a is None
+        else jax.lax.pcast(a, (WORKER_AXIS,), to="varying")
+        for a in tables)
+    return lkey, key_out, varying
+
+
+def _dp_exchange(tables, saved):
+    """ONE summed-delta exchange per dispatch: ``a0 + psum(a - a0)``.
+    Exact for commutative updaters (the Sigma-invariant); the only wire
+    traffic of the dispatch-mode dp data plane (docs/DISTRIBUTED.md
+    "Bytes on the wire")."""
+    return tuple(
+        None if a0 is None else a0 + jax.lax.psum(a - a0, WORKER_AXIS)
+        for a, a0 in zip(tables, saved))
+
+
 @dataclass
 class Word2VecConfig:
     """Mirrors the reference CLI options (``WE/src/util.cpp`` Option)."""
@@ -107,6 +132,25 @@ class Word2VecConfig:
     # that self-limits the reference's sequential loop has no batched
     # equivalent, so the cap plays that role). cap=1 -> pure mean.
     row_update_cap: float = 8.0
+    # Cross-worker exchange cadence for in-mesh data parallelism (worker
+    # axis > 1). The reference never ships a dense table on the wire (its
+    # sync Adds are sparse-filtered row buckets,
+    # ``src/table/sparse_matrix_table.cpp:145-153``); per-batch GSPMD BSP
+    # on replicated tables does — a table-sized allreduce EVERY scan
+    # iteration (43-57% measured overhead, docs/DISTRIBUTED.md).
+    #   "dispatch" — workers train their batch shards LOCALLY within one
+    #                fused dispatch (each sees its own updates immediately,
+    #                peers' at dispatch boundaries — the async-PS staleness
+    #                model, bounded by steps_per_call) and exchange ONE
+    #                summed table delta per dispatch:
+    #                ``w = w0 + psum(w_local - w0)``. Sigma-invariant exact
+    #                for commutative updaters; wire bytes cut ~3*S vs
+    #                per-batch BSP.
+    #   "batch"    — per-batch BSP via GSPMD (exact per-batch freshness at
+    #                S x the wire cost).
+    # Falls back to "batch" when batch_size doesn't divide over the
+    # worker axis (and shared-negative groups).
+    dp_sync: str = "dispatch"
 
 
 def build_unigram_alias(counts: np.ndarray, power: float = 0.75
@@ -295,6 +339,30 @@ class Word2Vec:
 
     def _pairs_to_words(self, pairs: float) -> float:
         return pairs / (self.config.window + 1)
+
+    def _dp_local(self) -> int:
+        """Worker-axis size of the local-accumulation dp exchange (1 = off).
+
+        > 1 means the multi-batch/corpus dispatches run under shard_map
+        with the worker axis MANUAL: each worker trains its batch shard
+        against a local table copy and the dispatch exchanges one summed
+        delta (``dp_sync="dispatch"``). The server axis stays AUTO, so
+        server-sharded tables keep their GSPMD layout inside.
+        """
+        cfg = self.config
+        dp = int(self.input_table.mesh.shape[WORKER_AXIS])
+        if dp <= 1 or cfg.dp_sync != "dispatch":
+            return 1
+        G = max(int(cfg.shared_negatives), 1)
+        if cfg.batch_size % dp != 0 or (cfg.batch_size // dp) % G != 0:
+            if not getattr(self, "_dp_fallback_logged", False):
+                self._dp_fallback_logged = True
+                Log.info(
+                    "dp_sync=dispatch needs batch_size divisible over "
+                    "%d workers (and G=%d groups); falling back to "
+                    "per-batch GSPMD sync", dp, G)
+            return 1
+        return dp
 
     # -- jitted step -------------------------------------------------------
     def _build_step(self):
@@ -571,6 +639,48 @@ class Word2Vec:
                 (centers, contexts, mask))
             return w_in, w_out, g_in, g_out, losses.mean(), key
 
+        dp = self._dp_local()
+
+        def multi_step_local(w_in, w_out, g_in, g_out, centers, contexts,
+                             mask, lr, key):
+            """``dp_sync="dispatch"``: each worker scans its batch shards
+            against a LOCAL table copy (zero collectives in the loop) and
+            the dispatch ends with ONE summed-delta exchange —
+            ``w = w0 + psum(w_local - w0)``. Runs under shard_map with the
+            worker axis manual; the server axis stays auto, so GSPMD still
+            lays the table math out over server shards. Wire bytes per
+            dispatch: 2 tables once, vs (2-3 tables) x steps_per_call for
+            per-batch BSP (docs/DISTRIBUTED.md has the accounting)."""
+            saved = (w_in, w_out, g_in, g_out)
+            lkey, key_out, (w_in, w_out, g_in, g_out) = _dp_enter(key, saved)
+
+            def body(carry, xs):
+                w_in, w_out, g_in, g_out, key = carry
+                c, t, m = xs
+                w_in, w_out, g_in, g_out, loss, key = step(
+                    w_in, w_out, g_in, g_out, c, t, m, lr, key)
+                return (w_in, w_out, g_in, g_out, key), loss
+
+            (w_in, w_out, g_in, g_out, _), losses = jax.lax.scan(
+                body, (w_in, w_out, g_in, g_out, lkey),
+                (centers, contexts, mask))
+
+            w_in, w_out, g_in, g_out = _dp_exchange(
+                (w_in, w_out, g_in, g_out), saved)
+            loss = jax.lax.psum(losses.mean(), WORKER_AXIS) / dp
+            return w_in, w_out, g_in, g_out, loss, key_out
+
+        if dp > 1:
+            sm_batch = (P(None, WORKER_AXIS) if not cfg.cbow
+                        else P(None, WORKER_AXIS, None))
+            multi_step = jax.shard_map(
+                multi_step_local, mesh=mesh,
+                in_specs=(P(), P(), P(), P(),
+                          P(None, WORKER_AXIS), sm_batch, sm_batch,
+                          P(), P()),
+                out_specs=(P(), P(), P(), P(), P(), P()),
+                axis_names={WORKER_AXIS})
+
         multi_batch_sharding = NamedSharding(mesh, P(None, WORKER_AXIS))
         key_sharding = self._key_sharding
         jitted = jax.jit(
@@ -601,21 +711,26 @@ class Word2Vec:
         return self._neg_pool
 
     def _candidate_batch(self, n: int) -> int:
-        """Candidate slab length M for a corpus chunk of ``n`` positions.
+        """GLOBAL candidate slab length M for a corpus chunk of ``n``
+        positions (candidates consumed per fused step, summed over the
+        worker axis — ``dp_sync="dispatch"`` gives each worker its own
+        ``M // dp`` slab on its own arc of the chunk).
 
         Single source of truth for the oversample formula — the device
         sampler and the host-side stream-position bookkeeping must agree.
-        Clamped so ``ext`` slicing (n >= M + 2W) stays in bounds.
+        Clamped so ``ext`` slicing (n >= M_local + 2W) stays in bounds.
         """
         cfg = self.config
         B, W = cfg.batch_size, cfg.window
-        if n < B + 2 * W:
+        dp = self._dp_local()
+        Bl = B // dp
+        if n < Bl + 2 * W:
             Log.fatal(f"corpus chunk ({n} positions) smaller than "
-                      f"batch_size + 2*window ({B + 2 * W}); lower batch_size "
-                      "or load a larger chunk")
-        M = (max(B, int(round(B * cfg.oversample)))
-             if cfg.oversample > 1 else B)
-        return min(M, n - 2 * W)
+                      f"per-worker batch + 2*window ({Bl + 2 * W}); lower "
+                      "batch_size or load a larger chunk")
+        Ml = (max(Bl, int(round(Bl * cfg.oversample)))
+              if cfg.oversample > 1 else Bl)
+        return min(Ml, n - 2 * W) * dp
 
     def _build_corpus_step(self, n_steps: int, M: int):
         """Fused sample+train over a device-resident corpus chunk.
@@ -627,38 +742,47 @@ class Word2Vec:
         dispatch with no per-batch host traffic. This is the TPU-native form
         of the reference's loader-thread + pipelined-trainer overlap
         (``distributed_wordembedding.cpp:199-208``).
+
+        With ``dp_sync="dispatch"`` and worker axis > 1 the whole dispatch
+        runs under shard_map with the worker axis manual: each worker
+        samples its ``M // dp`` candidate slab from its own arc of the
+        cyclic chunk (the in-mesh form of the per-process data partition),
+        trains against a local table copy, and the dispatch ends with ONE
+        summed-delta psum — no per-batch table collectives (the dense
+        grad-table allreduce the reference never pays either; its sync
+        Adds are sparse row buckets, ``src/table/matrix_table.cpp:288-316``).
         """
         cfg = self.config
         W, B = cfg.window, cfg.batch_size
         step = self._raw_step
-
-        # M candidates per step (cheap int-only sampling may overdraw; the
-        # row gather/scatter work is always on exactly B slots)
+        dp = self._dp_local()
         S = n_steps
+        # per-worker candidate slab / batch (dp == 1: the global sizes)
+        Ml, Bl = M // dp, B // dp
         G = max(int(cfg.shared_negatives), 1)
-        draws_per_call = S * (B // G) * cfg.negative
+        draws_per_call = S * (Bl // G) * cfg.negative
         neg_pool = (self._ensure_neg_pool(draws_per_call)
                     if cfg.negative > 0 and cfg.neg_pool_size > 0 else None)
 
         def compact_one(ok, n_valid, *arrays):
-            """Pack the ``ok`` rows of each [M, ...] array into [B, ...].
+            """Pack the ``ok`` rows of each [Ml, ...] array into [Bl, ...].
 
             Linear-time alternative to sorting (TPU sorts are slow): each
             surviving row's destination is its prefix-count rank; overflow
             and rejected rows scatter out of bounds and are dropped.
             """
             rank = jnp.cumsum(ok.astype(jnp.int32)) - 1
-            dest = jnp.where(ok & (rank < B), rank, B)
+            dest = jnp.where(ok & (rank < Bl), rank, Bl)
             packed = tuple(
-                jnp.zeros((B,) + a.shape[1:], a.dtype).at[dest].set(
+                jnp.zeros((Bl,) + a.shape[1:], a.dtype).at[dest].set(
                     a, mode="drop")
                 for a in arrays)
-            return packed + (jnp.arange(B) < n_valid,)
+            return packed + (jnp.arange(Bl) < n_valid,)
 
         def fused(w_in, w_out, g_in, g_out, ext_ids, ext_sents, ext_disc,
                   lr, key, start0):
             """Sequential corpus streaming (the reference reads sentences in
-            order — ``WE/src/reader.cpp``): each step consumes the next M
+            order — ``WE/src/reader.cpp``): each step consumes the next Ml
             corpus positions as centers, so every word lookup is a contiguous
             slice instead of a scalar gather. The per-pair window offset is
             resolved by selecting among the 2W statically-shifted copies of
@@ -667,24 +791,32 @@ class Word2Vec:
             """
             n = ext_ids.shape[0] - M - 2 * W
 
+            saved = (w_in, w_out, g_in, g_out)
+            if dp > 1:
+                key, key_out, (w_in, w_out, g_in, g_out) = _dp_enter(
+                    key, saved)
+                # each worker streams its own arc of the cyclic chunk
+                widx = jax.lax.axis_index(WORKER_AXIS)
+                start0 = (start0 + widx * (n // dp)) % n
+
             # ---- bulk RNG: ONE vectorized draw for all S batches ----
             key, k1, k2, k3, k4, k5 = jax.random.split(key, 6)
-            shrink = jax.random.randint(k1, (S, M), 1, W + 1)
+            shrink = jax.random.randint(k1, (S, Ml), 1, W + 1)
             if not cfg.cbow:
-                dmag = jnp.minimum(jax.random.randint(k2, (S, M), 1, W + 1),
+                dmag = jnp.minimum(jax.random.randint(k2, (S, Ml), 1, W + 1),
                                    shrink)
-                sign = jnp.where(jax.random.bernoulli(k3, 0.5, (S, M)), 1, -1)
+                sign = jnp.where(jax.random.bernoulli(k3, 0.5, (S, Ml)), 1, -1)
                 # window offset -W..W (excl 0) → shifted-copy index 0..2W-1
                 dsel = jnp.where(sign > 0, W + dmag - 1, W - dmag)
-                u_ctx = jax.random.uniform(k5, (S, M))
+                u_ctx = jax.random.uniform(k5, (S, Ml))
             else:
                 dsel = None
-                u_ctx = jax.random.uniform(k5, (S, M, 2 * W))
-            u_center = jax.random.uniform(k4, (S, M))
+                u_ctx = jax.random.uniform(k5, (S, Ml, 2 * W))
+            u_center = jax.random.uniform(k4, (S, Ml))
             negs = None
             if cfg.negative > 0:
                 key, kn = jax.random.split(key)
-                n_rows = B // G
+                n_rows = Bl // G
                 if neg_pool is not None:
                     negs = pool_negatives(kn, neg_pool,
                                           (S, n_rows, cfg.negative))
@@ -692,21 +824,21 @@ class Word2Vec:
                     negs = sample_negatives(kn, self._packed_alias,
                                             (S, n_rows, cfg.negative))
 
-            starts = (start0 + jnp.arange(S, dtype=jnp.int32) * M) % n
+            starts = (start0 + jnp.arange(S, dtype=jnp.int32) * Ml) % n
 
             offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
 
             def slab_views(start):
                 """[2W+1 views of the slab] — static slices of one dynamic
                 slice, so the only data movement is contiguous."""
-                buf = jax.lax.dynamic_slice(ext_ids, (start,), (M + 2 * W,))
+                buf = jax.lax.dynamic_slice(ext_ids, (start,), (Ml + 2 * W,))
                 sbuf = jax.lax.dynamic_slice(ext_sents, (start,),
-                                             (M + 2 * W,))
+                                             (Ml + 2 * W,))
                 dbuf = jax.lax.dynamic_slice(ext_disc, (start,),
-                                             (M + 2 * W,))
-                ctr = (buf[W:W + M], sbuf[W:W + M], dbuf[W:W + M])
-                shifted = [(buf[W + d:W + d + M], sbuf[W + d:W + d + M],
-                            dbuf[W + d:W + d + M]) for d in offsets]
+                                             (Ml + 2 * W,))
+                ctr = (buf[W:W + Ml], sbuf[W:W + Ml], dbuf[W:W + Ml])
+                shifted = [(buf[W + d:W + d + Ml], sbuf[W + d:W + d + Ml],
+                            dbuf[W + d:W + d + Ml]) for d in offsets]
                 return ctr, shifted
 
             def select(shifted_vals, dsel_row):
@@ -725,8 +857,8 @@ class Word2Vec:
                 valid = (xsent == csent)
                 keep = (u_center >= cdisc) & (u_ctx >= xdisc)
                 ok = valid & keep
-                if M > B:
-                    n_valid = jnp.minimum(ok.sum(), B)
+                if Ml > Bl:
+                    n_valid = jnp.minimum(ok.sum(), Bl)
                     centers, contexts, ok = compact_one(
                         ok, n_valid, centers, contexts)
                 return centers, contexts, ok.astype(jnp.float32)
@@ -741,9 +873,9 @@ class Word2Vec:
                 valid = in_window & (xsent == csent[:, None])
                 keep = (u_center >= cdisc)[:, None] & (u_ctx >= xdisc)
                 ok = valid & keep
-                if M > B:
+                if Ml > Bl:
                     ex_ok = ok.any(axis=1)
-                    n_valid = jnp.minimum(ex_ok.sum(), B)
+                    n_valid = jnp.minimum(ex_ok.sum(), Bl)
                     centers, contexts, ok, ex_packed = compact_one(
                         ex_ok, n_valid, centers, contexts, ok)
                     ok = ok & ex_packed[:, None]
@@ -777,9 +909,22 @@ class Word2Vec:
 
             (w_in, w_out, g_in, g_out, key), (losses, counts) = jax.lax.scan(
                 body_wrap, (w_in, w_out, g_in, g_out, key), xs)
-            return (w_in, w_out, g_in, g_out, losses.mean(), counts.sum(),
-                    key)
+            loss, count = losses.mean(), counts.sum()
+            if dp > 1:
+                w_in, w_out, g_in, g_out = _dp_exchange(
+                    (w_in, w_out, g_in, g_out), saved)
+                loss = jax.lax.psum(loss, WORKER_AXIS) / dp
+                count = jax.lax.psum(count, WORKER_AXIS)
+                key = key_out
+            return (w_in, w_out, g_in, g_out, loss, count, key)
 
+        if dp > 1:
+            from jax.sharding import PartitionSpec as P
+
+            fused = jax.shard_map(
+                fused, mesh=self.input_table.mesh,
+                in_specs=(P(),) * 10, out_specs=(P(),) * 7,
+                axis_names={WORKER_AXIS})
         return jax.jit(
             fused,
             donate_argnums=(0, 1, 2, 3),
@@ -894,7 +1039,11 @@ class Word2Vec:
         p_eff = eff / max(eff.sum(), 1e-12)
         w75 = counts ** 0.75
         p_neg = w75 / max(w75.sum(), 1e-12)
-        B, K = cfg.batch_size, cfg.negative
+        # the table application unit is the PER-WORKER batch: with
+        # dp_sync="dispatch" each worker applies its own Bl-sized batches
+        # locally, so the expected colliding grads per application scale
+        # with Bl, not the global batch
+        B, K = cfg.batch_size // self._dp_local(), cfg.negative
         e_in = B * p_eff                      # sg centers (sg-only mode)
         e_out = B * p_eff + B * K * p_neg     # targets + negatives
 
@@ -937,7 +1086,11 @@ class Word2Vec:
         g_in = self._g_in if cfg.use_adagrad else None
         g_out = self._g_out if cfg.use_adagrad else None
         start0 = self._stream_pos % n
-        self._stream_pos = (start0 + n_steps * M) % n
+        # the cursor is a PER-WORKER arc position: each of the dp workers
+        # consumes n_steps * (M // dp) positions of its own arc per
+        # dispatch (the in-jit widx*(n//dp) offsets place the arcs), so
+        # advancing by the global M would skip/alias corpus coverage
+        self._stream_pos = (start0 + n_steps * (M // self._dp_local())) % n
         # read-and-rebind of table state stays under BOTH table locks so a
         # concurrent async-PS drain apply can never land between the read
         # and the rebind (it would be silently overwritten)

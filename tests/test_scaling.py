@@ -1,15 +1,22 @@
-"""Scaling-harness floor (VERDICT r2 item 2): the dp weak-scaling sweep
-runs, its efficiency accounting is sane, and the timeshare-normalized
-efficiency clears a floor on the virtual mesh.
+"""Scaling-harness floors (VERDICT r2 item 2 / r3 items 1-2).
 
-The floor is deliberately loose: virtual CPU devices timeshare
-``os.cpu_count()`` real cores, so the normalized number still contains
-the dense grad-table allreduce cost through host memory (see
-docs/DISTRIBUTED.md "Measured" section). On real chips the same sweep
-must clear the BASELINE.json bar (>= 0.9 at 8->64); here the test
-guards the methodology and catches regressions that would tank even the
-rehearsal number (e.g. a sharding change that re-replicates the batch or
-adds a per-step host sync).
+Two guards:
+
+* the REAL-shape sweep (the docs/DISTRIBUTED.md methodology: batch
+  2048/device, vocab 20k, 25-batch dispatches) must clear an eff_norm
+  floor at dp=8 — this is the round-4 headline claim (the dispatch-mode
+  delta exchange lifted it from 0.43 to ~0.7; the floor holds margin for
+  host noise). A regression here means the dp data plane re-grew
+  per-batch table collectives or the exchange got more expensive.
+* the quick-shape sweep stays sane (finite, positive, dp=1 == 1.0) and
+  its >1 artifacts are ANNOTATED, not clamped (`saturated` flag) — the
+  honesty contract for MULTICHIP_r*.json.
+
+The virtual CPU devices timeshare ``os.cpu_count()`` cores; eff_norm
+charges the timesharing to the machine and leaves sharding/collective/
+exchange overhead — the thing the framework controls — in the
+measurement. On real chips the same sweep must clear the BASELINE.json
+bar (>= 0.9 at 8->64).
 """
 
 import os
@@ -18,17 +25,30 @@ import numpy as np
 import pytest
 
 
-def test_w2v_weak_scaling_efficiency_floor():
-    from tools.scaling_bench import quick_sweep
+def test_w2v_real_shape_efficiency_floor():
+    from tools.scaling_bench import dryrun_sweep
 
-    rows = quick_sweep([1, 8])
+    rows = dryrun_sweep([1, 8])
     by_dp = {r["dp"]: r for r in rows}
     assert by_dp[1]["eff_norm"] == 1.0
     for r in rows:
         assert np.isfinite(r["pairs_per_sec"]) and r["pairs_per_sec"] > 0
+    # round-4 floor: the delta exchange holds dp=8 sync overhead under
+    # ~45% at the real shape (measured ~29%; r3's per-batch BSP was 57%)
+    assert by_dp[8]["eff_norm"] >= 0.55, rows
+
+
+def test_quick_sweep_sane_and_saturation_annotated():
+    from tools.scaling_bench import quick_sweep
+
+    rows = quick_sweep([1, 8])
+    by_dp = {r["dp"]: r for r in rows}
+    assert by_dp[1]["eff_norm"] == 1.0 and not by_dp[1]["saturated"]
+    for r in rows:
+        assert np.isfinite(r["pairs_per_sec"]) and r["pairs_per_sec"] > 0
         assert 0.0 < r["eff_raw"] <= 1.0 + 1e-9
-    # floor: sharding/collective overhead must not exceed ~3x ideal
-    assert by_dp[8]["eff_norm"] >= 0.3, rows
+        # the annotation contract: > 1 values carry the saturated flag
+        assert r["saturated"] == (r["eff_norm"] > 1.0 + 1e-9)
 
 
 def test_collective_sweep_bandwidths_sane():

@@ -509,3 +509,107 @@ def test_row_mean_static_matches_realized(mv_session):
     assert np.isfinite(stat).all() and np.isfinite(real).all()
     assert stat[-1] < stat[0]                  # both descend
     assert abs(stat[-1] - real[-1]) < 0.3, (stat[-1], real[-1])
+
+
+def test_dp_dispatch_exchange_exact_vs_sequential_oracle(tmp_path):
+    """dp_sync="dispatch" contract: the multi-batch dispatch on a dp-worker
+    mesh equals w0 + sum over workers of that worker's SEQUENTIAL local
+    deltas (each worker sees its own updates immediately, peers' at the
+    dispatch boundary). HS mode keeps the step RNG-free, so the per-worker
+    oracle is bit-reproducible; the only tolerance is psum summation order.
+    """
+    import jax.numpy as jnp
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.word2vec import (HuffmanCodes, Word2Vec,
+                                                Word2VecConfig, build_huffman)
+    from multiverso_tpu.runtime import Session
+
+    vocab, dim, dp, S, B = 32, 8, 4, 3, 16
+    counts = np.arange(1, vocab + 1, dtype=np.float64)
+    huff = build_huffman(counts)
+    rng = np.random.default_rng(11)
+    centers = rng.integers(0, vocab, (S, B)).astype(np.int32)
+    contexts = rng.integers(0, vocab, (S, B)).astype(np.int32)
+    mask = np.ones((S, B), np.float32)
+
+    def train(mesh_shape, dp_sync, c, t, m):
+        Session._instance = None
+        mv.set_flag("mesh_shape", mesh_shape)
+        mv.init(["dpx", "-log_level=error"])
+        try:
+            cfg = Word2VecConfig(vocab_size=vocab, embedding_size=dim,
+                                 negative=0, hs=True, batch_size=c.shape[1],
+                                 init_lr=0.1, seed=5, dp_sync=dp_sync)
+            w_in = mv.create_table("matrix", vocab, dim)
+            w_out = mv.create_table("matrix", vocab, dim)
+            w_in.add_rows(np.arange(vocab, dtype=np.int32),
+                          rng0.standard_normal((vocab, dim)).astype(np.float32))
+            model = Word2Vec(cfg, w_in, w_out, counts=counts, huffman=huff)
+            model.train_batches(c, t, m)
+            return np.asarray(w_in.get()), np.asarray(w_out.get())
+        finally:
+            mv.shutdown()
+            mv.set_flag("mesh_shape", "")
+            Session._instance = None
+
+    # deterministic shared init for every run
+    rng0 = np.random.default_rng(99)
+    got_in, got_out = train(f"{dp},1", "dispatch", centers, contexts, mask)
+
+    # oracle: each worker trains its batch COLUMNS shard sequentially on a
+    # 1-worker mesh; deltas sum onto the shared init
+    rng0 = np.random.default_rng(99)
+    w0_in = w0_out = None
+    tot_in = tot_out = 0.0
+    Bl = B // dp
+    for w in range(dp):
+        rng0 = np.random.default_rng(99)
+        sl = slice(w * Bl, (w + 1) * Bl)
+        fin, fout = train("1,1", "dispatch",
+                          centers[:, sl], contexts[:, sl], mask[:, sl])
+        if w0_in is None:
+            rng0 = np.random.default_rng(99)
+            w0_in = rng0.standard_normal((vocab, dim)).astype(np.float32)
+            w0_out = np.zeros((vocab, dim), np.float32)
+        tot_in = tot_in + (fin - w0_in)
+        tot_out = tot_out + (fout - w0_out)
+
+    np.testing.assert_allclose(got_in, w0_in + tot_in, rtol=0, atol=2e-5)
+    np.testing.assert_allclose(got_out, w0_out + tot_out, rtol=0, atol=2e-5)
+
+
+def test_dp_corpus_stream_advances_per_worker_arc(tmp_path):
+    """The stream cursor is a PER-WORKER arc position under
+    dp_sync="dispatch": one dispatch consumes n_steps * (M // dp)
+    positions of each worker's arc, not n_steps * M — advancing by the
+    global M would skip/alias corpus coverage (r4 review finding)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
+    from multiverso_tpu.runtime import Session
+
+    Session._instance = None
+    mv.set_flag("mesh_shape", "2,4")
+    mv.init(["dparc", "-log_level=error"])
+    try:
+        vocab, dim = 64, 8
+        cfg = Word2VecConfig(vocab_size=vocab, embedding_size=dim,
+                             negative=2, batch_size=32, window=2,
+                             oversample=2.0, seed=5)
+        w_in = mv.create_table("matrix", vocab, dim, init_value="random")
+        w_out = mv.create_table("matrix", vocab, dim)
+        counts = np.ones(vocab, np.float64)
+        model = Word2Vec(cfg, w_in, w_out, counts=counts)
+        assert model._dp_local() == 2
+        n = 4096
+        rng = np.random.default_rng(3)
+        ids = rng.integers(0, vocab, n).astype(np.int32)
+        model.load_corpus_chunk(ids, np.zeros(n, np.int32))
+        M = model._candidate_batch(n)
+        assert M % 2 == 0
+        loss, count = model.train_device_steps(3)
+        assert np.isfinite(float(loss))
+        assert model._stream_pos == 3 * (M // 2)
+    finally:
+        mv.shutdown()
+        mv.set_flag("mesh_shape", "")
+        Session._instance = None

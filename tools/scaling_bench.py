@@ -131,13 +131,18 @@ def collective_sweep(dps, payload_mb: float = 4.0, repeats: int = 5,
 
 
 def w2v_weak_scaling(dps, per_dev_batch: int = 2048, vocab: int = 20000,
-                     dim: int = 128, steps: int = 4, repeats: int = 5):
+                     dim: int = 128, steps: int = 25, repeats: int = 5,
+                     dp_sync: str = "dispatch"):
     """Weak-scaling sweep of the REAL jitted word2vec train step.
 
     Fixed per-device batch; the batch axis is sharded over the mesh
-    ``worker`` axis and the replicated tables force XLA to insert the dp
-    gradient-sync collectives — the exact program a dp pod runs
-    (BASELINE methodology step 1, per-step form).
+    ``worker`` axis — the exact program a dp pod runs (BASELINE
+    methodology step 1). ``steps`` is the dispatch cadence: the default
+    25 matches real training (bench.py / the app driver fuse 25 batches
+    per dispatch), which is what amortises the per-dispatch delta
+    exchange of ``dp_sync="dispatch"``; pass 1 to measure the unamortised
+    per-batch cost, or ``dp_sync="batch"`` for the per-batch GSPMD BSP
+    program (a table-sized allreduce every scan iteration).
     """
     import multiverso_tpu as mv
     from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
@@ -152,7 +157,8 @@ def w2v_weak_scaling(dps, per_dev_batch: int = 2048, vocab: int = 20000,
             batch = per_dev_batch * dp
             cfg = Word2VecConfig(vocab_size=vocab, embedding_size=dim,
                                  negative=5, batch_size=batch,
-                                 steps_per_call=steps, seed=3)
+                                 steps_per_call=steps, seed=3,
+                                 dp_sync=dp_sync)
             w_in = mv.create_table("matrix", vocab, dim, init_value="random")
             w_out = mv.create_table("matrix", vocab, dim)
             model = Word2Vec(cfg, w_in, w_out,
@@ -168,7 +174,8 @@ def w2v_weak_scaling(dps, per_dev_batch: int = 2048, vocab: int = 20000,
             run()                            # compile
             t = _best_of(run, repeats)
             rows.append({
-                "dp": dp, "batch": batch, "time_ms": t * 1e3,
+                "dp": dp, "batch": batch, "steps": steps,
+                "time_ms": t * 1e3,
                 "pairs_per_sec": steps * batch / t,
             })
         finally:
@@ -191,20 +198,35 @@ def efficiencies(rows, cores: int):
         dp = r["dp"]
         raw = t1 / r["time_ms"]
         norm = dp * t1 / (min(dp, cores) * r["time_ms"])
-        # measurement noise can push either ratio past 1 on fast hosts
-        out.append({**r, "eff_raw": min(raw, 1.0), "eff_norm": min(norm, 1.0),
+        # UNclamped: > 1 means the timeshare model under-charges the
+        # machine at this shape (sublinear tiny-shape timing) — annotate
+        # so readers discount it rather than mistaking it for headroom
+        out.append({**r, "eff_raw": raw, "eff_norm": norm,
+                    "saturated": bool(norm > 1.0 + 1e-9),
                     "overhead_frac": max(0.0, 1.0 - norm)})
     return out
 
 
 def quick_sweep(dps):
     """The ONE quick-shape rehearsal parameterization — shared by the
-    dryrun (`__graft_entry__.dryrun_multichip`), the test floor
-    (`tests/test_scaling.py`) and `run_sweep(quick=True)`, so all three
-    measure the same program."""
+    test floor (`tests/test_scaling.py`) and `run_sweep(quick=True)`, so
+    both measure the same program (real dispatch cadence, tiny shapes)."""
     return efficiencies(
         w2v_weak_scaling(dps, per_dev_batch=512, vocab=4096, dim=64,
-                         steps=4, repeats=3),
+                         steps=25, repeats=3),
+        os.cpu_count() or 1)
+
+
+def dryrun_sweep(dps):
+    """The REAL-shape sweep the dryrun embeds in MULTICHIP_r*.json —
+    same shape + cadence as the docs/DISTRIBUTED.md table (batch 2048/dev,
+    vocab 20k, dim 128, 25-batch dispatches), reduced repeats so the
+    dryrun stays bounded. This is the honest number: the quick shapes
+    saturate the timeshare normalisation (eff_norm > 1 artifacts) and say
+    nothing about the exchange cost at real table sizes."""
+    return efficiencies(
+        w2v_weak_scaling(dps, per_dev_batch=2048, vocab=20000, dim=128,
+                         steps=25, repeats=2),
         os.cpu_count() or 1)
 
 
@@ -213,15 +235,26 @@ def run_sweep(n_devices: int = 8, quick: bool = False):
     cores = os.cpu_count() or 1
     if quick:
         w2v = quick_sweep(dps)
+        cadence = []
     else:
         w2v = efficiencies(
             w2v_weak_scaling(dps, per_dev_batch=2048, vocab=20000,
                              dim=128, repeats=5),
             cores)
+        # dispatch-cadence amortisation at the widest dp: the per-dispatch
+        # delta exchange is a fixed cost, so efficiency is a function of
+        # steps_per_call (real training runs 25)
+        top = max(dps)
+        cadence = []
+        for steps in (1, 4, 25):
+            rows = w2v_weak_scaling([1, top], per_dev_batch=2048,
+                                    vocab=20000, dim=128, steps=steps,
+                                    repeats=3)
+            cadence.append(efficiencies(rows, cores)[-1])
     coll = collective_sweep(dps, payload_mb=1.0 if quick else 4.0,
                             repeats=3 if quick else 5)
     return {"cores": cores, "devices": n_devices, "w2v": w2v,
-            "collectives": coll}
+            "cadence": cadence, "collectives": coll}
 
 
 _BEGIN = "<!-- scaling_bench:begin -->"
@@ -241,19 +274,44 @@ def render_markdown(res) -> str:
         "charges that to the machine and isolates the framework's",
         "sharding + collective overhead — the quantity the ≥90%",
         "8→64-chip target is about (each real chip has its own compute).",
+        "Values > 1 are reported unclamped and flagged `(sat)`: they mean",
+        "the timeshare model under-charges the machine at that shape, not",
+        "that the framework beat ideal.",
         "",
-        "word2vec jitted train step, fixed per-device batch "
-        "(weak scaling):",
+        "word2vec jitted train step, `dp_sync=\"dispatch\"` (workers train",
+        "locally, ONE summed-delta psum per dispatch), fixed per-device",
+        "batch, real dispatch cadence (weak scaling):",
         "",
-        "| dp | global batch | step ms | pairs/s | eff_raw | eff_norm | "
-        "sync overhead |",
-        "|---|---|---|---|---|---|---|",
+        "| dp | global batch | steps/dispatch | dispatch ms | pairs/s "
+        "| eff_raw | eff_norm | sync overhead |",
+        "|---|---|---|---|---|---|---|---|",
     ]
+
+    def _eff(r, k):
+        return f"{r[k]:.2f}" + (" (sat)" if r.get("saturated") else "")
+
     for r in res["w2v"]:
         lines.append(
-            f"| {r['dp']} | {r['batch']} | {r['time_ms']:.1f} "
+            f"| {r['dp']} | {r['batch']} | {r.get('steps', '?')} "
+            f"| {r['time_ms']:.1f} "
             f"| {r['pairs_per_sec']:.3g} | {r['eff_raw']:.2f} "
-            f"| {r['eff_norm']:.2f} | {r['overhead_frac'] * 100:.0f}% |")
+            f"| {_eff(r, 'eff_norm')} | {r['overhead_frac'] * 100:.0f}% |")
+    if res.get("cadence"):
+        top = res["cadence"][0]["dp"]
+        lines += [
+            "",
+            f"Dispatch-cadence amortisation at dp={top}: the delta "
+            "exchange is a fixed per-dispatch cost, so efficiency is a "
+            "function of `steps_per_call` (real training fuses 25 "
+            "batches/dispatch — bench.py and the app driver):",
+            "",
+            "| steps/dispatch | dispatch ms | eff_norm | sync overhead |",
+            "|---|---|---|---|",
+        ]
+        for r in res["cadence"]:
+            lines.append(
+                f"| {r['steps']} | {r['time_ms']:.1f} | {_eff(r, 'eff_norm')} "
+                f"| {r['overhead_frac'] * 100:.0f}% |")
     lines += [
         "",
         "Raw collectives, fixed per-device payload "
@@ -267,16 +325,31 @@ def render_markdown(res) -> str:
                      f"| {r['algbw_gbps']:.2f} |")
     lines += [
         "",
-        "The dominant overhead term is the dense grad-table allreduce the "
-        "replicated-table dp program implies (2 tables x vocab x dim x 4 B "
-        "per fused step — tens of MB/call at these shapes) squeezed "
-        "through a one-core memcpy at the psum rates above; the sparse "
-        "path (`get_dirty_rows` keyed publication) exists precisely to cut "
-        "that term, and on-chip ICI moves it at 2-3 orders of magnitude "
-        "higher bandwidth. On real v5e the same sweep runs unchanged per "
-        "chip count (methodology steps 1-2 above); the CPU-mesh numbers "
-        "validate that the framework side of the loop (sharding, program, "
-        "collectives) holds its overhead budget before pod time is spent.",
+        "#### Bytes on the wire (per device, per 25-batch dispatch, "
+        "real shape: V=20k, D=128, f32, ring-collective cost "
+        "`2(dp-1)/dp · bytes`)",
+        "",
+        "| dp data plane | what moves | bytes @ dp=8 |",
+        "|---|---|---|",
+        "| per-batch BSP (`dp_sync=\"batch\"`, r3) | 2-3 table-sized "
+        "allreduces EVERY scan iteration: `S × ~2.5 × V·D·4 × 2(dp-1)/dp` "
+        "| ~1.1 GB |",
+        "| delta exchange (`dp_sync=\"dispatch\"`, r4 default) | ONE fused "
+        "allreduce of 2 table deltas per dispatch: `2 × V·D·4 × 2(dp-1)/dp` "
+        "| ~36 MB |",
+        "| keyed rows (async bus, cross-process) | touched rows only: "
+        "`S × N·(D+1)·4` per publisher | ~29 MB |",
+        "",
+        "The r4 step compiles to exactly one `all-reduce` op "
+        "(f32[V,D] × 2 + loss — verified in the dp=8 HLO); the reference "
+        "never ships a dense table either (sparse-filtered row-bucket "
+        "Adds, `src/table/sparse_matrix_table.cpp:145-153`). What remains "
+        "in `sync overhead` above is the exchange's table-shaped "
+        "arithmetic (delta subtract/add + the psum memcpy) serialised "
+        "through this host's single core — on a real pod that arithmetic "
+        "is parallel per chip and the wire cost is ~36 MB over ICI "
+        "(sub-ms at v5e bandwidths). On real v5e the same sweep runs "
+        "unchanged per chip count (methodology steps 1-2 above).",
         _END,
     ]
     return "\n".join(lines)
