@@ -210,5 +210,13 @@ define_int("async_max_record_kb", 1024,
 define_int("async_max_inflight_mb", 64,
            "async PS: publisher backpressure watermark — publish blocks "
            "while un-acked published bytes exceed this")
+define_bool("async_p2p", True,
+            "async PS: payload bytes ride direct per-pair TCP sockets "
+            "(the reference's p2p Isend/DEALER data plane); false = "
+            "funnel payloads through the coordination-service KV")
+define_float("failure_timeout_s", 0.0,
+             "declare a peer dead after this many seconds of missed "
+             "heartbeats and keep training without it (async bus "
+             "survivor mode); 0 disables the watchdog")
 define_string("log_file", "", "optional log sink file")
 define_string("log_level", "info", "debug|info|error|fatal")

@@ -939,14 +939,34 @@ class Word2Vec:
         lr = jnp.float32(self.current_lr())
         g_in = self._g_in if cfg.use_adagrad else None
         g_out = self._g_out if cfg.use_adagrad else None
+        batch = (jnp.asarray(centers, jnp.int32),
+                 jnp.asarray(contexts, jnp.int32),
+                 jnp.asarray(mask, jnp.float32))
+        import jax as _jax
+
+        if _jax.process_count() > 1 and len(
+                self.input_table.mesh.devices.flat) > len(
+                _jax.local_devices()):
+            # multi-process SPMD (the worker axis spans processes): each
+            # process passes ITS batch shard; assemble the global array
+            # from the per-process local data (a plain device_put cannot
+            # target non-addressable shards). Global batch = local x P.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self.input_table.mesh
+            spec = (NamedSharding(mesh, P(None, WORKER_AXIS))
+                    if batch[0].ndim >= 2
+                    else NamedSharding(mesh, P(WORKER_AXIS)))
+            batch = tuple(
+                _jax.make_array_from_process_local_data(
+                    NamedSharding(mesh, P(*(spec.spec[:a.ndim]))),
+                    np.asarray(a))
+                for a in batch)
         with self.input_table._lock, self.output_table._lock:
             (self.input_table._data, self.output_table._data,
              g_in, g_out, loss, self._key) = step_fn(
                 self.input_table._data, self.output_table._data,
-                g_in, g_out,
-                jnp.asarray(centers, jnp.int32),
-                jnp.asarray(contexts, jnp.int32),
-                jnp.asarray(mask, jnp.float32), lr, self._key)
+                g_in, g_out, *batch, lr, self._key)
         if cfg.use_adagrad:
             self._g_in, self._g_out = g_in, g_out
         self._words_trained += n_words

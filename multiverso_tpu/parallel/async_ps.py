@@ -157,6 +157,49 @@ class AsyncDeltaBus:
             int(config.get_flag("async_max_record_kb")), 64) << 10
         self._max_inflight = max(
             int(config.get_flag("async_max_inflight_mb")), 1) << 20
+        # ranks declared dead (FailureDetector -> mark_dead): excluded from
+        # the ack quorum and the drain targets so survivors keep training.
+        # Mutated WITHOUT _pub_lock (GIL-atomic set ops) — a backpressure-
+        # blocked publisher HOLDS _pub_lock, and the whole point of the
+        # declaration is to release that wait.
+        self._dead: set = set()
+        # survivor mode active? (drain's KV dead-union costs P-1 RPCs per
+        # quiesce; skip it entirely when nothing can ever be declared dead)
+        self._survivor_mode = float(
+            config.get_flag("failure_timeout_s")) > 0
+        self._p2p = None
+        if config.get_flag("async_p2p"):
+            try:
+                from .p2p import P2PTransport
+
+                self._p2p = P2PTransport(self._rank, self._size, client)
+            except Exception as exc:
+                Log.error("async PS: p2p transport unavailable (%s)", exc)
+            # the payload plane must be AGREED: one rank silently falling
+            # back to KV while peers publish over sockets splits the bus
+            # (its records unread by p2p consumers and vice versa). Each
+            # rank publishes its outcome; everyone ANDs them.
+            # allow_overwrite: the KV outlives the Session, so a restarted
+            # bus in the same process-group lifetime re-publishes its vote
+            self._client.key_value_set(
+                f"mvps/p2p/{self._rank}", "1" if self._p2p else "0",
+                allow_overwrite=True)
+            all_ok = self._p2p is not None
+            for r in range(self._size):
+                if r == self._rank:
+                    continue
+                try:
+                    ok = self._client.blocking_key_value_get(
+                        f"mvps/p2p/{r}", 120_000)
+                except Exception as exc:
+                    Log.fatal(f"async PS: no p2p handshake from rank {r}: "
+                              f"{exc}")
+                all_ok = all_ok and str(ok) == "1"
+            if not all_ok and self._p2p is not None:
+                Log.error("async PS: a peer lacks p2p; whole group falls "
+                          "back to KV payloads")
+                self._p2p.stop()
+                self._p2p = None
         # (seq, nbytes) of own records not yet acked by all consumers;
         # drives backpressure and ack-key GC (guarded by _pub_lock)
         self._outstanding: Deque[Tuple[int, int]] = collections.deque()
@@ -215,6 +258,8 @@ class AsyncDeltaBus:
             # module-level _consumed counters
             self._stop.set()
             self._thread.join(timeout=30)
+            if self._p2p is not None:
+                self._p2p.stop()
             with _state_lock:
                 if self._thread.is_alive():
                     Log.error("async PS: drain thread failed to stop in "
@@ -242,7 +287,9 @@ class AsyncDeltaBus:
         deadlock, r3). Caller holds ``_pub_lock``."""
         while self._outstanding:
             seq, nbytes = self._outstanding[0]
-            if self._acks_for(seq) < self._size - 1:
+            # dead peers leave the quorum; a peer that acked before dying
+            # only over-satisfies the check
+            if self._acks_for(seq) < self._size - 1 - len(self._dead):
                 return
             # recursive: also removes the nested ack key
             self._client.key_value_delete(f"mvps/{self._rank}/{seq}")
@@ -289,7 +336,15 @@ class AsyncDeltaBus:
             time.sleep(self._interval)
             self._reap_acks()
         seq = _published
-        self._client.key_value_set_bytes(f"mvps/{self._rank}/{seq}", payload)
+        if self._p2p is not None:
+            # payload rides the direct sockets; only the counter/acks stay
+            # on the KV control plane. A consumer may observe the counter
+            # before its frame lands — poll_once simply retries until the
+            # in-order inbox head matches.
+            self._p2p.send(seq, payload)
+        else:
+            self._client.key_value_set_bytes(
+                f"mvps/{self._rank}/{seq}", payload)
         _published = seq + 1
         # counter bump AFTER the payload is visible: readers never see
         # a sequence number without its record
@@ -307,7 +362,10 @@ class AsyncDeltaBus:
         self._mon_pub.begin()
         with self._pub_lock:
             maxb = self._max_record
-            if len(payload) <= maxb:
+            if self._p2p is not None or len(payload) <= maxb:
+                # direct sockets have no gRPC message-size cap: one frame
+                # per logical record (chunking would only add copies and
+                # per-part counter/ack RPCs — measured 5x throughput cost)
                 self._put_record(payload)
             else:
                 n_parts = -(-len(payload) // maxb)
@@ -381,14 +439,19 @@ class AsyncDeltaBus:
         applied = 0
         with self._drain_lock:
             for r in range(self._size):
-                if r == self._rank:
+                if r == self._rank or r in self._dead:
                     continue
                 n = self._peer_count(r)
                 while _consumed[r] < n:
                     seq = _consumed[r]
                     key = f"mvps/{r}/{seq}"
-                    data = self._client.blocking_key_value_get_bytes(
-                        key, 60_000)
+                    if self._p2p is not None:
+                        data = self._p2p.pop_ready(r, seq)
+                        if data is None:
+                            break      # frame still in flight; next poll
+                    else:
+                        data = self._client.blocking_key_value_get_bytes(
+                            key, 60_000)
                     self._consume(r, data)
                     with _state_lock:
                         _consumed[r] = seq + 1
@@ -472,27 +535,110 @@ class AsyncDeltaBus:
             "apply_lat_avg_ms": self._mon_lat.average_ms(),
         }
 
+    # -- failure handling --------------------------------------------------
+    def mark_dead(self, ranks) -> None:
+        """FailureDetector action hook: survivors keep training.
+
+        A declared-dead rank is (a) dropped from the ack quorum, releasing
+        any backpressure debt its silence pinned, (b) dropped from the
+        drain/poll targets, and (c) cut from the p2p fan-out. The
+        declaration is published to the KV so peers that haven't noticed
+        yet converge on the same live set before the next drain barrier.
+
+        Deliberately does NOT take ``_pub_lock``: a backpressure-blocked
+        publisher HOLDS that lock, and this call is what lets its next
+        ``_reap_acks`` poll pass. Consistency note (documented contract):
+        the dead rank's final in-flight records may have reached some
+        survivors and not others — bounded by the in-flight watermark,
+        exactly the records the reference's async PS also loses when a
+        worker dies mid-send (``src/server.cpp:36-60`` has no liveness
+        coupling either).
+        """
+        ranks = {int(r) for r in ranks} - {self._rank}
+        new = ranks - self._dead
+        if not new:
+            return
+        self._dead |= new
+        for r in new:
+            try:
+                self._client.key_value_set(f"mvps/dead/{r}", "1",
+                                           allow_overwrite=True)
+            except Exception:
+                pass    # best effort; peers' own detectors still fire
+        if self._p2p is not None:
+            self._p2p.mark_dead(new)
+        Log.error("async PS: rank(s) %s declared dead; continuing with "
+                  "%d live peer(s)", sorted(new),
+                  self._size - 1 - len(self._dead))
+
+    def _live_ranks(self):
+        """Union the KV dead-declarations into the local dead set (so all
+        survivors enter the drain barrier with the same participant list)
+        and return the live ranks, self included. The KV probe only runs
+        in survivor mode (`-failure_timeout_s` > 0) — without a watchdog
+        nothing can ever be declared dead, and the probe would add P-1
+        RPCs to every quiesce for nothing."""
+        if self._survivor_mode:
+            for r in range(self._size):
+                if r != self._rank and r not in self._dead:
+                    try:
+                        self._client.key_value_try_get(f"mvps/dead/{r}")
+                    except Exception:
+                        continue  # NOT_FOUND (or unreadable) -> assume live
+                    self.mark_dead({r})
+        return [r for r in range(self._size) if r not in self._dead]
+
     # -- quiesce -----------------------------------------------------------
     def drain(self, tag: str = "drain") -> None:
-        """Collective flush: after it returns on ALL processes, every delta
-        published before any process entered is applied everywhere.
+        """Collective flush among LIVE processes: after it returns on all
+        of them, every delta a live process published before any live
+        process entered is applied on every live process.
 
         Protocol: barrier A pins the publication frontier (everything
         published-before-entry is visible); each process then consumes up to
         the pinned counters; barrier B confirms group-wide completion.
+        Both barriers name the live participant set, so survivors of a
+        declared-dead peer still quiesce (the declaration is read from the
+        KV union first — see :meth:`_live_ranks`).
         """
         global _drain_round
         with _state_lock:
             _drain_round += 1
             rnd = _drain_round
-        self._client.wait_at_barrier(f"mvps/{tag}/{rnd}/a", 600_000)
+        live = self._live_ranks()
+        self._client.wait_at_barrier(f"mvps/{tag}/{rnd}/a", 600_000, live)
         targets = {r: self._peer_count(r)
-                   for r in range(self._size) if r != self._rank}
-        while any(_consumed[r] < n for r, n in targets.items()):
-            self.poll_once()
-        self._client.wait_at_barrier(f"mvps/{tag}/{rnd}/b", 600_000)
-        # every own record is now applied (and acked) everywhere: collect
-        # the ack keys and release any backpressure debt
+                   for r in live if r != self._rank}
+        # p2p frames are not durable like KV payloads, so the wait is
+        # deadlined: a stream that stops making progress for as long as
+        # the KV path's blocking-get timeout is a transport failure, not
+        # a slow peer — fail loudly instead of spinning forever
+        last_progress = time.monotonic()
+        while True:
+            # a peer declared dead MID-drain leaves the target set (its
+            # unreceived tail can never arrive; waiting would hang forever)
+            targets = {r: n for r, n in targets.items()
+                       if r not in self._dead}
+            missing = {r: n - _consumed[r] for r, n in targets.items()
+                       if _consumed[r] < n}
+            if not missing:
+                break
+            if self.poll_once() == 0:
+                if time.monotonic() - last_progress > 60.0:
+                    Log.fatal(
+                        f"async PS drain stalled 60 s waiting on records "
+                        f"{missing} (rank->count); peer dead or transport "
+                        f"broken — see parallel.FailureDetector")
+                time.sleep(0.002)      # p2p frames may still be in flight
+            else:
+                last_progress = time.monotonic()
+        # recompute the participant list: a peer that died MID-drain must
+        # not be named in barrier B (it will never arrive). _live_ranks
+        # re-unions the KV declarations so survivors converge on the list.
+        live = [r for r in self._live_ranks() if r in live]
+        self._client.wait_at_barrier(f"mvps/{tag}/{rnd}/b", 600_000, live)
+        # every own record is now applied (and acked) everywhere live:
+        # collect the ack keys and release any backpressure debt
         with self._pub_lock:
             self._reap_acks()
 

@@ -41,6 +41,7 @@ class FailureDetector:
         self._stop = threading.Event()
         self._watch_cb: Optional[Callable[[List[int]], None]] = None
         self._watch_timeout = 0.0
+        self._reported: set = set()   # ranks already handed to the callback
         # last observed (counter value, local monotonic time) per peer
         self._seen: Dict[int, tuple] = {}
         if sess.size > 1:
@@ -92,9 +93,13 @@ class FailureDetector:
                     dead = self.dead_peers(self._watch_timeout)
                 except Exception:
                     continue
-                if dead:
-                    self._watch_cb = None   # fire once
-                    cb(dead)
+                # stay armed: each dead rank is reported exactly once, so
+                # a survivor-mode callback (AsyncDeltaBus.mark_dead) keeps
+                # working through successive failures
+                new = [r for r in dead if r not in self._reported]
+                if new:
+                    self._reported.update(new)
+                    cb(new)
 
     # -- monitor -----------------------------------------------------------
     def _peer_count(self, r: int) -> int:
@@ -136,9 +141,12 @@ class FailureDetector:
                        on_failure: Optional[Callable[[List[int]], None]]
                        = None) -> None:
         """Declare-dead-and-act: when a peer misses heartbeats for
-        ``timeout_s``, invoke ``on_failure(dead_ranks)`` (default: fatal
-        log naming the dead ranks — crash fast, restart, resume from the
-        latest checkpoint)."""
+        ``timeout_s``, invoke ``on_failure(newly_dead_ranks)`` (default:
+        fatal log naming the dead ranks — crash fast, restart, resume
+        from the latest checkpoint). The watchdog stays armed: each rank
+        is reported once, successive failures keep firing — so a
+        survivor-mode callback (``-failure_timeout_s`` wires
+        ``AsyncDeltaBus.mark_dead``) can ride out multiple deaths."""
         if self._client is None:
             return
 

@@ -1,0 +1,269 @@
+"""Peer-to-peer TCP payload transport for the async delta bus.
+
+The reference's data plane is peer-to-peer: the MPI backend keeps a
+one-outstanding Isend pipeline per peer (``include/multiverso/net/
+mpi_net.h:199-220`` in the Multiverso reference) and the ZMQ backend a
+DEALER socket mesh (``zmq_net.h:171-228``). Round 3's bus funneled every
+record through the coordination-service KV — a single gRPC server
+(~117 MB/s measured at 256 KB values), fine at 2-4 processes but a
+funnel for a pod's O(P^2) record streams.
+
+This module moves the PAYLOAD bytes onto direct per-pair TCP sockets;
+the coordination-service KV keeps only the CONTROL plane it is good at:
+endpoint discovery, publication counters, acks, the GC/backpressure
+frontier, and barriers. Topology:
+
+* every rank listens on an ephemeral port and advertises
+  ``{label}/ep/{rank} = host:port`` in the KV;
+* every rank SUBSCRIBES to each peer (connects to the peer's listener
+  and sends its own rank) — records flow publisher -> subscriber down
+  that connection, so each pair has one connection per direction and
+  ordering per publisher is TCP's;
+* frames are ``<QI`` (sequence number, length) + payload; the sequence
+  number is authoritative — a gap means the transport invariant broke
+  and the bus fails loudly rather than applying around it.
+
+Threads: one accept loop, one sender per subscriber (drains a per-peer
+deque, so a slow consumer never blocks publishes to others — the
+reference's per-peer send queue, ``mpi_net.h:199`` ``msg_queues_``), one
+receiver per subscription (appends to an in-order inbox the bus's drain
+thread consumes). All daemon; :meth:`stop` closes sockets and joins.
+"""
+
+from __future__ import annotations
+
+import collections
+import socket
+import struct
+import threading
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ..log import Log
+
+_FRAME = struct.Struct("<QI")   # seq, payload length
+_HELLO = struct.Struct("<I")    # subscriber rank
+
+
+def _local_host() -> str:
+    """Advertised host: MV_P2P_HOST overrides; default = the hostname's
+    address (localhost setups resolve to 127.x and work either way)."""
+    import os
+
+    host = os.environ.get("MV_P2P_HOST")
+    if host:
+        return host
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+class P2PTransport:
+    """Direct-socket record plane between the processes of one bus."""
+
+    def __init__(self, rank: int, size: int, client,
+                 label: str = "mvps", connect_timeout_s: float = 60.0
+                 ) -> None:
+        self._rank = rank
+        self._size = size
+        self._client = client
+        self._label = label
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        # publisher side: per-subscriber outboxes + their sender threads
+        self._out: Dict[int, Deque[Tuple[int, bytes]]] = {
+            r: collections.deque() for r in range(size) if r != rank}
+        self._out_cv = threading.Condition(self._lock)
+        self._senders: Dict[int, threading.Thread] = {}
+        # consumer side: per-publisher in-order inboxes
+        self._in: Dict[int, Deque[Tuple[int, bytes]]] = {
+            r: collections.deque() for r in range(size) if r != rank}
+        self._dead: set = set()
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("", 0))
+        self._listener.listen(size)
+        port = self._listener.getsockname()[1]
+        # allow_overwrite: the KV outlives the Session; a restarted bus
+        # re-advertises its (new) endpoint
+        client.key_value_set(f"{label}/ep/{rank}",
+                             f"{_local_host()}:{port}", allow_overwrite=True)
+        self._spawn(self._accept_loop, "p2p-accept")
+        for r in self._in:
+            self._spawn(self._subscribe, f"p2p-sub-{r}", r,
+                        connect_timeout_s)
+
+    def _spawn(self, fn, name, *args) -> None:
+        t = threading.Thread(target=fn, name=name, args=args, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- publisher side ----------------------------------------------------
+    def send(self, seq: int, payload: bytes) -> None:
+        """Enqueue one record for every live subscriber (non-blocking; the
+        bus's in-flight-bytes watermark bounds total queued memory)."""
+        with self._out_cv:
+            for r, q in self._out.items():
+                if r not in self._dead:
+                    q.append((seq, payload))
+            self._out_cv.notify_all()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return                       # listener closed by stop()
+            try:
+                hello = self._read_exact(conn, _HELLO.size)
+                (peer,) = _HELLO.unpack(hello)
+            except OSError:
+                conn.close()
+                continue
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            with self._lock:
+                self._senders[peer] = t = threading.Thread(
+                    target=self._send_loop, name=f"p2p-send-{peer}",
+                    args=(peer, conn), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _send_loop(self, peer: int, conn: socket.socket) -> None:
+        q = self._out[peer]
+        while True:
+            with self._out_cv:
+                while not q and not self._stop.is_set():
+                    self._out_cv.wait(0.2)
+                if self._stop.is_set() and not q:
+                    return
+                seq, payload = q.popleft()
+            try:
+                # sendmsg scatters header + payload in one syscall without
+                # concatenating (the concat alone costs a payload-sized
+                # memcpy per subscriber on multi-MB records)
+                self._send_frame(conn, seq, payload)
+            except OSError as exc:
+                if not self._stop.is_set() and peer not in self._dead:
+                    Log.error("p2p: send to rank %d failed: %s (peer dead? "
+                              "see parallel.FailureDetector)", peer, exc)
+                return
+
+    @staticmethod
+    def _send_frame(conn: socket.socket, seq: int, payload: bytes) -> None:
+        header = _FRAME.pack(seq, len(payload))
+        view = memoryview(payload)
+        sent = conn.sendmsg([header, view])
+        # sendmsg may send partially; finish the remainder with sendall
+        if sent < len(header) + len(view):
+            if sent < len(header):
+                conn.sendall(header[sent:])
+                conn.sendall(view)
+            else:
+                conn.sendall(view[sent - len(header):])
+
+    # -- consumer side -----------------------------------------------------
+    @staticmethod
+    def _read_exact(conn: socket.socket, n: int) -> bytearray:
+        # recv_into a preallocated buffer: no per-chunk allocations, no
+        # final copy (callers treat the result as read-only bytes-like)
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = conn.recv_into(view[got:], n - got)
+            if r == 0:
+                raise OSError("connection closed")
+            got += r
+        return buf
+
+    def _subscribe(self, publisher: int, timeout_s: float) -> None:
+        key = f"{self._label}/ep/{publisher}"
+        try:
+            ep = self._client.blocking_key_value_get(
+                key, int(timeout_s * 1000))
+        except Exception as exc:
+            Log.error("p2p: no endpoint from rank %d within %.0f s: %s",
+                      publisher, timeout_s, exc)
+            return
+        host, _, port = str(ep).rpartition(":")
+        deadline = time.monotonic() + timeout_s
+        conn = None
+        while conn is None and not self._stop.is_set():
+            try:
+                conn = socket.create_connection((host, int(port)), timeout=5)
+            except OSError:
+                if time.monotonic() > deadline:
+                    Log.error("p2p: cannot connect to rank %d at %s",
+                              publisher, ep)
+                    return
+                time.sleep(0.05)
+        if conn is None:
+            return
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._conns.append(conn)
+        try:
+            conn.sendall(_HELLO.pack(self._rank))
+            inbox = self._in[publisher]
+            while not self._stop.is_set():
+                hdr = self._read_exact(conn, _FRAME.size)
+                seq, length = _FRAME.unpack(hdr)
+                payload = self._read_exact(conn, length)
+                with self._lock:
+                    inbox.append((seq, payload))
+        except OSError as exc:
+            if not self._stop.is_set() and publisher not in self._dead:
+                Log.error("p2p: stream from rank %d broke: %s (peer dead? "
+                          "see parallel.FailureDetector)", publisher, exc)
+
+    def pop_ready(self, publisher: int, expected_seq: int
+                  ) -> Optional[bytes]:
+        """Return the payload for ``expected_seq`` if it is the inbox head.
+
+        TCP preserves per-publisher order, so the head either IS the
+        expected record or hasn't arrived yet; anything else is a broken
+        transport invariant and fails loudly (same posture as the PART
+        reassembly check)."""
+        with self._lock:
+            inbox = self._in[publisher]
+            if not inbox:
+                return None
+            seq, payload = inbox[0]
+            if seq != expected_seq:
+                Log.fatal(f"p2p: rank {publisher} stream out of order: "
+                          f"seq {seq} at head, expected {expected_seq}")
+            inbox.popleft()
+            return payload
+
+    # -- failure handling (wired by the bus, driven by FailureDetector) ----
+    def mark_dead(self, ranks) -> None:
+        """Stop queueing to / expecting from dead peers; drop their queued
+        output so a wedged sender can't pin memory."""
+        with self._out_cv:
+            for r in ranks:
+                self._dead.add(r)
+                if r in self._out:
+                    self._out[r].clear()
+            self._out_cv.notify_all()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for c in self._conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5)

@@ -38,6 +38,7 @@ class Session:
         self.role: int = _ROLE_ALL
         self.started = False
         self.async_bus: Optional[Any] = None  # cross-process async PS plane
+        self.failure_detector: Optional[Any] = None  # -failure_timeout_s
 
     # -- singleton --------------------------------------------------------
     @classmethod
@@ -71,6 +72,19 @@ class Session:
             from .parallel.async_ps import AsyncDeltaBus
 
             self.async_bus = AsyncDeltaBus.maybe_start(self)
+            timeout = float(config.get_flag("failure_timeout_s"))
+            if timeout > 0 and self.size > 1:
+                from .parallel.health import FailureDetector
+
+                self.failure_detector = FailureDetector(
+                    interval_s=max(min(1.0, timeout / 5), 0.1), session=self)
+                # survivor mode when the async bus is up (dead peers leave
+                # the ack quorum and training continues); fail-fast default
+                # otherwise (sync collectives can't run degraded)
+                self.failure_detector.start_watchdog(
+                    timeout,
+                    self.async_bus.mark_dead
+                    if self.async_bus is not None else None)
             Log.info(
                 "multiverso-tpu initialised: rank %d/%d, mesh %s, mode %s",
                 self.rank, self.size, dict(self.topo.mesh.shape),
@@ -84,7 +98,21 @@ class Session:
         with self._lock:
             if not self.started:
                 return
-            topology.barrier("mv_shutdown")
+            if self.failure_detector is not None:
+                self.failure_detector.stop()
+                self.failure_detector = None
+            live = None
+            if (self.async_bus is not None
+                    and self.async_bus._survivor_mode):
+                # survivor mode: ALWAYS rendezvous via the KV live-set
+                # barrier, not just when the LOCAL dead set is non-empty —
+                # a survivor whose watchdog hasn't fired yet would
+                # otherwise take the all-process device barrier while its
+                # peer takes the live-set one, and both would hang.
+                # _live_ranks() unions the KV declarations so all
+                # survivors agree on the participant list.
+                live = self.async_bus._live_ranks()
+            topology.barrier("mv_shutdown", live)
             if self.async_bus is not None:
                 # collective: every in-flight delta lands everywhere before
                 # any table is torn down (the reference's FinishTrain drain,
@@ -108,6 +136,10 @@ class Session:
         self._require_started()
         if self.async_bus is not None:
             self.async_bus.drain("barrier")
+            if self.async_bus._dead:
+                # survivor mode: drain's live-set barriers were the
+                # rendezvous; a device barrier would wait on the dead peer
+                return
         topology.barrier()
 
     # -- registry ---------------------------------------------------------

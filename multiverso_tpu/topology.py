@@ -242,16 +242,30 @@ def discover(mesh_shape: Optional[Sequence[int]] = None) -> Topology:
     return topo
 
 
-def barrier(name: str = "mv_barrier") -> None:
+def barrier(name: str = "mv_barrier", participants=None) -> None:
     """Global process barrier.
 
     Replaces the reference's rank-0 BarrierController round-trip
     (``src/controller.cpp:16-31``): the JAX coordination service provides the
     same rendezvous over DCN; a single-process group is a no-op.
+
+    ``participants`` (survivor mode): rendezvous only the given live
+    process ids via a coordination-service barrier — a device-collective
+    barrier over ALL processes would wait on the dead peer forever. Pass
+    it only from one-shot phases (e.g. shutdown): KV barrier ids are
+    single-use per name.
     """
     import jax
 
     if jax.process_count() > 1:
+        if participants is not None:
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+            if client is not None:
+                client.wait_at_barrier(f"mvb/{name}", 600_000,
+                                       sorted(participants))
+                return
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(name)

@@ -1,0 +1,241 @@
+"""Two-process x multi-device dp scaling over the REAL gRPC control plane
+(VERDICT r3 item 8).
+
+The single-process virtual-mesh sweep (`tools/scaling_bench.py`) cannot
+see cross-process costs: the coordination-service handshake, the
+cross-process collective transport, the bus. This harness launches TWO
+OS processes x 4 virtual CPU devices each (8 devices total, the same
+device count as the single-process sweep) joined through a real
+`jax.distributed` coordinator over localhost, and measures the SAME
+jitted word2vec program both ways:
+
+* **sync** — one global mesh {worker: 2, server: 4}: the worker axis
+  spans the processes, so `dp_sync="dispatch"`'s per-dispatch delta psum
+  rides the cross-process CPU collective transport (the DCN stand-in);
+  each process feeds its batch shard via
+  `make_array_from_process_local_data`.
+* **async** — per-process local meshes; cross-process sync rides the
+  p2p delta bus instead of in-jit collectives (the reference's default
+  mode). Throughput = aggregate pairs/s of both ranks between two
+  drain barriers.
+
+Reference analogue: the 4-process benchmark table
+`binding/python/docs/BENCHMARK.md:54-57` in the Multiverso reference.
+
+Usage:
+  python tools/dcn_bench.py            # driver: spawns workers, prints table
+  python tools/dcn_bench.py --json     # one JSON object
+  python tools/dcn_bench.py --out docs/DISTRIBUTED.md   # splice the table
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# shared shape: the scaling_bench real-shape methodology at 8 devices
+VOCAB, DIM, PER_DEV_BATCH, STEPS = 20000, 128, 2048, 25
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    sys.path.insert(0, %(repo)r)
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
+
+    mode = os.environ["MV_DCN_MODE"]
+    rank = int(os.environ["MV_PROCESS_ID"])
+    nproc = int(os.environ["MV_NUM_PROCESSES"])
+    VOCAB, DIM, PB, S = %(vocab)d, %(dim)d, %(pb)d, %(steps)d
+    n_local_dev = 4
+
+    if mode == "sync":
+        mv.init(["w", "-sync=true", "-mesh_shape=%%d,4" %% nproc,
+                 "-log_level=error"])
+        B = PB * n_local_dev * nproc          # global batch (weak scaling)
+    else:
+        mv.init(["w", "-sync=false", "-log_level=error"])
+        B = PB * n_local_dev                  # per-process batch
+    cfg = Word2VecConfig(vocab_size=VOCAB, embedding_size=DIM, negative=5,
+                         batch_size=B, steps_per_call=S, seed=3)
+    w_in = mv.create_table("matrix", VOCAB, DIM, init_value="random")
+    w_out = mv.create_table("matrix", VOCAB, DIM)
+    model = Word2Vec(cfg, w_in, w_out, counts=np.ones(VOCAB, np.float64))
+    rng = np.random.default_rng(rank)
+    # sync mode: each process passes its LOCAL batch shard (worker axis
+    # spans processes); async: the whole per-process batch
+    Bl = B // nproc if mode == "sync" else B
+    c = rng.integers(0, VOCAB, (S, Bl)).astype(np.int32)
+    t = rng.integers(0, VOCAB, (S, Bl)).astype(np.int32)
+    m = np.ones((S, Bl), np.float32)
+
+    def run():
+        float(model.train_batches(c, t, m))
+
+    run()                                     # compile
+    mv.barrier()
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter(); run(); best = min(best,
+                                                    time.perf_counter() - t0)
+    mv.barrier()
+    pairs = S * (B * nproc if mode != "sync" else B)
+    print(json.dumps({"mode": mode, "rank": rank,
+                      "dispatch_ms": best * 1e3,
+                      "global_pairs_per_dispatch": pairs}), flush=True)
+    mv.shutdown()
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_mode(mode: str, tmpdir: str, nproc: int = 2):
+    port = _free_port()
+    script = os.path.join(tmpdir, f"dcn_{mode}.py")
+    with open(script, "w") as f:
+        f.write(_WORKER % {"repo": _REPO, "vocab": VOCAB, "dim": DIM,
+                           "pb": PER_DEV_BATCH, "steps": STEPS})
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "MV_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "MV_NUM_PROCESSES": str(nproc),
+            "MV_PROCESS_ID": str(rank),
+            "MV_DCN_MODE": mode,
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    rows = []
+    try:
+        for rank, proc in enumerate(procs):
+            try:
+                out, _ = proc.communicate(timeout=900)
+            except subprocess.TimeoutExpired:
+                raise RuntimeError(f"{mode} rank {rank} timed out")
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"{mode} rank {rank} failed:\n{out[-4000:]}")
+            for line in out.splitlines():
+                if line.startswith("{"):
+                    rows.append(json.loads(line))
+    finally:
+        # never leave a wedged worker pinning the CPU/coordinator (the
+        # round-3 zombie lesson: orphans poison every later measurement)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rows
+
+
+def single_process_reference():
+    """dp=8 single-process dispatch time at the same shape (the number the
+    cross-process runs are compared against)."""
+    from tools.scaling_bench import w2v_weak_scaling
+
+    rows = w2v_weak_scaling([1, 8], per_dev_batch=PER_DEV_BATCH,
+                            vocab=VOCAB, dim=DIM, steps=STEPS, repeats=2)
+    return {r["dp"]: r for r in rows}
+
+
+_BEGIN = "<!-- dcn_bench:begin -->"
+_END = "<!-- dcn_bench:end -->"
+
+
+def render(res) -> str:
+    sp = res["single"]
+    lines = [
+        _BEGIN,
+        "### Measured: 2-process x 4-device dp over the real control plane",
+        "",
+        "`tools/dcn_bench.py` — same 8 total devices and shape as the",
+        "single-process sweep, but split across two OS processes joined by",
+        "a real `jax.distributed` coordinator (localhost gRPC). The delta",
+        "vs the single-process dp=8 row isolates the cross-process cost",
+        "the virtual mesh cannot see (control plane + cross-process",
+        "collective transport for sync; the p2p bus for async).",
+        "",
+        "| config | global batch | dispatch ms | pairs/s | vs 1-proc dp=8 |",
+        "|---|---|---|---|---|",
+    ]
+    one = sp[8]["time_ms"]
+    base_pps = sp[8]["pairs_per_sec"]
+    lines.append(f"| 1 proc x 8 dev (reference) | {sp[8]['batch']} "
+                 f"| {one:.0f} | {base_pps:.3g} | 1.00 |")
+    for mode in ("sync", "async"):
+        rows = res[mode]
+        ms = max(r["dispatch_ms"] for r in rows)
+        pairs = rows[0]["global_pairs_per_dispatch"]
+        pps = pairs / (ms / 1e3)
+        lines.append(f"| 2 proc x 4 dev, {mode} | {pairs // STEPS} "
+                     f"| {ms:.0f} | {pps:.3g} | {pps / base_pps:.2f} |")
+    lines += [
+        "",
+        "(async trains 2 independent per-process replicas — its row counts "
+        "aggregate pairs across both ranks; staleness is the bus poll "
+        "interval. sync is one global-mesh SPMD program whose per-dispatch "
+        "delta psum crosses the process boundary.)",
+        _END,
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        res = {
+            "single": single_process_reference(),
+            "sync": run_mode("sync", td),
+            "async": run_mode("async", td),
+        }
+    if args.json:
+        print(json.dumps(res, default=str))
+    else:
+        print(render(res))
+    if args.out:
+        text = open(args.out).read()
+        if _BEGIN in text and _END in text:
+            pre = text[:text.index(_BEGIN)]
+            post = text[text.index(_END) + len(_END):]
+            open(args.out, "w").write(pre + render(res) + post)
+        else:
+            open(args.out, "a").write("\n\n" + render(res) + "\n")
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    # pin the 8-virtual-device CPU platform BEFORE jax initialises (the
+    # single-process reference sweep runs in THIS process)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, _REPO)
+    sys.exit(main())
