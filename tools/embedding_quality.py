@@ -113,23 +113,50 @@ def make_realscale_corpus(path: str, vocab: int = 71291,
     return labels
 
 
-def probe_subset(words, vecs, labels):
-    """(nn_purity, cosine_gap) over ONLY the planted cluster words —
-    at 71k vocab the full sim matrix is 20 GB; the planted subset
-    (C x size words) is what ground truth exists for anyway."""
+def probe_subset(words, vecs, labels, bands=None):
+    """(nn_purity, cosine_gap[, per-band rows]) over ONLY the planted
+    cluster words — at 71k vocab the full sim matrix is 20 GB; the
+    planted subset (C x size words) is what ground truth exists for
+    anyway.
+
+    ``bands``: optional list of (name, lo_rank, hi_rank) — word ids ARE
+    zipf ranks in the synthetic corpora, so banding by id splits the
+    planted clusters into frequency strata. The per-band rows answer the
+    TAIL-sensitivity question the aggregate can hide: an approximation
+    (e.g. G-shared negatives) could hold the head and quietly damage
+    rare words.
+    """
     idx = [i for i, w in enumerate(words) if w in labels]
     lab = np.array([labels[words[i]] for i in idx])
+    rank = np.array([int(words[i][1:]) for i in idx])   # "w123" -> 123
     sub = vecs[idx]
     unit = sub / np.maximum(np.linalg.norm(sub, axis=1, keepdims=True), 1e-9)
     sim = unit @ unit.T
     np.fill_diagonal(sim, -np.inf)
     nn = sim.argmax(axis=1)
-    purity = float(np.mean(lab == lab[nn]))
+    hit = lab == lab[nn]
     same = lab[:, None] == lab[None, :]
     off = ~np.eye(len(idx), dtype=bool)
-    gap = float(sim[same & off].mean()
-                - sim[~same & off][:: max(len(idx) // 64, 1)].mean())
-    return purity, gap
+
+    def _gap(mask_rows):
+        s = sim[mask_rows]
+        sm = same[mask_rows]
+        offm = off[mask_rows]
+        return float(s[sm & offm].mean()
+                     - s[~sm & offm][:: max(len(idx) // 64, 1)].mean())
+
+    purity = float(hit.mean())
+    gap = _gap(np.ones(len(idx), bool))
+    if bands is None:
+        return purity, gap
+    rows = []
+    for name, lo, hi in bands:
+        m = (rank >= lo) & (rank < hi)
+        if m.sum() == 0:
+            continue
+        rows.append({"band": name, "n": int(m.sum()),
+                     "purity": float(hit[m].mean()), "gap": _gap(m)})
+    return purity, gap, rows
 
 
 def load_vectors(path: str):
@@ -213,10 +240,14 @@ def run_realscale_config(corpus, labels, tag, shared, epochs=3):
                     sample=1e-3, log_every=0)
         words, vecs = load_vectors(out)
         os.unlink(out)
-        purity, gap = probe_subset(words, vecs, labels)
+        purity, gap, bands = probe_subset(
+            words, vecs, labels,
+            bands=[("head [100,1k)", 100, 1000),
+                   ("mid [1k,5k)", 1000, 5000),
+                   ("tail [5k,20k)", 5000, 20000)])
         return {"tag": tag, "shared": shared, "loss": res.final_loss,
                 "pairs_per_sec": res.pairs_per_sec,
-                "nn_purity": purity, "cos_gap": gap}
+                "nn_purity": purity, "cos_gap": gap, "bands": bands}
     finally:
         mv.shutdown()
         Session._instance = None
@@ -226,7 +257,8 @@ _RS_BEGIN = "<!-- realscale:begin -->"
 _RS_END = "<!-- realscale:end -->"
 
 
-def realscale_sweep(out_path: str = "", quick: bool = False):
+def realscale_sweep(out_path: str = "", quick: bool = False,
+                    gs=(0, 4, 8, 16)):
     """VERDICT r3 item 7: re-probe the G cap at the real text8 shape."""
     corpus = os.path.join(tempfile.gettempdir(), "eq_real_corpus.txt")
     n_tokens = 2_000_000 if quick else 8_000_000
@@ -235,7 +267,7 @@ def realscale_sweep(out_path: str = "", quick: bool = False):
     labels = make_realscale_corpus(corpus, n_tokens=n_tokens,
                                    n_clusters=n_clusters)
     rows = []
-    for g in (0, 4, 8, 16):
+    for g in gs:
         r = run_realscale_config(corpus, labels, f"rs_g{g}", g,
                                  epochs=epochs)
         print(f"realscale G={g}: loss {r['loss']:.4f} purity "
@@ -243,9 +275,20 @@ def realscale_sweep(out_path: str = "", quick: bool = False):
               f"({r['pairs_per_sec'] / 1e6:.2f}M pairs/s)", flush=True)
         rows.append(r)
     ref = rows[0]
+
+    def band_parity(r):
+        """Tail-sensitivity bar: EVERY frequency band must hold parity
+        (purity within 0.02, gap within 10% of the same band's exact-draw
+        baseline) — the aggregate can hide rare-word damage."""
+        ref_bands = {b["band"]: b for b in ref["bands"]}
+        return all(b["purity"] >= ref_bands[b["band"]]["purity"] - 0.02
+                   and b["gap"] >= 0.9 * ref_bands[b["band"]]["gap"]
+                   for b in r["bands"] if b["band"] in ref_bands)
+
     ok = [r for r in rows[1:]
           if r["nn_purity"] >= ref["nn_purity"] - 0.02
-          and r["cos_gap"] >= 0.9 * ref["cos_gap"]]
+          and r["cos_gap"] >= 0.9 * ref["cos_gap"]
+          and band_parity(r)]
     best = max((r["shared"] for r in ok), default=0)
     lines = [
         _RS_BEGIN,
@@ -270,8 +313,23 @@ def realscale_sweep(out_path: str = "", quick: bool = False):
                      f"| {r['pairs_per_sec'] / 1e6:.2f}M |")
     lines += [
         "",
-        (f"Parity bar (purity within 0.02, cos-gap within 10% of the "
-         f"exact-draw G=0 baseline): largest G at parity = **{best}**."),
+        "Per-frequency-band breakdown (word ids are zipf ranks; the",
+        "aggregate could hide rare-word damage — G-shared draws touch",
+        "head rows most, so the TAIL bands are the sensitivity check):",
+        "",
+        "| G | " + " | ".join(
+            f"{b['band']} purity / gap" for b in rows[0]["bands"]) + " |",
+        "|---|" + "---|" * len(rows[0]["bands"]),
+    ]
+    for r in rows:
+        cells = " | ".join(f"{b['purity']:.3f} / {b['gap']:.3f}"
+                           for b in r["bands"])
+        lines.append(f"| {r['shared']} | {cells} |")
+    lines += [
+        "",
+        (f"Parity bar (purity within 0.02 and cos-gap within 10% of the "
+         f"exact-draw G=0 baseline, in aggregate AND in every frequency "
+         f"band): largest G at parity = **{best}**."),
         _RS_END,
     ]
     text = "\n".join(lines)
@@ -298,11 +356,22 @@ def main(argv=None):
     ap.add_argument("--realscale", action="store_true",
                     help="71k-vocab G probe at the frozen bench config "
                          "(appends its own section to --out)")
+    ap.add_argument("--gs", default="0,4,8,16",
+                    help="comma-separated G values for --realscale")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend (e.g. accelerator tunnel "
+                         "down); quality verdicts are backend-independent")
     ap.add_argument("--out", default="")
     args = ap.parse_args(argv)
 
+    if args.cpu:
+        import jax
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
     if args.realscale:
-        realscale_sweep(args.out, quick=args.quick)
+        realscale_sweep(args.out, quick=args.quick,
+                        gs=tuple(int(g) for g in args.gs.split(",")))
         return 0
 
     corpus = os.path.join(tempfile.gettempdir(), "eq_corpus.txt")
