@@ -942,11 +942,9 @@ class Word2Vec:
         batch = (jnp.asarray(centers, jnp.int32),
                  jnp.asarray(contexts, jnp.int32),
                  jnp.asarray(mask, jnp.float32))
-        import jax as _jax
-
-        if _jax.process_count() > 1 and len(
+        if jax.process_count() > 1 and len(
                 self.input_table.mesh.devices.flat) > len(
-                _jax.local_devices()):
+                jax.local_devices()):
             # multi-process SPMD (the worker axis spans processes): each
             # process passes ITS batch shard; assemble the global array
             # from the per-process local data (a plain device_put cannot
@@ -954,12 +952,13 @@ class Word2Vec:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             mesh = self.input_table.mesh
-            spec = (NamedSharding(mesh, P(None, WORKER_AXIS))
-                    if batch[0].ndim >= 2
-                    else NamedSharding(mesh, P(WORKER_AXIS)))
+            # batch dim is axis 0 for single batches, axis 1 for stacked
+            # [S, B] multi-batch calls; trailing dims (CBOW window) unsharded
+            lead = (None,) if batch[0].ndim >= 2 else ()
             batch = tuple(
-                _jax.make_array_from_process_local_data(
-                    NamedSharding(mesh, P(*(spec.spec[:a.ndim]))),
+                jax.make_array_from_process_local_data(
+                    NamedSharding(
+                        mesh, P(*(lead + (WORKER_AXIS,))[:a.ndim])),
                     np.asarray(a))
                 for a in batch)
         with self.input_table._lock, self.output_table._lock:

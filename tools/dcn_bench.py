@@ -226,13 +226,10 @@ def main(argv=None) -> int:
     else:
         print(render(res))
     if args.out:
-        text = open(args.out).read()
-        if _BEGIN in text and _END in text:
-            pre = text[:text.index(_BEGIN)]
-            post = text[text.index(_END) + len(_END):]
-            open(args.out, "w").write(pre + render(res) + post)
-        else:
-            open(args.out, "a").write("\n\n" + render(res) + "\n")
+        from tools.docsplice import splice
+
+        splice(args.out, render(res), _BEGIN, _END,
+               anchor="## Failure recovery")
         print(f"wrote {args.out}")
     return 0
 

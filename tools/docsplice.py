@@ -1,0 +1,27 @@
+"""Marker-delimited block splicing shared by the measured-docs tools
+(`scaling_bench`, `dcn_bench`, `embedding_quality`): each tool owns a
+``<!-- name:begin -->…<!-- name:end -->`` block in a docs file and
+re-renders ONLY that block on re-runs, so regenerated measurements never
+clobber the surrounding prose."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def splice(path: str, block: str, begin: str, end: str,
+           anchor: Optional[str] = None) -> None:
+    """Replace the ``begin``..``end`` region of ``path`` with ``block``
+    (which must itself carry the markers). First insertion goes before
+    ``anchor`` when given, else appends."""
+    with open(path) as f:
+        text = f.read()
+    if begin in text and end in text and text.index(begin) < text.index(end):
+        text = (text[:text.index(begin)] + block
+                + text[text.index(end) + len(end):])
+    elif anchor is not None and anchor in text:
+        text = text.replace(anchor, block + "\n\n" + anchor)
+    else:
+        text = text.rstrip() + "\n\n" + block + "\n"
+    with open(path, "w") as f:
+        f.write(text)

@@ -334,15 +334,9 @@ def realscale_sweep(out_path: str = "", quick: bool = False,
     ]
     text = "\n".join(lines)
     if out_path:
-        with open(out_path) as f:
-            doc = f.read()
-        if _RS_BEGIN in doc and _RS_END in doc:
-            doc = (doc[:doc.index(_RS_BEGIN)] + text
-                   + doc[doc.index(_RS_END) + len(_RS_END):])
-        else:
-            doc = doc.rstrip() + "\n\n" + text + "\n"
-        with open(out_path, "w") as f:
-            f.write(doc)
+        from tools.docsplice import splice
+
+        splice(out_path, text, _RS_BEGIN, _RS_END)
         print(f"wrote {out_path}")
     else:
         print(text)

@@ -356,24 +356,11 @@ def render_markdown(res) -> str:
 
 
 def splice_into(path: str, block: str) -> None:
-    with open(path) as f:
-        text = f.read()
-    markers_ok = (_BEGIN in text and _END in text
-                  and text.index(_BEGIN) < text.index(_END))
-    if markers_ok:
-        pre = text[:text.index(_BEGIN)]
-        post = text[text.index(_END) + len(_END):]
-        text = pre + block + post
-    else:
-        # insert after the "Scaling methodology" numbered list (before the
-        # next ## heading)
-        anchor = "## Failure recovery"
-        if anchor in text:
-            text = text.replace(anchor, block + "\n\n" + anchor)
-        else:
-            text = text.rstrip() + "\n\n" + block + "\n"
-    with open(path, "w") as f:
-        f.write(text)
+    from tools.docsplice import splice
+
+    # first insertion lands before the next section after the
+    # "Scaling methodology" numbered list
+    splice(path, block, _BEGIN, _END, anchor="## Failure recovery")
 
 
 def main(argv=None) -> int:
