@@ -7,15 +7,17 @@ reference's NAMED configuration shape — text8: ~71k vocabulary, 200-dim
 embeddings (BASELINE.json config 2; the corpus itself is synthesised with a
 zipf unigram law because this environment has no network egress, but vocab
 size, dimensionality, window, negatives and subsampling all match).
-Negative draws are group-shared at G=8 (round 4: the 71k-vocab
+Negative draws are group-shared at G=16 (round 4: the 71k-vocab
 real-scale probe — `tools/embedding_quality.py --realscale`, the frozen
-bench config with planted clusters — shows G=8 at full quality parity:
-purity 1.000, cos-gap 0.713 vs 0.703 exact-draw baseline; the r3 G=4
-cap came from a deliberately-harsh 332-word probe whose within-group
-negative correlation is ~200x denser than text8's. G=16 also passes
-that probe and measures ~9.3M pairs/s, kept off-default pending a
-tail-sensitivity probe; exact per-pair draws remain one flag away,
-`-shared_negatives=0`). Updates use the capped row-mean stabiliser
+bench config with planted clusters — shows G=16 at full quality parity
+in aggregate AND in every zipf frequency band, the tail-sensitivity
+check: purity 1.000 everywhere, cos-gap 0.724 vs 0.703 exact-draw
+baseline (tail band 0.745 vs 0.722 — shared draws mildly REDUCE
+negative-sampling noise under the capped row-mean). The r3 G=4 cap came
+from a deliberately-harsh 332-word probe whose within-group negative
+correlation is ~200x denser than text8's. Exact per-pair draws remain
+one flag away, `-shared_negatives=0`.) Updates use the capped row-mean
+stabiliser
 (quality parity in the same doc) because raw summed updates DIVERGE at
 64k batch on a zipf corpus — see the auto rule in apps/wordembedding.py.
 Config provenance/freeze: BASELINE.md "bench.py config provenance".
@@ -96,12 +98,12 @@ def main() -> int:
                                                    subsample_probs)
     from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
 
-    # default = G=8 group-shared draws (parity-proven on BOTH quality
-    # probes at this exact config — docs/EMBEDDING_QUALITY.md real-scale
-    # section); `-shared_negatives=0` restores exact per-pair reference
-    # semantics, `=16` the faster probe-passing mode (parsed by the
+    # default = G=16 group-shared draws (parity-proven at the real-scale
+    # probe in aggregate and per frequency band —
+    # docs/EMBEDDING_QUALITY.md real-scale section); `-shared_negatives=0`
+    # restores exact per-pair reference semantics (parsed by the
     # framework's own flag registry, like every other option).
-    mv.define_int("shared_negatives", 8,
+    mv.define_int("shared_negatives", 16,
                   "share each K-negative draw across G consecutive pairs")
 
     corpus = "/tmp/mv_bench_corpus_text8.txt"
