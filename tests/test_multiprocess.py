@@ -1113,3 +1113,75 @@ def test_two_process_p2p_raw_transport_rate(tmp_path):
         assert proc.returncode == 0, f"rank {rank}:\n{out[-3000:]}"
         assert f"RANK{rank}_RAWTP_OK" in out
     print(outs[1].strip().splitlines()[-1])
+
+
+_FOURP_P2P_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    sys.path.insert(0, %r)
+    import multiverso_tpu as mv
+
+    rank = int(os.environ["MV_PROCESS_ID"])
+    mv.init(["w", "-sync=false", "-log_level=error"])
+    bus = mv.session().async_bus
+    assert bus._p2p is not None           # 4-way handshake agreed on p2p
+
+    # full-mesh traffic: every rank publishes dense AND keyed deltas that
+    # every other rank must fold exactly once (12 directed socket pairs)
+    t = mv.create_table("array", 64)
+    m = mv.create_table("matrix", 32, 8)
+    iters = 5
+    for i in range(iters):
+        t.add(np.full(64, float(rank + 1), np.float32))
+        m.add_rows([rank, 31], np.full((2, 8), 1.0, np.float32))
+    mv.barrier()                          # quiesce across all four
+    got = np.asarray(t.get())
+    want = iters * (1 + 2 + 3 + 4)
+    assert np.allclose(got, want), (got[0], want)
+    gm = np.asarray(m.get())
+    assert np.allclose(gm[31], 4 * iters), gm[31]     # all ranks hit row 31
+    for r in range(4):
+        assert np.allclose(gm[r], iters), (r, gm[r])  # each rank's own row
+    st = bus.stats()
+    assert st["inflight_bytes"] == 0, st
+    print(f"RANK{rank}_P2P4_OK", flush=True)
+    mv.barrier()
+    mv.shutdown()
+""")
+
+
+def test_four_process_async_p2p_sigma(tmp_path):
+    """The p2p payload plane at P=4: a full socket mesh (12 directed
+    pairs), per-publisher in-order consumption from three peers at once,
+    and the 4-way transport handshake — with the exactly-once
+    Sigma-invariant intact after quiesce."""
+    port = _free_port()
+    script = tmp_path / "p2p4_worker.py"
+    script.write_text(_FOURP_P2P_WORKER % _REPO)
+    procs = []
+    for rank in range(4):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "MV_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "MV_NUM_PROCESSES": "4",
+            "MV_PROCESS_ID": str(rank),
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"rank {rank} timed out (4-way p2p bus stalled)")
+        outs.append(out)
+    for rank, (proc, out) in enumerate(zip(procs, outs)):
+        assert proc.returncode == 0, f"rank {rank}:\n{out[-3000:]}"
+        assert f"RANK{rank}_P2P4_OK" in out
