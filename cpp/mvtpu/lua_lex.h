@@ -1,0 +1,276 @@
+// Shared Lua 5.1 lexer for the binding toolchain: the syntax gate
+// (lua_check.cc) and the interpreter (lua_run.cc) tokenise identically
+// by construction. Errors throw LuaSyntaxError with file:line context.
+
+#ifndef MVTPU_LUA_LEX_H_
+#define MVTPU_LUA_LEX_H_
+
+#include <cctype>
+#include <cstdlib>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+
+namespace mvtpu_lua {
+
+struct LuaSyntaxError : std::runtime_error {
+  explicit LuaSyntaxError(const std::string& m) : std::runtime_error(m) {}
+};
+
+enum TokKind {
+  TK_EOF, TK_NAME, TK_NUMBER, TK_STRING,
+  TK_AND, TK_BREAK, TK_DO, TK_ELSE, TK_ELSEIF, TK_END, TK_FALSE, TK_FOR,
+  TK_FUNCTION, TK_IF, TK_IN, TK_LOCAL, TK_NIL, TK_NOT, TK_OR, TK_REPEAT,
+  TK_RETURN, TK_THEN, TK_TRUE, TK_UNTIL, TK_WHILE,
+  TK_PLUS, TK_MINUS, TK_STAR, TK_SLASH, TK_PERCENT, TK_CARET, TK_HASH,
+  TK_EQ, TK_NE, TK_LE, TK_GE, TK_LT, TK_GT, TK_ASSIGN, TK_LPAREN, TK_RPAREN,
+  TK_LBRACE, TK_RBRACE, TK_LBRACKET, TK_RBRACKET, TK_SEMI, TK_COLON,
+  TK_COMMA, TK_DOT, TK_CONCAT, TK_ELLIPSIS,
+};
+
+struct Token {
+  TokKind kind = TK_EOF;
+  std::string text;   // NAME/STRING payload
+  double num = 0;     // NUMBER payload
+  int line = 1;
+};
+
+class Lexer {
+ public:
+  Lexer(const std::string& src, std::string file)
+      : s_(src), file_(std::move(file)) {}
+
+  Token next() {
+    skip_space_and_comments();
+    Token t;
+    t.line = line_;
+    if (pos_ >= s_.size()) { t.kind = TK_EOF; return t; }
+    char c = s_[pos_];
+    if (std::isalpha(static_cast<unsigned char>(c)) || c == '_')
+      return name_or_keyword();
+    if (std::isdigit(static_cast<unsigned char>(c)) ||
+        (c == '.' && pos_ + 1 < s_.size() &&
+         std::isdigit(static_cast<unsigned char>(s_[pos_ + 1]))))
+      return number();
+    if (c == '"' || c == '\'') return short_string();
+    if (c == '[') {
+      size_t lvl;
+      if (long_bracket_level(&lvl)) return long_string(lvl);
+      ++pos_; t.kind = TK_LBRACKET; return t;
+    }
+    return symbol();
+  }
+
+  [[noreturn]] void err(int line, const std::string& msg) const {
+    std::ostringstream os;
+    os << file_ << ":" << line << ": " << msg;
+    throw LuaSyntaxError(os.str());
+  }
+
+  const std::string& file() const { return file_; }
+
+ private:
+  void skip_space_and_comments() {
+    for (;;) {
+      while (pos_ < s_.size() &&
+             std::isspace(static_cast<unsigned char>(s_[pos_]))) {
+        if (s_[pos_] == '\n') ++line_;
+        ++pos_;
+      }
+      if (pos_ + 1 < s_.size() && s_[pos_] == '-' && s_[pos_ + 1] == '-') {
+        pos_ += 2;
+        size_t lvl;
+        if (pos_ < s_.size() && s_[pos_] == '[' && long_bracket_level(&lvl)) {
+          long_string(lvl);
+        } else {
+          while (pos_ < s_.size() && s_[pos_] != '\n') ++pos_;
+        }
+        continue;
+      }
+      return;
+    }
+  }
+
+  bool long_bracket_level(size_t* lvl) const {
+    size_t p = pos_ + 1, eq = 0;
+    while (p < s_.size() && s_[p] == '=') { ++eq; ++p; }
+    if (p < s_.size() && s_[p] == '[') { *lvl = eq; return true; }
+    return false;
+  }
+
+  Token long_string(size_t lvl) {
+    Token t; t.kind = TK_STRING; t.line = line_;
+    pos_ += 2 + lvl;
+    if (pos_ < s_.size() && s_[pos_] == '\n') { ++line_; ++pos_; }
+    std::string close = "]" + std::string(lvl, '=') + "]";
+    size_t start = pos_;
+    for (;;) {
+      if (pos_ >= s_.size()) err(t.line, "unterminated long string/comment");
+      if (s_[pos_] == ']' && s_.compare(pos_, close.size(), close) == 0) {
+        t.text = s_.substr(start, pos_ - start);
+        pos_ += close.size();
+        return t;
+      }
+      if (s_[pos_] == '\n') ++line_;
+      ++pos_;
+    }
+  }
+
+  Token short_string() {
+    Token t; t.kind = TK_STRING; t.line = line_;
+    char quote = s_[pos_++];
+    std::string out;
+    for (;;) {
+      if (pos_ >= s_.size() || s_[pos_] == '\n')
+        err(t.line, "unterminated string");
+      char c = s_[pos_++];
+      if (c == quote) { t.text = out; return t; }
+      if (c == '\\') {
+        if (pos_ >= s_.size()) err(t.line, "unterminated string escape");
+        char e = s_[pos_++];
+        switch (e) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'a': out += '\a'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'v': out += '\v'; break;
+          case '\n': out += '\n'; ++line_; break;
+          case '\\': case '"': case '\'': out += e; break;
+          default:
+            if (std::isdigit(static_cast<unsigned char>(e))) {
+              int v = e - '0';
+              for (int k = 0; k < 2 && pos_ < s_.size() &&
+                   std::isdigit(static_cast<unsigned char>(s_[pos_])); ++k)
+                v = v * 10 + (s_[pos_++] - '0');
+              out += static_cast<char>(v);
+            } else {
+              out += e;
+            }
+        }
+        continue;
+      }
+      out += c;
+    }
+  }
+
+  Token number() {
+    Token t; t.kind = TK_NUMBER; t.line = line_;
+    size_t start = pos_;
+    if (s_[pos_] == '0' && pos_ + 1 < s_.size() &&
+        (s_[pos_ + 1] == 'x' || s_[pos_ + 1] == 'X')) {
+      pos_ += 2;
+      while (pos_ < s_.size() &&
+             std::isxdigit(static_cast<unsigned char>(s_[pos_]))) ++pos_;
+      if (pos_ == start + 2) err(t.line, "malformed hex number");
+      t.num = static_cast<double>(
+          std::strtoull(s_.substr(start + 2, pos_ - start - 2).c_str(),
+                        nullptr, 16));
+      return t;
+    }
+    bool seen_dot = false, seen_exp = false;
+    while (pos_ < s_.size()) {
+      char c = s_[pos_];
+      if (std::isdigit(static_cast<unsigned char>(c))) { ++pos_; continue; }
+      if (c == '.' && !seen_dot && !seen_exp) { seen_dot = true; ++pos_; continue; }
+      if ((c == 'e' || c == 'E') && !seen_exp) {
+        seen_exp = true; ++pos_;
+        if (pos_ < s_.size() && (s_[pos_] == '+' || s_[pos_] == '-')) ++pos_;
+        if (pos_ >= s_.size() ||
+            !std::isdigit(static_cast<unsigned char>(s_[pos_])))
+          err(t.line, "malformed number exponent");
+        continue;
+      }
+      break;
+    }
+    if (pos_ < s_.size() &&
+        (std::isalpha(static_cast<unsigned char>(s_[pos_])) || s_[pos_] == '_'))
+      err(t.line, "malformed number");
+    t.num = std::strtod(s_.substr(start, pos_ - start).c_str(), nullptr);
+    return t;
+  }
+
+  Token name_or_keyword() {
+    Token t; t.line = line_;
+    size_t start = pos_;
+    while (pos_ < s_.size() &&
+           (std::isalnum(static_cast<unsigned char>(s_[pos_])) ||
+            s_[pos_] == '_'))
+      ++pos_;
+    t.text = s_.substr(start, pos_ - start);
+    static const struct { const char* w; TokKind k; } kw[] = {
+        {"and", TK_AND}, {"break", TK_BREAK}, {"do", TK_DO},
+        {"else", TK_ELSE}, {"elseif", TK_ELSEIF}, {"end", TK_END},
+        {"false", TK_FALSE}, {"for", TK_FOR}, {"function", TK_FUNCTION},
+        {"if", TK_IF}, {"in", TK_IN}, {"local", TK_LOCAL}, {"nil", TK_NIL},
+        {"not", TK_NOT}, {"or", TK_OR}, {"repeat", TK_REPEAT},
+        {"return", TK_RETURN}, {"then", TK_THEN}, {"true", TK_TRUE},
+        {"until", TK_UNTIL}, {"while", TK_WHILE},
+    };
+    t.kind = TK_NAME;
+    for (const auto& e : kw)
+      if (t.text == e.w) { t.kind = e.k; break; }
+    return t;
+  }
+
+  Token symbol() {
+    Token t; t.line = line_;
+    char c = s_[pos_++];
+    char n = pos_ < s_.size() ? s_[pos_] : '\0';
+    switch (c) {
+      case '+': t.kind = TK_PLUS; return t;
+      case '-': t.kind = TK_MINUS; return t;
+      case '*': t.kind = TK_STAR; return t;
+      case '/': t.kind = TK_SLASH; return t;
+      case '%': t.kind = TK_PERCENT; return t;
+      case '^': t.kind = TK_CARET; return t;
+      case '#': t.kind = TK_HASH; return t;
+      case '(': t.kind = TK_LPAREN; return t;
+      case ')': t.kind = TK_RPAREN; return t;
+      case '{': t.kind = TK_LBRACE; return t;
+      case '}': t.kind = TK_RBRACE; return t;
+      case ']': t.kind = TK_RBRACKET; return t;
+      case ';': t.kind = TK_SEMI; return t;
+      case ':': t.kind = TK_COLON; return t;
+      case ',': t.kind = TK_COMMA; return t;
+      case '=':
+        if (n == '=') { ++pos_; t.kind = TK_EQ; } else t.kind = TK_ASSIGN;
+        return t;
+      case '~':
+        if (n == '=') { ++pos_; t.kind = TK_NE; return t; }
+        err(line_, "unexpected '~'");
+      case '<':
+        if (n == '=') { ++pos_; t.kind = TK_LE; } else t.kind = TK_LT;
+        return t;
+      case '>':
+        if (n == '=') { ++pos_; t.kind = TK_GE; } else t.kind = TK_GT;
+        return t;
+      case '.':
+        if (n == '.') {
+          ++pos_;
+          if (pos_ < s_.size() && s_[pos_] == '.') { ++pos_; t.kind = TK_ELLIPSIS; }
+          else t.kind = TK_CONCAT;
+        } else {
+          t.kind = TK_DOT;
+        }
+        return t;
+      default: {
+        std::ostringstream os;
+        os << "unexpected character '" << c << "'";
+        err(line_, os.str());
+      }
+    }
+  }
+
+  const std::string& s_;
+  std::string file_;
+  size_t pos_ = 0;
+  int line_ = 1;
+};
+
+
+}  // namespace mvtpu_lua
+
+#endif  // MVTPU_LUA_LEX_H_
