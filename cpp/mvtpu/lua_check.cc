@@ -24,10 +24,7 @@
 
 namespace {
 
-using mvtpu_lua::Lexer;
-using mvtpu_lua::LuaSyntaxError;
-using mvtpu_lua::Token;
-using namespace mvtpu_lua;  // TokKind enumerators (TK_*)
+using namespace mvtpu_lua;  // Lexer, Token, LuaSyntaxError, TK_*
 using SyntaxError = mvtpu_lua::LuaSyntaxError;
 
 class Parser {

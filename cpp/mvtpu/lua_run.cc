@@ -45,10 +45,7 @@
 
 namespace {
 
-using mvtpu_lua::Lexer;
-using mvtpu_lua::LuaSyntaxError;
-using mvtpu_lua::Token;
-using namespace mvtpu_lua;  // TokKind enumerators (TK_*)
+using namespace mvtpu_lua;  // Lexer, Token, LuaSyntaxError, TK_*
 
 // ---------------------------------------------------------------------------
 // AST
@@ -572,6 +569,10 @@ struct Value {
   bool truthy() const { return !(k == NIL || (k == BOOL && !b)); }
 };
 
+struct BreakSignal {};
+struct ReturnSignal { std::vector<Value> vals; };
+struct ErrorSignal { Value v; };    // error() / runtime error (pcall-able)
+
 struct Table {
   std::unordered_map<std::string, Value> smap;
   std::map<double, Value> nmap;
@@ -595,7 +596,8 @@ struct Table {
       else nmap[key.n] = std::move(v);
       return;
     }
-    throw LuaSyntaxError("unsupported table key type");
+    // runtime error, not syntax: pcall-able like every other one
+    throw ErrorSignal{Value::str("unsupported table key type")};
   }
   double length() const {
     double n = 0;
@@ -741,10 +743,6 @@ void parse_cdef(const std::string& src) {
 // ---------------------------------------------------------------------------
 // Interpreter
 // ---------------------------------------------------------------------------
-
-struct BreakSignal {};
-struct ReturnSignal { std::vector<Value> vals; };
-struct ErrorSignal { Value v; };    // error() / runtime error (pcall-able)
 
 struct Interp {
   std::shared_ptr<Table> globals = std::make_shared<Table>();
