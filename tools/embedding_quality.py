@@ -258,7 +258,7 @@ _RS_END = "<!-- realscale:end -->"
 
 
 def realscale_sweep(out_path: str = "", quick: bool = False,
-                    gs=(0, 4, 8, 16)):
+                    gs=(0, 16, 32, 64)):
     """VERDICT r3 item 7: re-probe the G cap at the real text8 shape."""
     corpus = os.path.join(tempfile.gettempdir(), "eq_real_corpus.txt")
     n_tokens = 2_000_000 if quick else 8_000_000
@@ -290,6 +290,14 @@ def realscale_sweep(out_path: str = "", quick: bool = False,
           and r["cos_gap"] >= 0.9 * ref["cos_gap"]
           and band_parity(r)]
     best = max((r["shared"] for r in ok), default=0)
+    # Loss guard (round 4): the planted-cluster bar is ONE-SIDED (it
+    # rejects degradation; improvement passes) and saturates at real
+    # scale — gaps improve monotonically with G — so it stops
+    # discriminating. Final training loss on the actual objective is
+    # the guard the bar cannot provide: cap the recommendation at <1%
+    # drift off the exact-draw baseline.
+    guarded = [r for r in ok if r["loss"] <= 1.01 * ref["loss"]]
+    best_guarded = max((r["shared"] for r in guarded), default=0)
     lines = [
         _RS_BEGIN,
         "## Real-scale G probe (71k-vocab, frozen bench config)",
@@ -302,13 +310,16 @@ def realscale_sweep(out_path: str = "", quick: bool = False,
         "row-mean — BASELINE.md). The r3 probe above is ~200x denser in",
         "within-group negative correlation than text8; this one has the",
         "real collision structure, so its G verdict transfers to the",
-        "bench corpus 1:1.",
+        "bench corpus 1:1. (pairs/s below is THIS probe run's own rate,",
+        "not the idle-chip bench — see BASELINE.md for bench rates.)",
         "",
-        "| G | final loss | NN purity | cos gap | pairs/s |",
-        "|---|---|---|---|---|",
+        "| G | final loss | Δloss | NN purity | cos gap | pairs/s |",
+        "|---|---|---|---|---|---|",
     ]
     for r in rows:
-        lines.append(f"| {r['shared']} | {r['loss']:.4f} "
+        dl = ("—" if r is ref else
+              f"{(r['loss'] / ref['loss'] - 1) * 100:+.1f}%")
+        lines.append(f"| {r['shared']} | {r['loss']:.4f} | {dl} "
                      f"| {r['nn_purity']:.3f} | {r['cos_gap']:.3f} "
                      f"| {r['pairs_per_sec'] / 1e6:.2f}M |")
     lines += [
@@ -327,9 +338,15 @@ def realscale_sweep(out_path: str = "", quick: bool = False,
         lines.append(f"| {r['shared']} | {cells} |")
     lines += [
         "",
-        (f"Parity bar (purity within 0.02 and cos-gap within 10% of the "
-         f"exact-draw G=0 baseline, in aggregate AND in every frequency "
-         f"band): largest G at parity = **{best}**."),
+        (f"Parity bar (ONE-SIDED degradation bar: purity within 0.02 "
+         f"below and cos-gap no more than 10% below the exact-draw G=0 "
+         f"baseline — improvement passes — in aggregate AND in every "
+         f"frequency band): largest G at parity = **{best}**. "
+         f"Loss guard (final training loss within 1% of exact-draw — "
+         f"the check the saturating cluster bar cannot make): largest "
+         f"G = **{best_guarded}**. The bench default is the loss-guarded "
+         f"value, additionally capped by measured on-chip throughput "
+         f"saturation (BASELINE.md)."),
         _RS_END,
     ]
     text = "\n".join(lines)
@@ -350,7 +367,7 @@ def main(argv=None):
     ap.add_argument("--realscale", action="store_true",
                     help="71k-vocab G probe at the frozen bench config "
                          "(appends its own section to --out)")
-    ap.add_argument("--gs", default="0,4,8,16",
+    ap.add_argument("--gs", default="0,16,32,64",
                     help="comma-separated G values for --realscale")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU backend (e.g. accelerator tunnel "
@@ -448,7 +465,10 @@ def main(argv=None):
             "negatives from the unigram^0.75 law, they are just correlated",
             "within a group).",
             (f"Parity bar: purity within 0.02 and cos-gap within 10% of the "
-             f"reference-semantics baseline. Largest G at parity: **{best}**."
+             f"reference-semantics baseline (one-sided — improvement "
+             f"passes). Largest G at parity: **{best}** — on THIS harsh "
+             f"probe; the real-scale probe below supersedes it for the "
+             f"bench default (loss-guarded, see its section)."
              if best else
              "No swept G met the parity bar (purity within 0.02, cos-gap "
              "within 10% of baseline)."),
@@ -457,9 +477,8 @@ def main(argv=None):
             "vocab makes within-group negative correlation ~200x denser",
             "than text8's 71k vocab (each word re-drawn ~G*K*B/(G*vocab)",
             "times per step), so a G that passes here has headroom at",
-            "real vocab sizes. Throughput context (bench.py, text8 shape,",
-            "one v5e chip): exact draws ~3.1M pairs/s, G=4 ~6.9M, G=8",
-            "~8.7M — the bench default is the largest G at parity.",
+            "real vocab sizes — which is why the real-scale probe, not",
+            "this one, sets the bench default.",
         ]
     lines += [
         "",

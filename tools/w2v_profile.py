@@ -57,7 +57,7 @@ def main(argv=None):
     ap.add_argument("--impl", default="scatter",
                     choices=["scatter", "segsum", "split8"])
     ap.add_argument("--shared", type=int, default=0,
-                    help="shared_negatives group size G (bench default 4)")
+                    help="shared_negatives group size G (bench default 64)")
     ap.add_argument("--trace", default="")
     args = ap.parse_args(argv)
 
