@@ -129,7 +129,7 @@ def _gather_kernel(idx_ref, table_ref, out_ref, scratch, sems):
     jax.lax.fori_loop(0, DEPTH, drain, 0)
 
 
-def pallas_gather(table, idx):
+def pallas_gather(table, idx, interpret: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -150,6 +150,7 @@ def pallas_gather(table, idx):
             pltpu.VMEM((DEPTH * TILE, DIM), jnp.float32),
             pltpu.SemaphoreType.DMA((DEPTH,)),
         ],
+        interpret=interpret,
     )(idx, table)
 
 
@@ -158,17 +159,24 @@ def _rmw_kernel(idx_ref, grad_ref, table_in_ref, table_out_ref,
     """Serial per-row read-modify-write via enclosing-tile DMA. Serial
     because zipf duplicates make any pipelined RMW racy: row i's tile
     write-back must land before a colliding row j>i reads the same
-    tile — and collisions are the workload, not a corner case."""
+    tile — and collisions are the workload, not a corner case.
+
+    Reads AND writes go through ``table_out_ref``: on TPU the aliased
+    input is the same buffer, but interpret mode gives the input ref a
+    stale snapshot — reading it would lose earlier duplicate-row
+    updates (caught by tests/test_kernel_probe.py)."""
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    del table_in_ref     # aliased to table_out_ref; RMW uses one view
+
     def body(i, _):
         idx = idx_ref[i]
         tile = _tile_slice(pl, idx)
-        pltpu.make_async_copy(table_in_ref.at[tile, :], scratch,
+        pltpu.make_async_copy(table_out_ref.at[tile, :], scratch,
                               sem_in).start()
-        pltpu.make_async_copy(table_in_ref.at[tile, :], scratch,
+        pltpu.make_async_copy(table_out_ref.at[tile, :], scratch,
                               sem_in).wait()
         row = pl.ds(idx % TILE, 1)
         scratch[row, :] = scratch[row, :] + grad_ref[pl.ds(i, 1), :]
@@ -181,7 +189,7 @@ def _rmw_kernel(idx_ref, grad_ref, table_in_ref, table_out_ref,
     jax.lax.fori_loop(0, CHUNK, body, 0)
 
 
-def pallas_rmw(table, idx, grads):
+def pallas_rmw(table, idx, grads, interpret: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -198,13 +206,14 @@ def pallas_rmw(table, idx, grads):
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        out_shape=jax.ShapeDtypeStruct((VOCAB, DIM), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((table.shape[0], DIM), jnp.float32),
         input_output_aliases={2: 0},
         scratch_shapes=[
             pltpu.VMEM((TILE, DIM), jnp.float32),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
+        interpret=interpret,
     )(idx, grads, table)
 
 
