@@ -1,0 +1,71 @@
+"""Gather- vs scatter-compaction equivalence (Word2VecConfig.compact_impl).
+
+The device-corpus sampler over-draws M = oversample*B candidates and
+packs the survivors into the B training slots. Round 4 added a
+gather-based pack (searchsorted over the survivor prefix-sum) because
+the scatter pack had grown to ~25% of the G=64 step; both must place
+identical rows in identical slots — the training step is then
+bit-identical, so this asserts the strongest possible contract: same
+seed, same corpus => same losses and same final tables.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+import pytest
+
+
+def _run(mv, impl: str, cbow: bool):
+    from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
+
+    rng = np.random.default_rng(3)
+    vocab, dim, B = 400, 16, 4096
+    probs = 1.0 / np.arange(1, vocab + 1)
+    probs /= probs.sum()
+    counts = np.maximum(probs * 1e6, 5)
+    ids = rng.choice(vocab, size=60_000, p=probs).astype(np.int32)
+    sents = (np.arange(ids.size) // 150).astype(np.int32)
+
+    cfg = Word2VecConfig(vocab_size=vocab, embedding_size=dim,
+                         negative=3, batch_size=B, seed=11,
+                         oversample=2.0, cbow=cbow, compact_impl=impl)
+    w_in = mv.create_table("matrix", vocab, dim, init_value="random",
+                           seed=9, name=f"ci_in_{impl}_{cbow}")
+    w_out = mv.create_table("matrix", vocab, dim,
+                            name=f"ci_out_{impl}_{cbow}")
+    m = Word2Vec(cfg, w_in, w_out, counts=counts)
+    m.load_corpus_chunk(ids, sents, np.zeros(vocab, np.float32))
+    losses = []
+    for _ in range(4):
+        loss, count = m.train_device_steps(2)
+        losses.append(float(loss))
+    assert float(count) > 0
+    return losses, np.asarray(w_in.get()), np.asarray(w_out.get())
+
+
+@pytest.mark.parametrize("cbow", [False, True],
+                         ids=["skipgram", "cbow"])
+def test_gather_and_scatter_compaction_train_identically(mv_session, cbow):
+    # cbow additionally packs a 2-D ok mask and re-masks with ex_packed —
+    # the multi-dim branch of both impls
+    l_g, in_g, out_g = _run(mv_session, "gather", cbow)
+    l_s, in_s, out_s = _run(mv_session, "scatter", cbow)
+    assert np.allclose(l_g, l_s, rtol=0, atol=0), (l_g, l_s)
+    assert np.array_equal(in_g, in_s)
+    assert np.array_equal(out_g, out_s)
+
+
+def test_unknown_compact_impl_fails_loudly(mv_session):
+    import pytest
+
+    from multiverso_tpu.log import FatalError
+    from multiverso_tpu.models.word2vec import Word2Vec, Word2VecConfig
+
+    cfg = Word2VecConfig(vocab_size=64, embedding_size=8, negative=2,
+                         batch_size=64, compact_impl="typo")
+    w_in = mv_session.create_table("matrix", 64, 8, name="ci_bad_in")
+    w_out = mv_session.create_table("matrix", 64, 8, name="ci_bad_out")
+    with pytest.raises(FatalError):
+        Word2Vec(cfg, w_in, w_out, counts=np.ones(64))
