@@ -231,7 +231,7 @@ def dryrun_sweep(dps):
 
 
 def run_sweep(n_devices: int = 8, quick: bool = False):
-    dps = [d for d in (1, 2, 4, 8) if d <= n_devices]
+    dps = [d for d in (1, 2, 4, 8, 16, 32) if d <= n_devices]
     cores = os.cpu_count() or 1
     if quick:
         w2v = quick_sweep(dps)
