@@ -171,11 +171,11 @@ def main() -> int:
     float(loss)
 
     # 20 x 25-step dispatches — FROZEN since r3 for cross-round
-    # comparability. Note the window length is itself a variable on this
-    # part: doubling to 40 iters measures ~7.7M pairs/s vs ~10M at 20
-    # (sustained load settles below the short-burst rate — see
-    # BASELINE.md "burst vs sustained"); changing iters would change the
-    # metric, so it stays at the r3 value and the effect is disclosed.
+    # comparability. Do not lengthen the window: past ~32 in-flight
+    # dispatches the tunnel's completion path adds ~1.5 s of host-side
+    # overhead (40 iters wall-measures 7.7-8.6M while the xprof device
+    # spans stay a constant 152.7 ms = 10.7M pairs/s on-device, zero
+    # gaps — BASELINE.md "window-length effect").
     iters = 20 if not degraded else 2
     counts = []
     t0 = time.perf_counter()
