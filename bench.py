@@ -11,12 +11,13 @@ Negative draws are group-shared at G=64 (round 4: the 71k-vocab
 real-scale probe — `tools/embedding_quality.py --realscale`, the frozen
 bench config with planted clusters — holds full parity at every probed
 G through 256 in aggregate AND in every zipf frequency band; the
-default is capped at G=64 anyway because (a) final training loss drifts
-monotonically off the exact-draw semantics (+0.8% at G=64, +1.8% at
-G=256 — the planted-cluster bar saturates and stops discriminating, so
-a loss guard caps what the bar cannot) and (b) measured throughput
-SATURATES at G=64 (10.3M pairs/s; G=128 is no faster) — larger G buys
-nothing and costs negative-sample diversity. The r3 G=4 cap came from a
+default is capped at G=64 anyway because the <1% loss guard binds
+first: final training loss drifts monotonically off the exact-draw
+semantics (+0.8% at G=64, +1.4% at G=128 — the planted-cluster bar
+saturates and stops discriminating, so a loss guard caps what the bar
+cannot), while device-rate gains past G=64 are +2-3% per doubling
+(xprof spans: 10.73M on-device at G=64, 11.06M at G=128) — not worth
+double the loss drift. The r3 G=4 cap came from a
 deliberately-harsh 332-word probe whose within-group negative
 correlation is ~200x denser than text8's. Exact per-pair draws remain
 one flag away, `-shared_negatives=0`.) Updates use the capped row-mean
