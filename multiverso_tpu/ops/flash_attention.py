@@ -55,6 +55,20 @@ def _pad_to(x: jax.Array, size: int, axis: int) -> jax.Array:
     return jnp.pad(x, widths)
 
 
+def _pad_dim(d: int) -> int:
+    """Head-dim block width: sublane-aligned d stays UNPADDED.
+
+    Pallas pads partial lane blocks inside the VMEM pipeline for free;
+    padding d to the 128 lane width in HBM instead (the r3 design)
+    materialised pad/slice copies around every kernel call AND doubled
+    every d-axis buffer at the common head_dim=64 — measured 30% of the
+    flagship LM train step (xprof per-op, tools/lm_mfu.py shape). Only
+    a non-multiple-of-8 d (never seen in practice) still pads, to the
+    f32 sublane tile.
+    """
+    return d if d % 8 == 0 else -(-d // 8) * 8
+
+
 def _fa_kernel(offs_ref, q_ref, k_ref, v_ref,
                o_ref, m_ref, l_ref,
                m_scr, l_scr, acc_scr,
@@ -149,7 +163,7 @@ def _fa_call(q, k, v, q_base, k_base, *, causal: bool, scale: float,
     block_k = min(block_k, max(_LANES, 1 << (sk - 1).bit_length()))
     sq_p = -(-sq // block_q) * block_q
     sk_p = -(-sk // block_k) * block_k
-    d_p = -(-d // _LANES) * _LANES
+    d_p = _pad_dim(d)
 
     # [s, h, d] -> [h, s, d], padded
     qt = _pad_to(_pad_to(jnp.transpose(q, (1, 0, 2)), sq_p, 1), d_p, 2)
@@ -382,7 +396,7 @@ def _bwd_call(q, k, v, g, lse, delta, q_base, k_base, *, causal: bool,
     block_k = min(block_k, max(_LANES, 1 << (sk - 1).bit_length()))
     sq_p = -(-sq // block_q) * block_q
     sk_p = -(-sk // block_k) * block_k
-    d_p = -(-d // _LANES) * _LANES
+    d_p = _pad_dim(d)
     nq = sq_p // block_q
     nk = sk_p // block_k
 
