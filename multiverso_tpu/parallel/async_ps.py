@@ -172,7 +172,14 @@ class AsyncDeltaBus:
             try:
                 from .p2p import P2PTransport
 
-                self._p2p = P2PTransport(self._rank, self._size, client)
+                # a restarted bus in the same process resumes streams from
+                # the module-level consumed counters (a graceful restart
+                # drained first, so these equal each peer's published count)
+                self._p2p = P2PTransport(
+                    self._rank, self._size, client,
+                    initial_resume={r: _consumed.get(r, 0)
+                                    for r in range(self._size)
+                                    if r != self._rank})
             except Exception as exc:
                 Log.error("async PS: p2p transport unavailable (%s)", exc)
             # the payload plane must be AGREED: one rank silently falling
@@ -293,6 +300,10 @@ class AsyncDeltaBus:
                 return
             # recursive: also removes the nested ack key
             self._client.key_value_delete(f"mvps/{self._rank}/{seq}")
+            if self._p2p is not None:
+                # fully acked -> no reconnect can ask for it again; drop
+                # it from the transport's retained replay window
+                self._p2p.release(seq)
             self._outstanding.popleft()
             self._inflight_bytes -= nbytes
 

@@ -16,18 +16,35 @@ frontier, and barriers. Topology:
 * every rank listens on an ephemeral port and advertises
   ``{label}/ep/{rank} = host:port`` in the KV;
 * every rank SUBSCRIBES to each peer (connects to the peer's listener
-  and sends its own rank) — records flow publisher -> subscriber down
-  that connection, so each pair has one connection per direction and
-  ordering per publisher is TCP's;
+  and sends its own rank + the sequence number it wants to resume
+  from) — records flow publisher -> subscriber down that connection,
+  so each pair has one connection per direction and ordering per
+  publisher is TCP's;
 * frames are ``<QI`` (sequence number, length) + payload; the sequence
   number is authoritative — a gap means the transport invariant broke
   and the bus fails loudly rather than applying around it.
 
-Threads: one accept loop, one sender per subscriber (drains a per-peer
-deque, so a slow consumer never blocks publishes to others — the
-reference's per-peer send queue, ``mpi_net.h:199`` ``msg_queues_``), one
-receiver per subscription (appends to an in-order inbox the bus's drain
-thread consumes). All daemon; :meth:`stop` closes sockets and joins.
+Reconnect (r5; the reference's ZMQ mesh reconnects transparently,
+``zmq_net.h:171-228``): a broken subscription re-fetches the
+publisher's endpoint and reconnects with a hello carrying the next
+sequence number it expects; the publisher replays from its RETAINED
+window. The retained window holds exactly the publisher's un-GC'd
+records — the bus's ack frontier (`async_ps.AsyncDeltaBus._reap_acks`)
+calls :meth:`release` as records become fully acknowledged, so a
+record any consumer might still need (it has not acked it) is always
+replayable, and retained memory is bounded by the bus's in-flight
+backpressure watermark. A duplicate subscription from the same peer
+REPLACES the old sender (the old connection is closed and its thread
+exits) instead of leaking a second thread on the same stream.
+Permanent peer death stays the FailureDetector's job (`mark_dead`);
+the transport itself retries transient breaks indefinitely.
+
+Threads: one accept loop, one sender per live subscription (a cursor
+over the retained window — a slow consumer never blocks publishes to
+others; the reference's per-peer send queue, ``mpi_net.h:199``
+``msg_queues_``), one receiver per subscription (appends to an
+in-order inbox the bus's drain thread consumes). All daemon;
+:meth:`stop` closes sockets and joins.
 """
 
 from __future__ import annotations
@@ -42,7 +59,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 from ..log import Log
 
 _FRAME = struct.Struct("<QI")   # seq, payload length
-_HELLO = struct.Struct("<I")    # subscriber rank
+_HELLO = struct.Struct("<IQ")   # subscriber rank, resume-from seq
 
 
 def _local_host() -> str:
@@ -63,25 +80,31 @@ class P2PTransport:
     """Direct-socket record plane between the processes of one bus."""
 
     def __init__(self, rank: int, size: int, client,
-                 label: str = "mvps", connect_timeout_s: float = 60.0
-                 ) -> None:
+                 label: str = "mvps", connect_timeout_s: float = 60.0,
+                 initial_resume: Optional[Dict[int, int]] = None) -> None:
         self._rank = rank
         self._size = size
         self._client = client
         self._label = label
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        # publisher side: per-subscriber outboxes + their sender threads
-        self._out: Dict[int, Deque[Tuple[int, bytes]]] = {
-            r: collections.deque() for r in range(size) if r != rank}
+        # publisher side: retained un-GC'd records (seq -> payload) + the
+        # next seq to be published; per-subscriber senders are cursors
+        # over this window (guarded by _lock / signalled via _out_cv)
+        self._retained: Dict[int, bytes] = {}
+        self._next_seq: Optional[int] = None
         self._out_cv = threading.Condition(self._lock)
-        self._senders: Dict[int, threading.Thread] = {}
-        # consumer side: per-publisher in-order inboxes
+        # peer -> sender state dict; identity is the liveness token — a
+        # sender whose state is no longer registered has been replaced
+        self._senders: Dict[int, dict] = {}
+        # consumer side: per-publisher in-order inboxes + next expected seq
         self._in: Dict[int, Deque[Tuple[int, bytes]]] = {
             r: collections.deque() for r in range(size) if r != rank}
+        self._expect: Dict[int, int] = {
+            r: int((initial_resume or {}).get(r, 0)) for r in self._in}
         self._dead: set = set()
         self._threads: List[threading.Thread] = []
-        self._conns: List[socket.socket] = []
+        self._conns: set = set()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -100,17 +123,48 @@ class P2PTransport:
     def _spawn(self, fn, name, *args) -> None:
         t = threading.Thread(target=fn, name=name, args=args, daemon=True)
         t.start()
+        # prune retired senders so reconnect churn can't grow the join
+        # list without bound
+        self._threads = [x for x in self._threads if x.is_alive()]
         self._threads.append(t)
+
+    def _track(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.add(conn)
+
+    def _close(self, conn: Optional[socket.socket]) -> None:
+        if conn is None:
+            return
+        with self._lock:
+            self._conns.discard(conn)
+        try:
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     # -- publisher side ----------------------------------------------------
     def send(self, seq: int, payload: bytes) -> None:
-        """Enqueue one record for every live subscriber (non-blocking; the
-        bus's in-flight-bytes watermark bounds total queued memory)."""
+        """Retain one record and wake the per-subscriber senders
+        (non-blocking; the bus's in-flight-bytes watermark bounds the
+        retained window — see :meth:`release`)."""
         with self._out_cv:
-            for r, q in self._out.items():
-                if r not in self._dead:
-                    q.append((seq, payload))
+            self._retained[seq] = payload
+            self._next_seq = seq + 1
             self._out_cv.notify_all()
+
+    def release(self, seq: int) -> None:
+        """Drop a fully-acknowledged record from the retained window.
+
+        Called by the bus's ack-GC frontier (`_reap_acks`) — once every
+        live consumer acked ``seq``, no reconnect can legitimately ask
+        for it again (a consumer only acks what it consumed, and resumes
+        strictly after what it consumed)."""
+        with self._out_cv:
+            self._retained.pop(seq, None)
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -120,38 +174,76 @@ class P2PTransport:
                 return                       # listener closed by stop()
             try:
                 hello = self._read_exact(conn, _HELLO.size)
-                (peer,) = _HELLO.unpack(hello)
+                peer, resume = _HELLO.unpack(hello)
             except OSError:
                 conn.close()
                 continue
+            if peer in self._dead:
+                # a declared-dead (or out-of-contract resurrected) peer
+                # gets no stream; closing here keeps the reject bounded
+                conn.close()
+                continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns.append(conn)
+            self._track(conn)
+            state = {"peer": peer, "conn": conn, "cursor": resume}
             with self._lock:
-                self._senders[peer] = t = threading.Thread(
-                    target=self._send_loop, name=f"p2p-send-{peer}",
-                    args=(peer, conn), daemon=True)
-            t.start()
-            self._threads.append(t)
+                old = self._senders.pop(peer, None)
+                self._senders[peer] = state
+            # a duplicate subscribe REPLACES the old sender: closing its
+            # socket errors out any blocked send; the registry check below
+            # exits it even when it was idle-waiting
+            if old is not None:
+                self._close(old["conn"])
+            self._spawn(self._send_loop, f"p2p-send-{peer}", state)
 
-    def _send_loop(self, peer: int, conn: socket.socket) -> None:
-        q = self._out[peer]
+    def _send_loop(self, state: dict) -> None:
+        peer: int = state["peer"]
+        conn: socket.socket = state["conn"]
+        cursor: int = state["cursor"]
         while True:
             with self._out_cv:
-                while not q and not self._stop.is_set():
+                while (not self._stop.is_set()
+                       and self._senders.get(peer) is state
+                       and peer not in self._dead
+                       and (self._next_seq is None
+                            or cursor >= self._next_seq)):
                     self._out_cv.wait(0.2)
-                if self._stop.is_set() and not q:
-                    return
-                seq, payload = q.popleft()
+                if (self._stop.is_set() or peer in self._dead
+                        or self._senders.get(peer) is not state):
+                    if self._senders.get(peer) is state:
+                        self._senders.pop(peer, None)
+                    break
+                payload = self._retained.get(cursor)
+            if payload is None:
+                # only reachable for a resurrected peer whose records were
+                # GC'd after it was declared dead — out of contract.
+                # Mark it dead transport-side so its retry loop gets a
+                # bounded reject at accept instead of a fresh sender +
+                # error line per attempt.
+                Log.error("p2p: rank %d resumed from seq %d which is "
+                          "already released (declared dead earlier?); "
+                          "rejecting its stream", peer, cursor)
+                with self._out_cv:
+                    self._dead.add(peer)
+                    self._senders.pop(peer, None)
+                break
             try:
                 # sendmsg scatters header + payload in one syscall without
                 # concatenating (the concat alone costs a payload-sized
                 # memcpy per subscriber on multi-MB records)
-                self._send_frame(conn, seq, payload)
-            except OSError as exc:
-                if not self._stop.is_set() and peer not in self._dead:
-                    Log.error("p2p: send to rank %d failed: %s (peer dead? "
-                              "see parallel.FailureDetector)", peer, exc)
-                return
+                self._send_frame(conn, cursor, payload)
+            except OSError:
+                # the subscriber reconnects with its own resume point;
+                # this sender just retires
+                with self._lock:
+                    if self._senders.get(peer) is state:
+                        self._senders.pop(peer, None)
+                break
+            cursor += 1
+        # every exit path closes + untracks this connection (a replaced
+        # sender's conn was already closed by the accept loop — _close is
+        # idempotent)
+        self._close(conn)
 
     @staticmethod
     def _send_frame(conn: socket.socket, seq: int, payload: bytes) -> None:
@@ -181,44 +273,94 @@ class P2PTransport:
             got += r
         return buf
 
-    def _subscribe(self, publisher: int, timeout_s: float) -> None:
-        key = f"{self._label}/ep/{publisher}"
-        try:
-            ep = self._client.blocking_key_value_get(
-                key, int(timeout_s * 1000))
-        except Exception as exc:
-            Log.error("p2p: no endpoint from rank %d within %.0f s: %s",
-                      publisher, timeout_s, exc)
-            return
+    def _endpoint(self, publisher: int, timeout_ms: int) -> Tuple[str, int]:
+        ep = self._client.blocking_key_value_get(
+            f"{self._label}/ep/{publisher}", timeout_ms)
         host, _, port = str(ep).rpartition(":")
+        return host, int(port)
+
+    def _connect(self, publisher: int, first: bool,
+                 timeout_s: float) -> Optional[socket.socket]:
+        """One connected+hello'd socket to ``publisher``, or None.
+
+        The FIRST subscription bounds endpoint discovery by
+        ``timeout_s`` (a peer that never comes up fails the bus
+        handshake anyway); reconnects retry indefinitely — transient
+        breaks are the transport's job, permanent death is the
+        FailureDetector's (`mark_dead` ends the retries)."""
         deadline = time.monotonic() + timeout_s
-        conn = None
-        while conn is None and not self._stop.is_set():
+        while not self._stop.is_set() and publisher not in self._dead:
             try:
-                conn = socket.create_connection((host, int(port)), timeout=5)
-            except OSError:
-                if time.monotonic() > deadline:
-                    Log.error("p2p: cannot connect to rank %d at %s",
-                              publisher, ep)
-                    return
+                # re-fetch each attempt: a restarted publisher
+                # re-advertises a NEW ephemeral port
+                host, port = self._endpoint(publisher, 5_000)
+                conn = socket.create_connection((host, port), timeout=5)
+            except Exception as exc:
+                if first and time.monotonic() > deadline:
+                    Log.error("p2p: no endpoint from rank %d within "
+                              "%.0f s: %s", publisher, timeout_s, exc)
+                    return None
                 time.sleep(0.05)
-        if conn is None:
-            return
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._conns.append(conn)
-        try:
-            conn.sendall(_HELLO.pack(self._rank))
-            inbox = self._in[publisher]
-            while not self._stop.is_set():
-                hdr = self._read_exact(conn, _FRAME.size)
-                seq, length = _FRAME.unpack(hdr)
-                payload = self._read_exact(conn, length)
+                continue
+            # create_connection leaves its 5 s connect timeout on the
+            # socket; a publisher idle longer than that (jit compile,
+            # barrier) must read as silence, not a broken stream
+            conn.settimeout(None)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
                 with self._lock:
-                    inbox.append((seq, payload))
-        except OSError as exc:
-            if not self._stop.is_set() and publisher not in self._dead:
-                Log.error("p2p: stream from rank %d broke: %s (peer dead? "
-                          "see parallel.FailureDetector)", publisher, exc)
+                    resume = self._expect[publisher]
+                conn.sendall(_HELLO.pack(self._rank, resume))
+            except OSError:
+                self._close(conn)
+                time.sleep(0.05)
+                continue
+            self._track(conn)
+            return conn
+        return None
+
+    def _subscribe(self, publisher: int, timeout_s: float) -> None:
+        first = True
+        backoff = 0.05
+        while not self._stop.is_set() and publisher not in self._dead:
+            conn = self._connect(publisher, first, timeout_s)
+            if conn is None:
+                return
+            first = False
+            inbox = self._in[publisher]
+            delivered = False
+            try:
+                while not self._stop.is_set():
+                    hdr = self._read_exact(conn, _FRAME.size)
+                    seq, length = _FRAME.unpack(hdr)
+                    payload = self._read_exact(conn, length)
+                    with self._lock:
+                        if seq != self._expect[publisher]:
+                            # TCP + replay-from-resume preserve per-
+                            # publisher order; anything else is a broken
+                            # transport invariant (same posture as
+                            # pop_ready / the PART reassembly check)
+                            Log.fatal(
+                                f"p2p: rank {publisher} stream out of "
+                                f"order: got seq {seq}, expected "
+                                f"{self._expect[publisher]}")
+                        inbox.append((seq, payload))
+                        self._expect[publisher] = seq + 1
+                    delivered = True
+            except OSError as exc:
+                if self._stop.is_set() or publisher in self._dead:
+                    return
+                with self._lock:
+                    resume = self._expect[publisher]
+                Log.info("p2p: stream from rank %d broke (%s); "
+                         "reconnecting from seq %d", publisher, exc, resume)
+            finally:
+                self._close(conn)
+            # a stream the publisher keeps closing without delivering
+            # anything (out-of-contract reject) backs off instead of
+            # spinning the accept loop at ~20 Hz
+            backoff = 0.05 if delivered else min(backoff * 2, 2.0)
+            time.sleep(backoff)
 
     def pop_ready(self, publisher: int, expected_seq: int
                   ) -> Optional[bytes]:
@@ -241,13 +383,12 @@ class P2PTransport:
 
     # -- failure handling (wired by the bus, driven by FailureDetector) ----
     def mark_dead(self, ranks) -> None:
-        """Stop queueing to / expecting from dead peers; drop their queued
-        output so a wedged sender can't pin memory."""
+        """Stop queueing to / expecting from / reconnecting to dead peers;
+        their senders exit and release any cursor state."""
         with self._out_cv:
             for r in ranks:
                 self._dead.add(r)
-                if r in self._out:
-                    self._out[r].clear()
+                self._senders.pop(r, None)
             self._out_cv.notify_all()
 
     def stop(self) -> None:
@@ -256,14 +397,9 @@ class P2PTransport:
             self._listener.close()
         except OSError:
             pass
-        for c in self._conns:
-            try:
-                c.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                c.close()
-            except OSError:
-                pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            self._close(c)
         for t in self._threads:
             t.join(timeout=5)
