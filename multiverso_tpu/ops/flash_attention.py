@@ -60,12 +60,23 @@ def _pad_dim(d: int) -> int:
 
     Pallas pads partial lane blocks inside the VMEM pipeline for free;
     padding d to the 128 lane width in HBM instead (the r3 design)
-    materialised pad/slice copies around every kernel call AND doubled
-    every d-axis buffer at the common head_dim=64 — measured 30% of the
-    flagship LM train step (xprof per-op, tools/lm_mfu.py shape). Only
-    a non-multiple-of-8 d (never seen in practice) still pads, to the
-    f32 sublane tile.
+    materialises pad/slice copies around every kernel call AND doubles
+    every d-axis buffer at the common head_dim=64. Measured A/B on-chip
+    at the flagship LM shape (r5, xprof device time, 2 runs each,
+    docs/LM_MFU.md): lane-padded 53.94 ms vs unpadded 54.08 ms/step at
+    seq 1024 — a 0.27% wash. The unpadded form is kept for its halved
+    VMEM/HBM d-axis footprint, not for step time; the r4 snapshot's
+    "30% of the train step" attribution was the whole flash-vs-XLA
+    attention saving (78.2 -> 52.3 ms/step), not the padding delta —
+    corrected here.
+    ``MV_FLASH_PAD_LANES=1`` re-enables lane padding for measurement.
+    Only a non-multiple-of-8 d (never seen in practice) otherwise pads,
+    to the f32 sublane tile.
     """
+    import os
+
+    if os.environ.get("MV_FLASH_PAD_LANES") == "1":
+        return -(-d // _LANES) * _LANES
     return d if d % 8 == 0 else -(-d // 8) * 8
 
 
@@ -151,6 +162,52 @@ def _fa_kernel(offs_ref, q_ref, k_ref, v_ref,
             o_ref[0] = acc_scr[:].astype(o_ref.dtype)
 
 
+def _fa_kernel_single(offs_ref, q_ref, k_ref, v_ref,
+                      o_ref, m_ref, l_ref,
+                      *, scale: float, causal: bool, normalize: bool,
+                      kv_len: int, block_q: int, precision):
+    """One-k-block forward (``nk == 1``): plain softmax, no online pass.
+
+    With the whole K/V in one block the flash running-max/correction
+    machinery (VMEM scratch carries, acc rescale per k-step) is pure
+    overhead — the r5 trace measured the general kernel at ~25% of bf16
+    peak at the flagship LM shape vs ~43% for the one-pass backward.
+    This kernel computes max/exp/sum/divide in one sweep. Outputs match
+    the general kernel's contract exactly (same m/l row-stat tiles), so
+    the custom VJP and ring merges are unchanged.
+    """
+    q_base = offs_ref[0]
+    k_base = offs_ref[1]
+    qi = pl.program_id(1)
+    q = q_ref[0]                                        # [bq, d]
+    k = k_ref[0]                                        # [sk, d]
+    v = v_ref[0]
+    sk = k.shape[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32) * scale      # [bq, sk]
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, sk), 1)
+    mask = k_pos < kv_len
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, sk), 0)
+        mask = jnp.logical_and(mask, k_base + k_pos <= q_base + q_pos)
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=1, keepdims=True)                # [bq, 1]
+    m_safe = jnp.where(m <= _NEG_INF, 0.0, m)
+    p = jnp.exp(s - m_safe) * (s > _NEG_INF)             # [bq, sk]
+    l = jnp.sum(p, axis=1, keepdims=True)                # [bq, 1]
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        precision=precision, preferred_element_type=jnp.float32)
+    m_ref[0, 0] = jnp.broadcast_to(m[:, 0][None, :], m_ref.shape[2:])
+    l_ref[0, 0] = jnp.broadcast_to(l[:, 0][None, :], l_ref.shape[2:])
+    if normalize:
+        o_ref[0] = (pv / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    else:
+        o_ref[0] = pv.astype(o_ref.dtype)
+
+
 def _fa_call(q, k, v, q_base, k_base, *, causal: bool, scale: float,
              normalize: bool, block_q: int, block_k: int,
              interpret: Optional[bool], precision=None):
@@ -173,6 +230,44 @@ def _fa_call(q, k, v, q_base, k_base, *, causal: bool, scale: float,
 
     nq = sq_p // block_q
     nk = sk_p // block_k
+
+    # normalized attention matches the input dtype — written AT that
+    # dtype inside the kernel epilogue (see out_dtype below)
+    if nk == 1:
+        # whole K/V in one block: plain-softmax kernel, no online pass
+        single_grid = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(h, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d_p), lambda hi, qi, offs: (hi, qi, 0)),
+                pl.BlockSpec((1, sk_p, d_p), lambda hi, qi, offs: (hi, 0, 0)),
+                pl.BlockSpec((1, sk_p, d_p), lambda hi, qi, offs: (hi, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d_p), lambda hi, qi, offs: (hi, qi, 0)),
+                pl.BlockSpec((1, 1, 8, block_q), lambda hi, qi, offs: (hi, qi, 0, 0)),
+                pl.BlockSpec((1, 1, 8, block_q), lambda hi, qi, offs: (hi, qi, 0, 0)),
+            ],
+        )
+        out_dtype = q.dtype if normalize else jnp.float32
+        out, m, l = pl.pallas_call(
+            functools.partial(
+                _fa_kernel_single, scale=scale, causal=causal,
+                normalize=normalize, kv_len=sk, block_q=block_q,
+                precision=precision),
+            grid_spec=single_grid,
+            out_shape=[
+                jax.ShapeDtypeStruct((h, sq_p, d_p), out_dtype),
+                jax.ShapeDtypeStruct((h, nq, 8, block_q), jnp.float32),
+                jax.ShapeDtypeStruct((h, nq, 8, block_q), jnp.float32),
+            ],
+            interpret=interpret,
+        )(offs, qt, kt, vt)
+        out = jnp.transpose(out[:, :sq, :d], (1, 0, 2))
+        m = m[:, :, 0, :].reshape(h, sq_p)[:, :sq]
+        l = l[:, :, 0, :].reshape(h, sq_p)[:, :sq]
+        return out, m, l
+
     kernel = functools.partial(
         _fa_kernel, scale=scale, causal=causal, normalize=normalize,
         kv_len=sk, block_q=block_q, block_k=block_k, precision=precision)
@@ -196,21 +291,23 @@ def _fa_call(q, k, v, q_base, k_base, *, causal: bool, scale: float,
             pltpu.VMEM((block_q, d_p), jnp.float32),
         ],
     )
+    # normalized attention matches the input dtype — written AT that
+    # dtype inside the kernel epilogue, so no f32 round trip through HBM
+    # (a post-kernel convert measured ~1 ms/step at the flagship LM
+    # shape). Un-normalized partials stay f32 so ring-step merges don't
+    # accumulate rounding.
+    out_dtype = q.dtype if normalize else jnp.float32
     out, m, l = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((h, sq_p, d_p), jnp.float32),
+            jax.ShapeDtypeStruct((h, sq_p, d_p), out_dtype),
             jax.ShapeDtypeStruct((h, nq, 8, block_q), jnp.float32),
             jax.ShapeDtypeStruct((h, nq, 8, block_q), jnp.float32),
         ],
         interpret=interpret,
     )(offs, qt, kt, vt)
     out = jnp.transpose(out[:, :sq, :d], (1, 0, 2))
-    if normalize:
-        # normalized attention matches the input dtype; un-normalized
-        # partials stay f32 so ring-step merges don't accumulate rounding
-        out = out.astype(q.dtype)
     m = m[:, :, 0, :].reshape(h, sq_p)[:, :sq]
     l = l[:, :, 0, :].reshape(h, sq_p)[:, :sq]
     return out, m, l
@@ -369,6 +466,69 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, lse_ref,
+                      delta_ref, dq_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                      *, scale: float, causal: bool, kv_len: int,
+                      block_q: int, precision):
+    """Single-pass dq+dk+dv for the ONE-k-block case (``nk == 1``).
+
+    When the whole K/V fits one block (seq <= block_k — the flagship LM
+    shape), the two-pass backward recomputes ``s``/``p`` and ``g v^T``
+    twice (dq kernel + dkv kernel: 7 block dots, 2 exp sweeps). With
+    K/V resident across the q grid this kernel computes them once —
+    5 dots, 1 exp — and accumulates dk/dv in VMEM over the sequential
+    q dimension (the same revisited-output pattern as the dkv pass).
+    Measured on-chip at the flagship LM shape this cuts the train
+    step's flash backward cost (docs/LM_MFU.md r5 numbers).
+    """
+    qi = pl.program_id(1)
+    nq = pl.num_programs(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_base = offs_ref[0]
+    k_base = offs_ref[1]
+    q = q_ref[0]                                        # [bq, d]
+    k = k_ref[0]                                        # [sk, d]
+    v = v_ref[0]
+    g = g_ref[0]                                        # [bq, d]
+    lse = lse_ref[0, 0][0]                              # [bq]
+    delta = delta_ref[0, 0][0]                          # [bq]
+    sk = k.shape[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32) * scale      # [bq, sk]
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, sk), 1)
+    mask = k_pos < kv_len
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, sk), 0)
+        mask = jnp.logical_and(mask, k_base + k_pos <= q_base + q_pos)
+    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # [bq, sk]
+    dv_scr[:] += jax.lax.dot_general(
+        p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+        precision=precision, preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32)              # [bq, sk]
+    ds = p * (dp - delta[:, None]) * scale               # [bq, sk]
+    dq_ref[0] = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_scr[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        precision=precision, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
 def _stat_tiles(x, h, n_blocks, block: int):
     """[h, s] row statistic -> [h, n_blocks, 8, block] blocked tiles (row 0
     carries the payload; 8 sublanes is the minimal f32 tile height)."""
@@ -410,6 +570,44 @@ def _bwd_call(q, k, v, g, lse, delta, q_base, k_base, *, causal: bool,
     lse_t = _stat_tiles(lse_p, h, nq, block_q)
     delta_t = _stat_tiles(_pad_to(delta, sq_p, 1), h, nq, block_q)
     offs = jnp.asarray([q_base, k_base], jnp.int32)
+
+    if nk == 1:
+        # whole K/V resident -> fused single-pass backward (5 dots and
+        # one exp sweep vs the two-pass 7 dots / two sweeps); dk/dv
+        # accumulate across the sequential q grid dimension
+        fq_spec = pl.BlockSpec((1, block_q, d_p), lambda hi, a, offs: (hi, a, 0))
+        fk_spec = pl.BlockSpec((1, sk_p, d_p), lambda hi, a, offs: (hi, 0, 0))
+        fstat_spec = pl.BlockSpec((1, 1, 8, block_q),
+                                  lambda hi, a, offs: (hi, a, 0, 0))
+        fused_grid = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(h, nq),
+            in_specs=[fq_spec, fk_spec, fk_spec, fq_spec, fstat_spec,
+                      fstat_spec],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d_p), lambda hi, a, offs: (hi, a, 0)),
+                pl.BlockSpec((1, sk_p, d_p), lambda hi, a, offs: (hi, 0, 0)),
+                pl.BlockSpec((1, sk_p, d_p), lambda hi, a, offs: (hi, 0, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((sk_p, d_p), jnp.float32),
+                            pltpu.VMEM((sk_p, d_p), jnp.float32)],
+        )
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                              kv_len=sk, block_q=block_q,
+                              precision=precision),
+            grid_spec=fused_grid,
+            out_shape=[
+                jax.ShapeDtypeStruct((h, sq_p, d_p), jnp.float32),
+                jax.ShapeDtypeStruct((h, sk_p, d_p), jnp.float32),
+                jax.ShapeDtypeStruct((h, sk_p, d_p), jnp.float32),
+            ],
+            interpret=interpret,
+        )(offs, qt, kt, vt, gt, lse_t, delta_t)
+        dq = jnp.transpose(dq[:, :sq, :d], (1, 0, 2))
+        dk = jnp.transpose(dk[:, :sk, :d], (1, 0, 2))
+        dv = jnp.transpose(dv[:, :sk, :d], (1, 0, 2))
+        return dq, dk, dv
 
     q_spec = pl.BlockSpec((1, block_q, d_p),
                           lambda hi, a, b, offs: (hi, a, 0))

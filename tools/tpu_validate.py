@@ -28,7 +28,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 
-def _device_ms_one(impl: str, seq: int, mode: str = "fwd") -> None:
+def _device_ms_one(impl: str, seq: int, mode: str = "fwd",
+                   h: int = 8, d: int = 128) -> None:
     """Subprocess entry: trace ONE implementation at ONE shape and print
     the hardware-measured device ms/call. Wall clocks are unreliable on a
     tunneled device (dispatch acks return early), and repeated
@@ -44,7 +45,6 @@ def _device_ms_one(impl: str, seq: int, mode: str = "fwd") -> None:
     from tools.xprof_util import trace_device_ms
 
     rng = np.random.default_rng(0)
-    h, d = 8, 128
     q = jnp.asarray(rng.standard_normal((seq, h, d)), jnp.float32)
     base = flash_attention if impl == "flash" else reference_attention
     if mode == "fwdbwd":
@@ -60,12 +60,13 @@ def _device_ms_one(impl: str, seq: int, mode: str = "fwd") -> None:
     print(f"DEVICE_MS {ms:.6f}")
 
 
-def _device_ms(impl: str, seq: int, mode: str = "fwd") -> float:
+def _device_ms(impl: str, seq: int, mode: str = "fwd",
+               h: int = 8, d: int = 128) -> float:
     import subprocess
 
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--_one", impl,
-         str(seq), mode],
+         str(seq), mode, str(h), str(d)],
         capture_output=True, text=True, timeout=400)
     for line in out.stdout.splitlines():
         if line.startswith("DEVICE_MS "):
@@ -77,7 +78,9 @@ def _device_ms(impl: str, seq: int, mode: str = "fwd") -> float:
 def main(argv=None):
     if argv is None and len(sys.argv) >= 4 and sys.argv[1] == "--_one":
         _device_ms_one(sys.argv[2], int(sys.argv[3]),
-                       sys.argv[4] if len(sys.argv) > 4 else "fwd")
+                       sys.argv[4] if len(sys.argv) > 4 else "fwd",
+                       int(sys.argv[5]) if len(sys.argv) > 5 else 8,
+                       int(sys.argv[6]) if len(sys.argv) > 6 else 128)
         return 0
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="docs/TPU_VALIDATE.json")
@@ -135,20 +138,28 @@ def main(argv=None):
     if not result["interpret"]:
         from multiverso_tpu.ops.flash_attention import FLASH_CROSSOVER_SEQ
 
-        for mode in ("fwd", "fwdbwd"):
-            for seq in (512, 1024, 2048, 4096):
-                t_fa = _device_ms("flash", seq, mode)
-                t_ra = _device_ms("reference", seq, mode)
-                row = {"seq": seq, "heads": 8, "head_dim": 128,
-                       "mode": mode, "flash_ms": t_fa, "reference_ms": t_ra,
-                       "speedup": t_ra / t_fa, "timing": "device (xprof)",
-                       "dispatch": ("flash" if seq >= FLASH_CROSSOVER_SEQ
-                                    else "reference")}
-                result["bench"].append(row)
-                print(f"bench {mode} seq={seq}: flash {t_fa:.3f} ms, "
-                      f"xla-ref {t_ra:.3f} ms, speedup {t_ra/t_fa:.2f}x "
-                      f"(device time; attention='flash' dispatches "
-                      f"{row['dispatch']})", flush=True)
+        # two head shapes: (8, 128) is the historical sweep; (12, 64) is
+        # the flagship LM head shape and exercises the r4 _pad_dim change
+        # (sublane-aligned d=64 runs UNPADDED instead of lane-padded to
+        # 128 — this sweep is the on-chip evidence for that path).
+        for h, d in ((8, 128), (12, 64)):
+            for mode in ("fwd", "fwdbwd"):
+                for seq in (512, 1024, 2048, 4096):
+                    t_fa = _device_ms("flash", seq, mode, h, d)
+                    t_ra = _device_ms("reference", seq, mode, h, d)
+                    row = {"seq": seq, "heads": h, "head_dim": d,
+                           "mode": mode, "flash_ms": t_fa,
+                           "reference_ms": t_ra,
+                           "speedup": t_ra / t_fa,
+                           "timing": "device (xprof)",
+                           "dispatch": ("flash" if seq >= FLASH_CROSSOVER_SEQ
+                                        else "reference")}
+                    result["bench"].append(row)
+                    print(f"bench h={h} d={d} {mode} seq={seq}: "
+                          f"flash {t_fa:.3f} ms, "
+                          f"xla-ref {t_ra:.3f} ms, speedup {t_ra/t_fa:.2f}x "
+                          f"(device time; attention='flash' dispatches "
+                          f"{row['dispatch']})", flush=True)
         # the crossover constant must make attention="flash" never slower:
         # every swept point picks the faster implementation
         bad = [r for r in result["bench"]
