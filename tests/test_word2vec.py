@@ -578,6 +578,60 @@ def test_dp_dispatch_exchange_exact_vs_sequential_oracle(tmp_path):
     np.testing.assert_allclose(got_out, w0_out + tot_out, rtol=0, atol=2e-5)
 
 
+def test_dp_dispatch_keyed_exchange_matches_dense(tmp_path):
+    """dp_exchange="keyed" contract: the dirty-row-union exchange equals
+    the dense exchange exactly — both when the union fits the cap (keyed
+    wire path) and when it overflows (in-dispatch dense fallback via the
+    replicated-predicate cond). HS mode keeps the step RNG-free."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.word2vec import (Word2Vec, Word2VecConfig,
+                                                build_huffman)
+    from multiverso_tpu.runtime import Session
+
+    vocab, dim, dp, S, B = 32, 8, 4, 3, 16
+    counts = np.arange(1, vocab + 1, dtype=np.float64)
+    huff = build_huffman(counts)
+    rng = np.random.default_rng(11)
+    centers = rng.integers(0, vocab, (S, B)).astype(np.int32)
+    contexts = rng.integers(0, vocab, (S, B)).astype(np.int32)
+    mask = np.ones((S, B), np.float32)
+
+    def train(dp_exchange, cap):
+        global rng0
+        Session._instance = None
+        mv.set_flag("mesh_shape", f"{dp},1")
+        mv.init(["dpk", "-log_level=error"])
+        try:
+            cfg = Word2VecConfig(vocab_size=vocab, embedding_size=dim,
+                                 negative=0, hs=True, batch_size=B,
+                                 init_lr=0.1, seed=5, dp_sync="dispatch",
+                                 dp_exchange=dp_exchange, dp_keyed_cap=cap)
+            w_in = mv.create_table("matrix", vocab, dim)
+            w_out = mv.create_table("matrix", vocab, dim)
+            rng0 = np.random.default_rng(99)
+            w_in.add_rows(np.arange(vocab, dtype=np.int32),
+                          rng0.standard_normal((vocab, dim)
+                                               ).astype(np.float32))
+            model = Word2Vec(cfg, w_in, w_out, counts=counts, huffman=huff)
+            model.train_batches(centers, contexts, mask)
+            return np.asarray(w_in.get()), np.asarray(w_out.get())
+        finally:
+            mv.shutdown()
+            mv.set_flag("mesh_shape", "")
+            Session._instance = None
+
+    dense_in, dense_out = train("dense", 0)
+    # cap >= vocab: the union always fits -> pure keyed wire path
+    keyed_in, keyed_out = train("keyed", vocab)
+    np.testing.assert_allclose(keyed_in, dense_in, rtol=0, atol=1e-6)
+    np.testing.assert_allclose(keyed_out, dense_out, rtol=0, atol=1e-6)
+    # cap=8 rows << touched union -> every dispatch takes the overflow
+    # fallback; still exact
+    over_in, over_out = train("keyed", 8)
+    np.testing.assert_allclose(over_in, dense_in, rtol=0, atol=1e-6)
+    np.testing.assert_allclose(over_out, dense_out, rtol=0, atol=1e-6)
+
+
 def test_dp_corpus_stream_advances_per_worker_arc(tmp_path):
     """The stream cursor is a PER-WORKER arc position under
     dp_sync="dispatch": one dispatch consumes n_steps * (M // dp)

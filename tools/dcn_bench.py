@@ -43,6 +43,13 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # shared shape: the scaling_bench real-shape methodology at 8 devices
 VOCAB, DIM, PER_DEV_BATCH, STEPS = 20000, 128, 2048, 25
 
+# keyed-vs-dense comparison at the REAL text8 shape (the frozen bench
+# config: 71,291-word vocab, 200 dims, zipf corpus, G=64 shared
+# negatives) with per-batch dispatches — the cross-HOST sync cadence a
+# DCN deployment would run (dense at this shape is ~57 MB/table per
+# dispatch; ICI affords that, DCN does not)
+R_VOCAB, R_DIM, R_BATCH, R_CAP = 71291, 200, 16384, 12288
+
 _WORKER = textwrap.dedent("""
     import json, os, sys, time
     import jax
@@ -57,6 +64,65 @@ _WORKER = textwrap.dedent("""
     nproc = int(os.environ["MV_NUM_PROCESSES"])
     VOCAB, DIM, PB, S = %(vocab)d, %(dim)d, %(pb)d, %(steps)d
     n_local_dev = 4
+
+    if mode in ("densepb", "keyed"):
+        # real text8 shape, per-batch dispatch, zipf ids (the wire size
+        # of the keyed exchange depends on the touched-row union, so the
+        # id distribution must be the bench corpus's, not uniform)
+        VOCAB, DIM, B = %(r_vocab)d, %(r_dim)d, %(r_batch)d
+        mv.init(["w", "-sync=true", "-mesh_shape=%%d,4" %% nproc,
+                 "-log_level=error"])
+        ranks_ = np.arange(1, VOCAB + 1)
+        probs = 1.0 / ranks_; probs /= probs.sum()
+        cfg = Word2VecConfig(vocab_size=VOCAB, embedding_size=DIM,
+                             negative=5, shared_negatives=64,
+                             batch_size=B, steps_per_call=1, seed=3,
+                             dp_sync="dispatch",
+                             dp_exchange=("keyed" if mode == "keyed"
+                                          else "dense"),
+                             dp_keyed_cap=%(r_cap)d)
+        w_in = mv.create_table("matrix", VOCAB, DIM, init_value="random")
+        w_out = mv.create_table("matrix", VOCAB, DIM)
+        model = Word2Vec(cfg, w_in, w_out,
+                         counts=probs * 4e6)
+        # per-rank stream, but the SAME ids across the two modes so the
+        # dense-vs-keyed dispatch times compare on identical work
+        rng = np.random.default_rng(7 + rank)
+        Bl = B // nproc
+        def draw():
+            c = rng.choice(VOCAB, size=(1, Bl), p=probs).astype(np.int32)
+            t = rng.choice(VOCAB, size=(1, Bl), p=probs).astype(np.int32)
+            return c, t, np.ones((1, Bl), np.float32)
+        c, t, m = draw()
+        float(model.train_batches(c, t, m))          # compile
+        mv.barrier()
+        union = {}
+        if rank == 0:
+            before_in = np.asarray(w_in.get())
+            before_out = np.asarray(w_out.get())
+        c, t, m = draw()
+        float(model.train_batches(c, t, m))
+        if rank == 0:
+            union = {
+                "union_in": int(np.any(
+                    np.asarray(w_in.get()) != before_in, 1).sum()),
+                "union_out": int(np.any(
+                    np.asarray(w_out.get()) != before_out, 1).sum()),
+            }
+        mv.barrier()
+        best = 1e9
+        for _ in range(3):
+            c, t, m = draw()
+            t0 = time.perf_counter()
+            float(model.train_batches(c, t, m))
+            best = min(best, time.perf_counter() - t0)
+        mv.barrier()
+        print(json.dumps({"mode": mode, "rank": rank,
+                          "dispatch_ms": best * 1e3,
+                          "global_pairs_per_dispatch": B, **union}),
+              flush=True)
+        mv.shutdown()
+        sys.exit(0)
 
     if mode == "sync":
         mv.init(["w", "-sync=true", "-mesh_shape=%%d,4" %% nproc,
@@ -107,7 +173,9 @@ def run_mode(mode: str, tmpdir: str, nproc: int = 2):
     script = os.path.join(tmpdir, f"dcn_{mode}.py")
     with open(script, "w") as f:
         f.write(_WORKER % {"repo": _REPO, "vocab": VOCAB, "dim": DIM,
-                           "pb": PER_DEV_BATCH, "steps": STEPS})
+                           "pb": PER_DEV_BATCH, "steps": STEPS,
+                           "r_vocab": R_VOCAB, "r_dim": R_DIM,
+                           "r_batch": R_BATCH, "r_cap": R_CAP})
     procs = []
     for rank in range(nproc):
         env = dict(os.environ)
@@ -185,6 +253,36 @@ def render(res) -> str:
         pps = pairs / (ms / 1e3)
         lines.append(f"| 2 proc x 4 dev, {mode} | {pairs // STEPS} "
                      f"| {ms:.0f} | {pps:.3g} | {pps / base_pps:.2f} |")
+    if "keyed" in res and res["keyed"]:
+        dense_b = R_VOCAB * R_DIM * 4
+        keyed_b = R_VOCAB * 4 + R_CAP * R_DIM * 4
+        u = next((r for r in res["keyed"] if "union_in" in r), {})
+        dms = max(r["dispatch_ms"] for r in res["densepb"])
+        kms = max(r["dispatch_ms"] for r in res["keyed"])
+        lines += [
+            "",
+            "#### Keyed vs dense dispatch at the REAL shape "
+            f"(V={R_VOCAB:,}, D={R_DIM}, per-batch dispatch, B={R_BATCH:,} "
+            "zipf ids, G=64)",
+            "",
+            "| exchange | bytes/table/dispatch | dispatch ms (2-proc) | "
+            "measured dirty union (in / out) |",
+            "|---|---|---|---|",
+            f"| dense (`dp_exchange=\"dense\"`) | {dense_b / 1e6:.1f} MB "
+            f"| {dms:.0f} | — |",
+            f"| keyed (`dp_exchange=\"keyed\"`, cap {R_CAP:,}) "
+            f"| {keyed_b / 1e6:.1f} MB (**{dense_b / keyed_b:.1f}x "
+            f"smaller**) | {kms:.0f} "
+            + "| {} / {} rows |".format(
+                *(f"{u[k]:,}" if k in u else "?"
+                  for k in ("union_in", "union_out"))),
+            "",
+            "Keyed wire = V*4 (psum'd row-moved mask) + cap*D*4 (psum'd "
+            "union rows); exact — an over-cap union falls back to the "
+            "dense psum inside the dispatch (replicated-predicate cond), "
+            "so the cap tunes wire size, never correctness "
+            "(`tests/test_word2vec.py` keyed-vs-dense oracle).",
+        ]
     lines += [
         "",
         "(async trains 2 independent per-process replicas — its row counts "
@@ -220,6 +318,8 @@ def main(argv=None) -> int:
             "single": single_process_reference(),
             "sync": run_mode("sync", td),
             "async": run_mode("async", td),
+            "densepb": run_mode("densepb", td),
+            "keyed": run_mode("keyed", td),
         }
     if args.json:
         print(json.dumps(res, default=str))
