@@ -481,6 +481,7 @@ def train(
     table_dtype: Optional[Any] = None,
     steps_per_call: Optional[int] = None,
     oversample: Optional[float] = None,
+    output_path_ctx: Optional[str] = None,
 ) -> TrainResult:
     """Full training driver (reference ``TrainNeuralNetwork``,
     ``distributed_wordembedding.cpp:146``).
@@ -805,6 +806,11 @@ def train(
 
     if output_path and mv.rank() == 0:
         save_embeddings(output_path, dictionary, input_table.get())
+    if output_path_ctx and mv.rank() == 0:
+        # context (output-table) embeddings: the reference never saves
+        # these, but held-out NS likelihood needs u_o . v_c — the
+        # evaluation hook behind tools/embedding_quality.py --heldout
+        save_embeddings(output_path_ctx, dictionary, output_table.get())
     # words/sec counts corpus words (reference word_count_actual semantics,
     # WE/src/trainer.cpp:45-48); pairs/sec counts device training examples.
     # Multi-process: this process trained its 1/n partition of each epoch —

@@ -599,6 +599,39 @@ class AsyncDeltaBus:
                     self.mark_dead({r})
         return [r for r in range(self._size) if r not in self._dead]
 
+    def _live_barrier(self, name: str, live):
+        """Rendezvous among ``live``, robust to a peer dying MID-barrier.
+
+        A barrier whose participant list names a peer that dies before
+        arriving can never complete — and the death is only DECLARED
+        after the watchdog window, typically while survivors already
+        wait. In survivor mode each attempt therefore uses a fresh
+        single-use id and a watchdog-scaled timeout; on failure the
+        live list is re-unioned from the KV declarations and the
+        barrier retried. Converges because every live rank spends the
+        same per-attempt budget (entry offsets are scheduling jitter,
+        far below it), so live ranks meet at the first attempt where
+        their lists agree. Returns the (possibly reduced) live list.
+        """
+        if not self._survivor_mode:
+            self._client.wait_at_barrier(name, 600_000, live)
+            return live
+        deadline = time.monotonic() + 600.0
+        per_try_ms = int(max(
+            2.0 * float(config.get_flag("failure_timeout_s")), 5.0) * 1000)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                self._client.wait_at_barrier(
+                    f"{name}/t{attempt}", per_try_ms, live)
+                return live
+            except Exception as exc:
+                if time.monotonic() > deadline:
+                    Log.fatal(f"async PS live barrier {name} failed after "
+                              f"600 s: {exc}")
+                live = [r for r in self._live_ranks() if r in live]
+
     # -- quiesce -----------------------------------------------------------
     def drain(self, tag: str = "drain") -> None:
         """Collective flush among LIVE processes: after it returns on all
@@ -617,7 +650,7 @@ class AsyncDeltaBus:
             _drain_round += 1
             rnd = _drain_round
         live = self._live_ranks()
-        self._client.wait_at_barrier(f"mvps/{tag}/{rnd}/a", 600_000, live)
+        live = self._live_barrier(f"mvps/{tag}/{rnd}/a", live)
         targets = {r: self._peer_count(r)
                    for r in live if r != self._rank}
         # p2p frames are not durable like KV payloads, so the wait is
@@ -647,7 +680,7 @@ class AsyncDeltaBus:
         # not be named in barrier B (it will never arrive). _live_ranks
         # re-unions the KV declarations so survivors converge on the list.
         live = [r for r in self._live_ranks() if r in live]
-        self._client.wait_at_barrier(f"mvps/{tag}/{rnd}/b", 600_000, live)
+        self._live_barrier(f"mvps/{tag}/{rnd}/b", live)
         # every own record is now applied (and acked) everywhere live:
         # collect the ack keys and release any backpressure debt
         with self._pub_lock:

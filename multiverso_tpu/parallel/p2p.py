@@ -124,9 +124,11 @@ class P2PTransport:
         t = threading.Thread(target=fn, name=name, args=args, daemon=True)
         t.start()
         # prune retired senders so reconnect churn can't grow the join
-        # list without bound
-        self._threads = [x for x in self._threads if x.is_alive()]
-        self._threads.append(t)
+        # list without bound (under _lock: __init__ and the accept loop
+        # spawn concurrently, and a lost append would skip a join)
+        with self._lock:
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
 
     def _track(self, conn: socket.socket) -> None:
         with self._lock:
@@ -178,9 +180,12 @@ class P2PTransport:
             except OSError:
                 conn.close()
                 continue
-            if peer in self._dead:
-                # a declared-dead (or out-of-contract resurrected) peer
-                # gets no stream; closing here keeps the reject bounded
+            if (peer in self._dead or peer == self._rank
+                    or not 0 <= peer < self._size):
+                # declared-dead (or out-of-contract resurrected) peers and
+                # bogus hellos (port scanner, wrong-label client, own
+                # rank) get no stream; closing here keeps the reject
+                # bounded instead of granting a replay sender slot
                 conn.close()
                 continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -384,12 +389,20 @@ class P2PTransport:
     # -- failure handling (wired by the bus, driven by FailureDetector) ----
     def mark_dead(self, ranks) -> None:
         """Stop queueing to / expecting from / reconnecting to dead peers;
-        their senders exit and release any cursor state."""
+        their senders exit and release any cursor state. Closing the
+        conns matters: a sender to a wedged peer is typically blocked in
+        ``sendall`` (full TCP buffers), where no cv notify reaches it —
+        only erroring the syscall out does."""
+        dropped = []
         with self._out_cv:
             for r in ranks:
                 self._dead.add(r)
-                self._senders.pop(r, None)
+                state = self._senders.pop(r, None)
+                if state is not None:
+                    dropped.append(state)
             self._out_cv.notify_all()
+        for state in dropped:
+            self._close(state["conn"])
 
     def stop(self) -> None:
         self._stop.set()

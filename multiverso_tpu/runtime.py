@@ -113,12 +113,40 @@ class Session:
                 # survivors agree on the participant list.
                 live = self.async_bus._live_ranks()
             topology.barrier("mv_shutdown", live)
+            survivor = (self.async_bus is not None
+                        and self.async_bus._survivor_mode)
             if self.async_bus is not None:
                 # collective: every in-flight delta lands everywhere before
                 # any table is torn down (the reference's FinishTrain drain,
                 # src/zoo.cpp:96-101)
+                dead = set(self.async_bus._dead)
                 self.async_bus.stop()
                 self.async_bus = None
+            if survivor and self.size > 1:
+                # recoverable tasks skip JAX's synchronized shutdown
+                # barrier (the coordination service says so explicitly),
+                # so an unsynchronized exit lets the coordinator die
+                # mid-peer-disconnect (CANCELLED -> fatal error poll).
+                # Rendezvous the live set once more, give peers' own
+                # disconnects a grace window on rank 0, and disconnect
+                # HERE so the atexit teardown finds nothing left to race.
+                live = [r for r in range(self.size) if r not in dead]
+                try:
+                    topology.barrier("mv_exit", live)
+                except Exception as exc:
+                    Log.info("exit rendezvous incomplete (%s); "
+                             "proceeding with shutdown", exc)
+                import time as _time
+
+                import jax as _jax
+
+                if self.rank == 0:
+                    _time.sleep(1.0)
+                try:
+                    _jax.distributed.shutdown()
+                except Exception as exc:
+                    Log.info("distributed shutdown raced a peer exit "
+                             "(benign in survivor mode): %s", exc)
             for table in self.tables:
                 flush = getattr(table, "flush", None)
                 if flush is not None:

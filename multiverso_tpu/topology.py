@@ -158,6 +158,36 @@ def net_connect(ranks: Sequence[int], endpoints: Sequence[str]) -> None:
     _explicit_net["coordinator"] = str(table[0])
 
 
+def _survivor_mode_prep() -> None:
+    """Survivor mode (``-failure_timeout_s > 0``) needs the coordination
+    service itself to tolerate a dead task: without
+    ``jax_enable_recoverability`` the service's error polling terminates
+    every HEALTHY process ~heartbeat_timeout after a peer dies —
+    regardless of the framework-level live-set machinery. Fail-fast
+    stays the default for non-survivor jobs (the reference's posture: a
+    silent peer kills the job)."""
+    try:
+        from . import config as _config
+
+        survivor = float(_config.get_flag("failure_timeout_s")) > 0
+    except Exception as exc:   # flag registry not up yet -> default mode
+        Log.debug("survivor-mode prep skipped: %s", exc)
+        return
+    if not survivor:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_enable_recoverability", True)
+    except Exception as exc:
+        # the user EXPLICITLY asked for survivor mode; silently reverting
+        # to fail-fast would let a dead peer kill every healthy survivor
+        Log.error("survivor mode requested (-failure_timeout_s) but "
+                  "jax_enable_recoverability could not be enabled (%s): "
+                  "the coordination service will terminate survivors "
+                  "~heartbeat_timeout after a peer death", exc)
+
+
 def _maybe_init_distributed() -> None:
     """Initialise the multi-host process group if asked to.
 
@@ -166,6 +196,7 @@ def _maybe_init_distributed() -> None:
     net_bind/net_connect API (the reference's machine-file/ZMQ mode), then
     the MV_*/JAX_* coordinator env vars. Single-process runs skip this.
     """
+    _survivor_mode_prep()
     # Read the env BEFORE touching any jax API: probing jax.process_count()
     # would itself initialise the local backend, after which
     # jax.distributed.initialize() raises.

@@ -33,9 +33,18 @@ def test_w2v_real_shape_efficiency_floor():
     assert by_dp[1]["eff_norm"] == 1.0
     for r in rows:
         assert np.isfinite(r["pairs_per_sec"]) and r["pairs_per_sec"] > 0
-    # round-4 floor: the delta exchange holds dp=8 sync overhead under
-    # ~45% at the real shape (measured ~20% idle; r3's per-batch BSP was 57%)
-    assert by_dp[8]["eff_norm"] >= 0.55, rows
+    # r5 floor, tightened to the measured band: the dispatch exchange
+    # measures eff_norm 0.96-0.97 at dp=8 on an idle host (overhead ~3%,
+    # MULTICHIP_r04); 0.85 holds ~11 points of margin for host noise
+    # (banked tunnel spread is ±5%) while still failing a reintroduction
+    # of the r3 per-batch dense-allreduce path (which measured 0.43).
+    # The r4 floor of 0.55 would have let a 40-point regression — most
+    # of the r4 win — ship green (VERDICT r4 weak 4).
+    assert by_dp[8]["eff_norm"] >= 0.85, rows
+    # bench-band guard on the sweep's own overhead accounting (the
+    # number MULTICHIP_r*.json embeds): dispatch exchange measures ~3%;
+    # 10% is the band edge (VERDICT r4 item 5)
+    assert by_dp[8]["overhead_frac"] <= 0.10, rows
 
 
 def test_quick_sweep_sane_and_saturation_annotated():

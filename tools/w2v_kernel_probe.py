@@ -254,10 +254,16 @@ def subtile_rejected() -> str:
         np.asarray(call(jnp.zeros(8, jnp.int32),
                         jnp.zeros((64, DIM), jnp.float32)))
     except Exception as exc:                     # expected: Mosaic reject
+        # a rejection with ANY wording keeps the measured verdict valid;
+        # only genuine ACCEPTANCE (the fall-through below) triggers the
+        # re-measure alarm. Matching one literal compiler string here
+        # made a harmless wording change look like a probe failure
+        # (ADVICE r4).
         msg = str(exc)
-        assert "aligned to tiling" in msg, (
-            f"sub-tile DMA failed for an unexpected reason:\n{msg[-800:]}")
-        return "rejected: slice must be aligned to tiling (8)"
+        if "aligned to tiling" in msg:
+            return "rejected: slice must be aligned to tiling (8)"
+        return ("rejected (unrecognized wording — still a reject): "
+                + (msg.splitlines() or ["<no message>"])[-1][-200:])
     raise AssertionError(
         "Mosaic now ACCEPTS sub-tile HBM DMA slices — the per-row kernel "
         "class exists after all; re-measure docs/W2V_KERNEL.md's verdict")
