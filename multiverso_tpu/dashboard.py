@@ -81,16 +81,94 @@ class Monitor:
             )
 
 
+class Histogram:
+    """Bounded latency histogram: count/percentiles over a sliding window.
+
+    The serving layer's per-reply latency sink (p50/p95/p99 + QPS need a
+    distribution, not the Monitor's running mean). Keeps the most recent
+    ``window`` samples in a ring — old traffic ages out, so percentiles
+    track the CURRENT load regime, and memory stays bounded under
+    sustained QPS. Thread-safe; registered in the Dashboard next to the
+    Monitors so ``display()`` shows both.
+    """
+
+    WINDOW = 65536
+
+    def __init__(self, name: str, window: int = WINDOW,
+                 register: bool = True) -> None:
+        self.name = name
+        self.count = 0                      # lifetime samples (QPS numerator)
+        self._buf = [0.0] * int(window)
+        self._n = 0                         # filled slots (<= window)
+        self._pos = 0                       # next write slot
+        self._lock = threading.Lock()
+        if register:
+            Dashboard.add_histogram(self)
+
+    def record(self, value_ms: float) -> None:
+        with self._lock:
+            self.count += 1
+            self._buf[self._pos] = float(value_ms)
+            self._pos = (self._pos + 1) % len(self._buf)
+            self._n = min(self._n + 1, len(self._buf))
+
+    def percentiles(self, ps) -> Dict[float, float]:
+        """Nearest-rank percentiles over the retained window in ONE sort
+        (0s if empty) — summary()/stats() pollers would otherwise pay a
+        full sort per percentile while contending with record()."""
+        with self._lock:
+            n = self._n
+            if n == 0:
+                return {p: 0.0 for p in ps}
+            # unwrapped: slots [0, n) are the live samples; wrapped: all are
+            data = sorted(self._buf if n == len(self._buf) else self._buf[:n])
+        return {p: data[min(n - 1, max(0, int(round(p / 100.0 * (n - 1)))))]
+                for p in ps}
+
+    def percentile(self, p: float) -> float:
+        return self.percentiles((p,))[p]
+
+    def summary(self) -> Dict[str, float]:
+        qs = self.percentiles((50, 95, 99))
+        return {
+            "count": self.count,
+            "p50_ms": qs[50],
+            "p95_ms": qs[95],
+            "p99_ms": qs[99],
+        }
+
+    def info_string(self) -> str:
+        s = self.summary()
+        return (f"[{self.name}] count = {int(s['count'])} "
+                f"p50 = {s['p50_ms']:.3f} ms p95 = {s['p95_ms']:.3f} ms "
+                f"p99 = {s['p99_ms']:.3f} ms")
+
+
 class Dashboard:
     """Process-global monitor registry (reference ``dashboard.h:16-24``)."""
 
     _monitors: Dict[str, Monitor] = {}
+    _histograms: Dict[str, "Histogram"] = {}
     _lock = threading.Lock()
 
     @classmethod
     def add_monitor(cls, mon: Monitor) -> None:
         with cls._lock:
             cls._monitors[mon.name] = mon
+
+    @classmethod
+    def add_histogram(cls, hist: "Histogram") -> None:
+        with cls._lock:
+            cls._histograms[hist.name] = hist
+
+    @classmethod
+    def get_or_create_histogram(cls, name: str) -> "Histogram":
+        with cls._lock:
+            hist = cls._histograms.get(name)
+            if hist is None:
+                hist = Histogram(name, register=False)
+                cls._histograms[name] = hist
+            return hist
 
     @classmethod
     def get_or_create(cls, name: str) -> Monitor:
@@ -111,16 +189,22 @@ class Dashboard:
     def stats(cls, name: str) -> Optional[Dict[str, float]]:
         with cls._lock:
             mon = cls._monitors.get(name)
-        if mon is None:
-            return None
-        return {"count": mon.count, "total_ms": mon.total_ms, "avg_ms": mon.average_ms()}
+            hist = cls._histograms.get(name)
+        if mon is not None:
+            return {"count": mon.count, "total_ms": mon.total_ms,
+                    "avg_ms": mon.average_ms()}
+        if hist is not None:
+            return hist.summary()
+        return None
 
     @classmethod
     def display(cls, emit=None) -> str:
         with cls._lock:
             monitors = list(cls._monitors.values())
+            histograms = list(cls._histograms.values())
         lines = ["--------------Dashboard--------------"]
         lines += [m.info_string() for m in monitors]
+        lines += [h.info_string() for h in histograms]
         text = "\n".join(lines)
         if emit is None:
             from .log import Log
@@ -132,6 +216,7 @@ class Dashboard:
     def reset(cls) -> None:
         with cls._lock:
             cls._monitors.clear()
+            cls._histograms.clear()
 
 
 @contextmanager

@@ -145,6 +145,7 @@ class LogReg:
                 t._data, t._ustate,
                 jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32),
                 *_option_scalars(option, t.dtype))
+            t.version += 1
         self._steps += 1
         return loss
 

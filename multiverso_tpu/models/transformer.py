@@ -226,6 +226,124 @@ def loss_fn(cfg: TransformerConfig, params: Dict[str, Any],
         jnp.take_along_axis(logp, targets[..., None], axis=-1))
 
 
+# -- serving: KV-cache greedy decode -----------------------------------------
+#
+# The training ``forward`` recomputes attention over the whole prefix for
+# every new token — O(T^2) per generated token. The serving path splits
+# generation into PREFILL (one causal forward over the right-padded prompt
+# batch that also records per-layer K/V projections) and DECODE (one token per
+# step against the cached K/V — O(T) per token). Prompts are right-padded to
+# the batcher's bucket length; per-example ``lengths`` drive the position
+# embeddings, the logits gather, and the attention mask, so padding never
+# leaks into a response. Cache layout: [n_layers, B, max_seq, d_model],
+# pre-head-split (the head split is a free reshape).
+
+_NEG_INF = jnp.float32(-1e30)
+
+
+def _cached_attention(q, k_cache, v_cache, n_heads: int, pos) -> jax.Array:
+    """One-token attention: ``q`` [B, D] against cache [B, T, D].
+
+    ``pos`` [B] is each example's current position; cache entries at
+    positions <= pos are live (prompt + previously generated tokens),
+    everything past is masked. Math matches :func:`ops.reference_attention`
+    (1/sqrt(dh) scale, f32 softmax) so cached decode is numerically the
+    training forward's argmax path.
+    """
+    B, D = q.shape
+    T = k_cache.shape[1]
+    dh = D // n_heads
+    qh = q.reshape(B, n_heads, dh)
+    kh = k_cache.reshape(B, T, n_heads, dh)
+    vh = v_cache.reshape(B, T, n_heads, dh)
+    scores = jnp.einsum("bhd,bthd->bht", qh, kh,
+                        preferred_element_type=jnp.float32) / np.sqrt(dh)
+    mask = (jnp.arange(T)[None, :] <= pos[:, None])[:, None, :]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bht,bthd->bhd", probs.astype(vh.dtype), vh)
+    return out.reshape(B, D).astype(q.dtype)
+
+
+def prefill(cfg: TransformerConfig, params: Dict[str, Any],
+            tokens: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Causal forward over right-padded prompts, recording per-layer K/V.
+
+    Returns ``(logits [B, P, V], k [L, B, P, D], v [L, B, P, D])``. Padding
+    positions produce garbage hidden states — callers gather logits at
+    ``lengths - 1`` and decode overwrites pad-slot cache entries before the
+    mask ever reaches them, so the garbage is never observable.
+    """
+    B, P = tokens.shape
+    h = jnp.take(params["embed"], tokens, axis=0) + params["pos"][:P]
+    ks, vs = [], []
+    for i in range(cfg.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["layers"])
+        x = _rmsnorm(h, layer["ln1_g"])
+        q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
+        ks.append(k)
+        vs.append(v)
+        h = h + _attention(q, k, v, cfg.n_heads, cfg.attention) @ layer["w_o"]
+        x = _rmsnorm(h, layer["ln2_g"])
+        h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+    h = _rmsnorm(h, params["ln_f_g"])
+    logits = jnp.einsum("btd,vd->btv", h, params["embed"],
+                        preferred_element_type=jnp.float32)
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def greedy_decode(cfg: TransformerConfig, params: Dict[str, Any],
+                  tokens: jax.Array, lengths: jax.Array,
+                  max_new: int) -> jax.Array:
+    """Greedy continuation: ``max_new`` tokens per prompt.
+
+    ``tokens`` [B, P] right-padded prompt ids, ``lengths`` [B] true prompt
+    lengths (callers guarantee ``lengths + max_new <= cfg.max_seq``).
+    Returns [B, max_new] generated ids. jit-able with static ``max_new``
+    (the serving workload jits one instance per (B, P) shape bucket).
+    """
+    B, P = tokens.shape
+    # cache bound: positions can only ever reach P + max_new - 1 (callers
+    # guarantee lengths <= P), so sizing the cache/attention to max_seq
+    # would pay max_seq-width attention per generated token for nothing
+    L, D, T = cfg.n_layers, cfg.d_model, P + max_new
+    logits, ks, vs = prefill(cfg, params, tokens)
+    k_cache = jnp.zeros((L, B, T, D), cfg.dtype).at[:, :, :P].set(ks)
+    v_cache = jnp.zeros((L, B, T, D), cfg.dtype).at[:, :, :P].set(vs)
+    # next token comes from each example's LAST REAL position, not slot P-1
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    first = jnp.argmax(last, axis=-1).astype(tokens.dtype)
+
+    batch_ix = jnp.arange(B)
+
+    def step(carry, _):
+        k_cache, v_cache, pos, tok = carry
+        h = (jnp.take(params["embed"], tok, axis=0)
+             + jnp.take(params["pos"], pos, axis=0))
+        for i in range(L):
+            layer = jax.tree.map(lambda a: a[i], params["layers"])
+            x = _rmsnorm(h, layer["ln1_g"])
+            q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
+            k_cache = k_cache.at[i, batch_ix, pos].set(k)
+            v_cache = v_cache.at[i, batch_ix, pos].set(v)
+            h = h + _cached_attention(
+                q, k_cache[i], v_cache[i], cfg.n_heads, pos) @ layer["w_o"]
+            x = _rmsnorm(h, layer["ln2_g"])
+            h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+        h = _rmsnorm(h, params["ln_f_g"])
+        out = jnp.einsum("bd,vd->bv", h, params["embed"],
+                         preferred_element_type=jnp.float32)
+        nxt = jnp.argmax(out, axis=-1).astype(tok.dtype)
+        return (k_cache, v_cache, pos + 1, nxt), nxt
+
+    if max_new <= 1:
+        return first[:, None]
+    _, rest = jax.lax.scan(
+        step, (k_cache, v_cache, lengths, first), None, length=max_new - 1)
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
 class TransformerLM:
     """Trainer over a (worker, server) mesh: dp batches, tp weights."""
 
@@ -237,6 +355,14 @@ class TransformerLM:
         self.mesh = mesh if mesh is not None else Session.get().mesh
         if config.d_model % config.n_heads != 0:
             Log.fatal("d_model must divide by n_heads")
+        # Serving contract (mirrors TableBase): ``version`` counts train
+        # steps; ``snapshot_params`` copies under the lock so the serving
+        # layer never reads a params buffer a concurrent train step is
+        # about to donate.
+        import threading
+
+        self._lock = threading.Lock()
+        self.version = 0
         self._shardings = param_shardings(config, self.mesh, tp_axis)
         params = init_params(config)
         self.params = jax.tree.map(jax.device_put, params, self._shardings)
@@ -266,9 +392,22 @@ class TransformerLM:
 
     def train_batch(self, tokens: np.ndarray) -> jax.Array:
         """One dp+tp step on [B, T] token ids; returns async scalar loss."""
-        self.params, self._momentum, loss = self._step(
-            self.params, self._momentum, jnp.asarray(tokens, jnp.int32))
+        with self._lock:
+            self.params, self._momentum, loss = self._step(
+                self.params, self._momentum, jnp.asarray(tokens, jnp.int32))
+            self.version += 1
         return loss
+
+    def snapshot_params(self) -> Tuple[Dict[str, Any], int]:
+        """``(params copy, version)`` for the serving read path.
+
+        The copies dispatch under the train lock — device-stream ordering
+        guarantees they read the pre-donation buffers even while a train
+        step races (the :meth:`tables.base.TableBase.snapshot_array`
+        contract, for model params instead of a table).
+        """
+        with self._lock:
+            return jax.tree.map(jnp.copy, self.params), self.version
 
     def logits(self, tokens: np.ndarray) -> jax.Array:
         return forward(self.config, self.params,

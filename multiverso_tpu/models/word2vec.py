@@ -1085,6 +1085,8 @@ class Word2Vec:
              g_in, g_out, loss, self._key) = step_fn(
                 self.input_table._data, self.output_table._data,
                 g_in, g_out, *batch, lr, self._key)
+            self.input_table.version += 1
+            self.output_table.version += 1
         if cfg.use_adagrad:
             self._g_in, self._g_out = g_in, g_out
         self._words_trained += n_words
@@ -1238,6 +1240,8 @@ class Word2Vec:
                 self.input_table._data, self.output_table._data,
                 g_in, g_out, *self._ext_bufs,
                 lr, self._key, jnp.int32(start0))
+            self.input_table.version += 1
+            self.output_table.version += 1
         if cfg.use_adagrad:
             self._g_in, self._g_out = g_in, g_out
         # lr decay bookkeeping: count is async; approximate with the

@@ -179,7 +179,12 @@ class AsyncDeltaBus:
                     self._rank, self._size, client,
                     initial_resume={r: _consumed.get(r, 0)
                                     for r in range(self._size)
-                                    if r != self._rank})
+                                    if r != self._rank},
+                    # transport-declared deaths (out-of-contract resume)
+                    # must shrink the ACK quorum too, or _reap_acks waits
+                    # on a peer that will never consume again and the
+                    # publisher exits via the 600-s backpressure fatal
+                    on_dead=self.mark_dead)
             except Exception as exc:
                 Log.error("async PS: p2p transport unavailable (%s)", exc)
             # the payload plane must be AGREED: one rank silently falling
@@ -620,13 +625,53 @@ class AsyncDeltaBus:
         per_try_ms = int(max(
             2.0 * float(config.get_flag("failure_timeout_s")), 5.0) * 1000)
         attempt = 0
+        win_key = f"{name}/win"
         while True:
             attempt += 1
             try:
                 self._client.wait_at_barrier(
                     f"{name}/t{attempt}", per_try_ms, live)
+                # Publish the COMPLETED attempt + its participant list. A
+                # straggler whose own wait on this attempt timed out
+                # client-side just as its arrival completed the barrier
+                # server-side (arrival skew ~ the per-try budget, e.g. a
+                # long jit compile) would otherwise retry t{attempt+1}
+                # where nobody will ever arrive, desyncing the counters
+                # permanently until the 600-s Log.fatal.
+                try:
+                    self._client.key_value_set(
+                        win_key,
+                        f"{attempt}:{','.join(map(str, live))}",
+                        allow_overwrite=True)
+                except Exception:
+                    pass   # best effort; stragglers fall back to retrying
                 return live
             except Exception as exc:
+                won = None
+                try:
+                    won = str(self._client.key_value_try_get(win_key))
+                except Exception:
+                    pass   # NOT_FOUND (or unreadable): no winner yet
+                if won is not None:
+                    _, _, members = won.partition(":")
+                    winners = {int(r) for r in members.split(",") if r}
+                    if self._rank in winners:
+                        # the group completed an attempt COUNTING this
+                        # rank — its arrival was registered even though
+                        # its own wait raised; join the winning attempt
+                        # instead of retrying one nobody else will enter
+                        Log.info("async PS: barrier %s completed (%s) "
+                                 "while this rank's wait timed out; "
+                                 "joining the winning attempt", name, won)
+                        return live
+                    # completed WITHOUT this rank: the survivors dropped
+                    # it from their live list (declared dead). Joining
+                    # silently would fake synchronization — keep
+                    # retrying/re-unioning so the exclusion surfaces in
+                    # the timeout diagnostics instead.
+                    Log.error("async PS: barrier %s completed excluding "
+                              "this rank (%s) — survivors declared it "
+                              "dead", name, won)
                 if time.monotonic() > deadline:
                     Log.fatal(f"async PS live barrier {name} failed after "
                               f"600 s: {exc}")

@@ -60,6 +60,7 @@ from ..log import Log
 
 _FRAME = struct.Struct("<QI")   # seq, payload length
 _HELLO = struct.Struct("<IQ")   # subscriber rank, resume-from seq
+_HELLO_TIMEOUT_S = 5.0          # accept-loop budget for the 12-byte hello
 
 
 def _local_host() -> str:
@@ -81,11 +82,18 @@ class P2PTransport:
 
     def __init__(self, rank: int, size: int, client,
                  label: str = "mvps", connect_timeout_s: float = 60.0,
-                 initial_resume: Optional[Dict[int, int]] = None) -> None:
+                 initial_resume: Optional[Dict[int, int]] = None,
+                 on_dead=None) -> None:
         self._rank = rank
         self._size = size
         self._client = client
         self._label = label
+        # bus hook for TRANSPORT-declared deaths (out-of-contract resume):
+        # without it the bus's ack quorum keeps counting the rejected peer
+        # and the publisher can only exit via the 600-s backpressure fatal.
+        # Invoked WITHOUT _out_cv held — the bus's mark_dead re-enters
+        # p2p.mark_dead, which takes the (non-reentrant) lock.
+        self._on_dead = on_dead
         self._lock = threading.Lock()
         self._stop = threading.Event()
         # publisher side: retained un-GC'd records (seq -> payload) + the
@@ -175,8 +183,15 @@ class P2PTransport:
             except OSError:
                 return                       # listener closed by stop()
             try:
+                # a short hello deadline: a half-open connection (client
+                # stalled between connect and sendall) must not wedge the
+                # single accept thread — every OTHER peer's reconnect
+                # funnels through it. socket.timeout is an OSError, so
+                # the silent client lands in the except below.
+                conn.settimeout(_HELLO_TIMEOUT_S)
                 hello = self._read_exact(conn, _HELLO.size)
                 peer, resume = _HELLO.unpack(hello)
+                conn.settimeout(None)   # streaming is deadline-free again
             except OSError:
                 conn.close()
                 continue
@@ -231,6 +246,15 @@ class P2PTransport:
                 with self._out_cv:
                     self._dead.add(peer)
                     self._senders.pop(peer, None)
+                # surface the death to the bus (outside the lock — see
+                # __init__) so its ack quorum shrinks NOW instead of
+                # burning the 600-s backpressure deadline into Log.fatal
+                if self._on_dead is not None:
+                    try:
+                        self._on_dead({peer})
+                    except Exception as exc:
+                        Log.error("p2p: on_dead hook failed for rank %d: "
+                                  "%s", peer, exc)
                 break
             try:
                 # sendmsg scatters header + payload in one syscall without

@@ -35,6 +35,7 @@ class Session:
     def __init__(self) -> None:
         self.topo: Optional[topology.Topology] = None
         self.tables: List[Any] = []
+        self.servers: List[Any] = []  # serving.InferenceServer registry
         self.role: int = _ROLE_ALL
         self.started = False
         self.async_bus: Optional[Any] = None  # cross-process async PS plane
@@ -98,6 +99,14 @@ class Session:
         with self._lock:
             if not self.started:
                 return
+            # serving drains first: in-flight replies read tables, so the
+            # inference plane must quiesce before any table is torn down
+            for srv in self.servers:
+                try:
+                    srv.stop()
+                except Exception as exc:
+                    Log.error("serving shutdown failed: %s", exc)
+            self.servers.clear()
             if self.failure_detector is not None:
                 self.failure_detector.stop()
                 self.failure_detector = None
@@ -181,6 +190,13 @@ class Session:
 
     def table(self, table_id: int) -> Any:
         return self.tables[table_id]
+
+    def register_server(self, server: Any) -> None:
+        """Track a serving.InferenceServer so shutdown stops it before
+        the tables it reads are torn down."""
+        with self._lock:
+            self._require_started()
+            self.servers.append(server)
 
     # -- queries (``multiverso.h:18-29``) ---------------------------------
     def _require_started(self) -> None:

@@ -1,0 +1,29 @@
+"""Online serving: batched low-latency inference over live parameter tables.
+
+The inference half of the train/serve stack (docs/SERVING.md). Pieces:
+
+* :class:`InferenceServer` — request router; named models, blocking
+  ``predict`` / async ``submit``, per-model stats.
+* :class:`MicroBatcher` — bounded queue flushed on max-batch-size OR
+  deadline, padded to jit-warm shape buckets, load-shedding past the
+  queue-depth cap (:class:`OverloadedError`).
+* :class:`SnapshotManager` — versioned copy-on-publish read views over
+  tables/models; replies carry a staleness bound.
+* workloads — jitted inference for the three model families:
+  :class:`EmbeddingNeighbors` (word2vec lookup + top-k),
+  :class:`LogRegPredict` / :class:`FTRLPredict`, and
+  :class:`LMGreedyDecode` (KV-cache greedy decode).
+"""
+
+from .batcher import (BatcherConfig, MicroBatcher, OverloadedError,
+                      bucket_for, shape_buckets)
+from .server import InferenceServer
+from .snapshot import Snapshot, SnapshotManager
+from .workloads import (EmbeddingNeighbors, FTRLPredict, LMGreedyDecode,
+                        LogRegPredict)
+
+__all__ = [
+    "BatcherConfig", "MicroBatcher", "OverloadedError", "bucket_for",
+    "shape_buckets", "InferenceServer", "Snapshot", "SnapshotManager",
+    "EmbeddingNeighbors", "FTRLPredict", "LMGreedyDecode", "LogRegPredict",
+]

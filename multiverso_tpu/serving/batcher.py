@@ -1,0 +1,219 @@
+"""Micro-batching request scheduler: bounded queue -> padded shape buckets.
+
+The latency/throughput trade at the heart of batched serving (the
+clipper-style adaptive-batching design): single requests dispatched alone
+pay the full host->device dispatch + kernel launch cost per reply;
+batching amortises it, but an unbounded wait for a full batch destroys
+tail latency. The scheduler therefore flushes on EITHER trigger:
+
+* **size** — ``max_batch`` requests are waiting (throughput bound);
+* **deadline** — the OLDEST waiting request has aged ``deadline_ms``
+  (latency bound; nothing waits longer than one deadline + one batch
+  execution).
+
+Flushed batches are padded up to a small set of **shape buckets**
+(powers of two up to ``max_batch``), so XLA compiles one program per
+bucket and every later flush of any size reuses a warm cache entry —
+arbitrary batch sizes would retrace/recompile on each new size and
+torpedo p99.
+
+Overload is handled by **load-shedding, not queueing**: past
+``max_queue`` waiting requests, ``submit`` fast-rejects with the typed
+:class:`OverloadedError` (the caller can back off / retry elsewhere)
+instead of growing an unbounded queue whose every entry would time out
+anyway. Per-reply latency lands in a Dashboard histogram
+(``SERVE_LAT[name]``) for p50/p95/p99.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Callable, Deque, List, Optional, Sequence, Tuple
+
+import collections
+
+from ..dashboard import Dashboard
+from ..log import Log
+
+
+class OverloadedError(RuntimeError):
+    """Typed fast-reject: the model's queue is at its depth cap."""
+
+    def __init__(self, model: str, depth: int, cap: int) -> None:
+        super().__init__(
+            f"serving queue for {model!r} at depth cap ({depth}/{cap}); "
+            "request shed")
+        self.model = model
+        self.depth = depth
+        self.cap = cap
+
+
+def shape_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Powers of two up to ``max_batch`` (``max_batch`` always included)."""
+    buckets: List[int] = []
+    b = 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    return tuple(buckets)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (callers guarantee n <= max(buckets))."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
+@dataclass
+class BatcherConfig:
+    max_batch: int = 32
+    deadline_ms: float = 2.0
+    max_queue: int = 256
+    buckets: Optional[Tuple[int, ...]] = None   # default: shape_buckets()
+
+    def resolved_buckets(self) -> Tuple[int, ...]:
+        return tuple(self.buckets) if self.buckets else shape_buckets(
+            self.max_batch)
+
+
+class _Pending:
+    __slots__ = ("payload", "future", "t_enq")
+
+    def __init__(self, payload: Any) -> None:
+        self.payload = payload
+        self.future: Future = Future()
+        self.t_enq = time.monotonic()
+
+
+class MicroBatcher:
+    """One model's queue + flush thread.
+
+    ``run_batch(payloads, bucket) -> results`` executes a flushed batch
+    (``len(payloads) <= bucket``; the workload pads to ``bucket``) and
+    returns one result per payload, in order.
+    """
+
+    def __init__(self, name: str, run_batch: Callable[[List[Any], int], List[Any]],
+                 config: Optional[BatcherConfig] = None) -> None:
+        self.name = name
+        self.config = config or BatcherConfig()
+        self._buckets = self.config.resolved_buckets()
+        if self.config.max_batch > self._buckets[-1]:
+            Log.fatal(f"batcher {name!r}: max_batch {self.config.max_batch} "
+                      f"exceeds the largest bucket {self._buckets[-1]}")
+        self._run_batch = run_batch
+        self._q: Deque[_Pending] = collections.deque()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        # -- stats ----------------------------------------------------------
+        self.hist = Dashboard.get_or_create_histogram(f"SERVE_LAT[{name}]")
+        self.completed = 0
+        self.shed = 0
+        self.t_first: Optional[float] = None
+        # recent (n, bucket, cause) flush records, for tests/introspection
+        self.flushes: Deque[Tuple[int, int, str]] = collections.deque(
+            maxlen=1024)
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serve-batch-{name}", daemon=True)
+        self._thread.start()
+
+    # -- client side --------------------------------------------------------
+    def submit(self, payload: Any) -> Future:
+        """Enqueue one request; fast-rejects at the queue-depth cap."""
+        if self._stop.is_set():
+            raise RuntimeError(f"batcher {self.name!r} is stopped")
+        p = _Pending(payload)
+        with self._cv:
+            if self._stop.is_set():
+                # re-check under the lock: a submit that passed the gate
+                # above while stop() drained would enqueue a request no
+                # thread will ever flush
+                raise RuntimeError(f"batcher {self.name!r} is stopped")
+            if len(self._q) >= self.config.max_queue:
+                self.shed += 1
+                raise OverloadedError(self.name, len(self._q),
+                                      self.config.max_queue)
+            if self.t_first is None:
+                self.t_first = p.t_enq
+            self._q.append(p)
+            self._cv.notify()
+        return p.future
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    # -- flush thread -------------------------------------------------------
+    def _loop(self) -> None:
+        deadline_s = self.config.deadline_ms / 1e3
+        max_batch = self.config.max_batch
+        while True:
+            with self._cv:
+                while not self._q and not self._stop.is_set():
+                    self._cv.wait(0.05)
+                if self._stop.is_set() and not self._q:
+                    return
+                # queue non-empty: wait for a full batch, bounded by the
+                # OLDEST request's deadline (submit() notifies on growth)
+                cause = "size"
+                while len(self._q) < max_batch and not self._stop.is_set():
+                    remaining = deadline_s - (
+                        time.monotonic() - self._q[0].t_enq)
+                    if remaining <= 0:
+                        cause = "deadline"
+                        break
+                    self._cv.wait(remaining)
+                if self._stop.is_set():
+                    cause = "stop"        # final drain: flush what's left
+                batch = [self._q.popleft()
+                         for _ in range(min(max_batch, len(self._q)))]
+            self._flush(batch, cause)
+
+    def _flush(self, batch: List[_Pending], cause: str) -> None:
+        # claim every future FIRST: set_running_or_notify_cancel() returns
+        # False for a future the client cancel()'d while queued — skipping
+        # it (instead of set_result raising InvalidStateError) keeps one
+        # cancelled request from killing the flush thread for good
+        live = [p for p in batch if p.future.set_running_or_notify_cancel()]
+        bucket = bucket_for(len(batch), self._buckets)
+        try:
+            results = self._run_batch([p.payload for p in batch], bucket)
+        except Exception as exc:
+            for p in live:
+                p.future.set_exception(exc)
+            return
+        now = time.monotonic()
+        self.flushes.append((len(batch), bucket, cause))
+        done = 0
+        for p, r in zip(batch, results):
+            if p.future.running():          # claimed above, not cancelled
+                p.future.set_result(r)
+                self.hist.record((now - p.t_enq) * 1e3)
+                done += 1
+        self.completed += done
+
+    # -- stats / lifecycle --------------------------------------------------
+    def stats(self) -> dict:
+        elapsed = (time.monotonic() - self.t_first) if self.t_first else 0.0
+        issued = self.completed + self.shed
+        return {
+            "completed": self.completed,
+            "shed": self.shed,
+            "shed_rate": self.shed / issued if issued else 0.0,
+            "qps": self.completed / elapsed if elapsed > 0 else 0.0,
+            **{k: v for k, v in self.hist.summary().items() if k != "count"},
+        }
+
+    def stop(self) -> None:
+        """Flush whatever is queued, then retire the thread."""
+        with self._cv:
+            self._stop.set()
+            self._cv.notify_all()
+        self._thread.join(timeout=10)

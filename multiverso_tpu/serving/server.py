@@ -1,0 +1,132 @@
+"""Request router: named models -> micro-batchers -> jitted workloads.
+
+The front door of the serving subsystem. Each registered model owns a
+:class:`MicroBatcher` (bounded queue, size/deadline flush, shape
+buckets, load-shedding) and a :class:`SnapshotManager` (versioned
+copy-on-publish read view). A flush takes ONE snapshot decision for the
+whole batch, executes the workload's jitted program against it, and
+stamps every reply with the snapshot version and its staleness bound —
+so a client can always tell how far behind live training its answer is.
+
+Lifecycle ties into the Session: a started server registers itself, and
+``Session.stop()`` (``mv.shutdown()``) stops serving before tables are
+torn down — the reference Zoo's shutdown-order contract extended to the
+inference plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from ..log import Log
+from .batcher import BatcherConfig, MicroBatcher
+from .snapshot import SnapshotManager
+
+
+class _ModelEntry:
+    def __init__(self, name: str, workload, manager: SnapshotManager,
+                 batcher_cfg: BatcherConfig, max_staleness_s: float) -> None:
+        self.name = name
+        self.workload = workload
+        self.manager = manager
+        self.max_staleness_s = float(max_staleness_s)
+        self.batcher = MicroBatcher(name, self._run, batcher_cfg)
+
+    def _run(self, payloads: List[Any], bucket: int) -> List[dict]:
+        # ONE freshness decision per flush: every reply in the batch is
+        # built from the same snapshot, and its staleness at flush time
+        # is bounded by max_staleness_s (ensure_fresh republishes past it)
+        snap = self.manager.ensure_fresh(self.max_staleness_s)
+        staleness = self.manager.staleness_s(snap)
+        results = self.workload.run(payloads, bucket, snap)
+        return [{"result": r, "snapshot_version": snap.version,
+                 "staleness_s": staleness} for r in results]
+
+
+class InferenceServer:
+    """Batched low-latency inference over live parameter state."""
+
+    def __init__(self, name: str = "serving") -> None:
+        self.name = name
+        self._models: Dict[str, _ModelEntry] = {}
+        self._lock = threading.Lock()
+        self._stopped = False
+        from ..runtime import Session
+
+        sess = Session.get()
+        if sess.started:
+            sess.register_server(self)
+
+    # -- registration -------------------------------------------------------
+    def register(self, name: str, workload, max_batch: int = 32,
+                 deadline_ms: float = 2.0, max_queue: int = 256,
+                 max_staleness_s: float = 0.05,
+                 buckets: Optional[tuple] = None) -> None:
+        """Attach a workload under ``name``.
+
+        ``workload`` exposes ``source`` (a table or model with the
+        snapshot contract) and ``run(payloads, bucket, snap)``; knobs:
+        ``max_batch``/``deadline_ms`` set the flush triggers,
+        ``max_queue`` the shed threshold, ``max_staleness_s`` the
+        snapshot refresh bound.
+        """
+        cfg = BatcherConfig(max_batch=max_batch, deadline_ms=deadline_ms,
+                            max_queue=max_queue, buckets=buckets)
+        manager = SnapshotManager.of(workload.source, name=name)
+        with self._lock:
+            if name in self._models:
+                Log.fatal(f"serving: model {name!r} already registered")
+            self._models[name] = _ModelEntry(
+                name, workload, manager, cfg, max_staleness_s)
+        Log.info("serving: model %r up (max_batch %d, deadline %.1f ms, "
+                 "queue cap %d)", name, max_batch, deadline_ms, max_queue)
+
+    def _entry(self, name: str) -> _ModelEntry:
+        with self._lock:
+            entry = self._models.get(name)
+        if entry is None:
+            Log.fatal(f"serving: unknown model {name!r} "
+                      f"(registered: {sorted(self._models)})")
+        return entry
+
+    # -- request path -------------------------------------------------------
+    def submit(self, model: str, payload: Any) -> Future:
+        """Enqueue one request; raises :class:`OverloadedError` at the
+        queue-depth cap and ``ValueError`` for a malformed payload (the
+        workload's submit-time ``validate`` — a bad request must reject
+        HERE, not poison every co-batched request at flush). The future
+        resolves to a reply dict:
+        ``{"result", "snapshot_version", "staleness_s"}``."""
+        entry = self._entry(model)
+        validate = getattr(entry.workload, "validate", None)
+        if validate is not None:
+            validate(payload)
+        return entry.batcher.submit(payload)
+
+    def predict(self, model: str, payload: Any,
+                timeout_s: float = 30.0) -> dict:
+        """Blocking request -> reply dict."""
+        return self.submit(model, payload).result(timeout=timeout_s)
+
+    # -- introspection ------------------------------------------------------
+    def stats(self, model: str) -> dict:
+        entry = self._entry(model)
+        return {**entry.batcher.stats(),
+                "snapshot_publishes": entry.manager.publishes,
+                "queue_depth": entry.batcher.queue_depth()}
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    # -- lifecycle ----------------------------------------------------------
+    def stop(self) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            entries = list(self._models.values())
+        for entry in entries:
+            entry.batcher.stop()

@@ -1,0 +1,105 @@
+"""Versioned copy-on-publish read views over live parameter state.
+
+The serving read path must satisfy two properties the raw table view
+cannot:
+
+* **no torn reads** — training ``Add``s donate the table's device buffer,
+  so a reply computed against ``table.array`` can observe state from two
+  different versions (or a donated-away buffer). A snapshot is ONE
+  ``jnp.copy`` dispatched under the table lock
+  (:meth:`tables.base.TableBase.snapshot_array`), so every element of a
+  reply comes from the same version by device-stream ordering.
+* **bounded staleness, surfaced** — the reference Multiverso serves reads
+  from whatever the server shard holds (async contract); here each reply
+  carries the snapshot's version and its age, and the batcher refreshes
+  the snapshot whenever training moved AND the published copy is older
+  than ``max_staleness_s``.
+
+Copy-on-PUBLISH, not copy-on-read: with training idle (version
+unchanged) the same device buffer serves indefinitely — zero copies on
+the reply hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+from ..log import Log
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    """Immutable published view: a device pytree + its source version."""
+
+    value: Any
+    version: int
+    published_at: float
+
+
+class SnapshotManager:
+    """Publishes/refreshes snapshots of one source (table or model).
+
+    ``read`` returns ``(device pytree copy, version)`` atomically w.r.t.
+    the source's mutation lock; ``version_fn`` probes the current version
+    without copying (the cheap "did training move?" check).
+    """
+
+    def __init__(self, read: Callable[[], Tuple[Any, int]],
+                 version_fn: Callable[[], int], name: str = "snapshot"):
+        self._read = read
+        self._version_fn = version_fn
+        self.name = name
+        self._lock = threading.Lock()
+        self._snap: Optional[Snapshot] = None
+        self.publishes = 0      # copies actually taken (copy-on-publish)
+
+    @classmethod
+    def of(cls, source: Any, name: Optional[str] = None) -> "SnapshotManager":
+        """Build from anything exposing the snapshot contract: a table
+        (``snapshot_array``), a ``TransformerLM`` (``snapshot_params``),
+        or a ``(read, version_fn)`` pair."""
+        label = name or getattr(source, "name", type(source).__name__)
+        if hasattr(source, "snapshot_array"):
+            return cls(source.snapshot_array,
+                       lambda: source.version, label)
+        if hasattr(source, "snapshot_params"):
+            return cls(source.snapshot_params,
+                       lambda: source.version, label)
+        if isinstance(source, tuple) and len(source) == 2:
+            return cls(source[0], source[1], label)
+        Log.fatal(f"SnapshotManager: {type(source).__name__} exposes "
+                  "neither snapshot_array nor snapshot_params")
+
+    def publish(self) -> Snapshot:
+        """Force a fresh copy (the copy-on-publish event)."""
+        with self._lock:
+            value, version = self._read()
+            self._snap = Snapshot(value, version, time.monotonic())
+            self.publishes += 1
+            return self._snap
+
+    def current(self) -> Snapshot:
+        with self._lock:
+            snap = self._snap
+        return snap if snap is not None else self.publish()
+
+    def ensure_fresh(self, max_staleness_s: float) -> Snapshot:
+        """The batcher's per-flush gate: republish iff training moved the
+        source AND the published copy is older than the bound. Replies
+        built from the returned snapshot therefore carry
+        ``staleness_s(snap) <= max_staleness_s``."""
+        snap = self.current()
+        if snap.version != self._version_fn():
+            if time.monotonic() - snap.published_at > max_staleness_s:
+                return self.publish()
+        return snap
+
+    def staleness_s(self, snap: Snapshot) -> float:
+        """Reply-visible staleness: 0 while the snapshot IS the live state
+        (version unchanged), else the copy's age."""
+        if snap.version == self._version_fn():
+            return 0.0
+        return time.monotonic() - snap.published_at

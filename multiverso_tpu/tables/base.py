@@ -100,6 +100,12 @@ class TableBase:
         # silently clamp/drop an OOB index inside jit).
         self.num_worker_slots = int(num_sim_workers or sess.num_workers)
         self._lock = threading.RLock()
+        # Monotonic mutation counter: every state install (dense apply,
+        # keyed apply, set_array, checkpoint load) bumps it under _lock.
+        # The serving layer's copy-on-publish snapshots key off it — a
+        # snapshot whose version equals the table's is bit-identical to
+        # the live state (staleness 0 by definition).
+        self.version = 0
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -292,6 +298,7 @@ class TableBase:
                 self._data, self._ustate, staged,
                 *_option_scalars(option, self.dtype),
             )
+            self.version += 1
             mon.end()
 
     # -- public ops --------------------------------------------------------
@@ -334,6 +341,20 @@ class TableBase:
         """Blocking whole-table Get -> host ndarray (``WorkerTable::Get``)."""
         return self.get_async(option).wait()
 
+    def snapshot_array(self) -> Tuple[jax.Array, int]:
+        """``(copy, version)`` for the serving read path.
+
+        The copy dispatches UNDER the table lock, so device-stream
+        ordering guarantees it reads the state as of ``version`` even
+        though later adds donate ``_data`` — the same contract as
+        :meth:`get_async`, but the result stays on device (padded
+        physical shape; serving consumers slice via :meth:`logical`).
+        Concurrent training ``Add``s can therefore never tear a response
+        built from the returned buffer.
+        """
+        with self._lock:
+            return jnp.copy(self._data), self.version
+
     # -- device-side view --------------------------------------------------
     @property
     def array(self) -> jax.Array:
@@ -361,6 +382,7 @@ class TableBase:
                       f"{self.shape} (physical {self.padded_shape})")
         with self._lock:
             self._data = jax.device_put(value, self.sharding)
+            self.version += 1
 
     def flush(self) -> None:
         """Block until all dispatched updates have landed."""
@@ -384,6 +406,7 @@ class TableBase:
         with self._lock:
             self._data = jax.device_put(
                 self._pad_host(host.astype(self.dtype)), self.sharding)
+            self.version += 1
 
     @property
     def size(self) -> int:

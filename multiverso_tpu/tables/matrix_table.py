@@ -109,6 +109,7 @@ class MatrixTable(TableBase):
                 jnp.asarray(padded_ids), jnp.asarray(padded_vals),
                 jnp.asarray(mask), *_option_scalars(option, self.dtype),
             )
+            self.version += 1
 
     def add_rows_async(self, row_ids: Any, values: Any,
                        option: Optional[AddOption] = None) -> AsyncHandle:

@@ -64,6 +64,7 @@ class SparseTable(TableBase):
                 jnp.asarray(padded_ids), jnp.asarray(padded_vals),
                 jnp.asarray(mask), *_option_scalars(option, self.dtype),
             )
+            self.version += 1
 
     def add_keys_async(self, keys: Any, values: Any,
                        option: Optional[AddOption] = None) -> AsyncHandle:
@@ -122,6 +123,7 @@ class FTRLTable(TableBase):
             self._data = self._key_apply(
                 self._data, jnp.asarray(padded_ids), jnp.asarray(padded_vals),
                 jnp.asarray(mask))
+            self.version += 1
 
     def add_keys(self, keys: Any, delta_z: Any, delta_n: Any) -> None:
         """Accumulate ``FTRLGradient{delta_z, delta_n}`` per key."""

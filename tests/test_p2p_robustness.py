@@ -220,6 +220,69 @@ def _free_port():
     return port
 
 
+def test_silent_hello_does_not_wedge_accept_loop(monkeypatch):
+    """A half-open connection (connects, never sends the 12-byte hello)
+    must be dropped after the hello timeout instead of wedging the single
+    accept thread — every other peer's (re)connect funnels through it
+    (ADVICE r5)."""
+    from multiverso_tpu.parallel import p2p as p2p_mod
+
+    monkeypatch.setattr(p2p_mod, "_HELLO_TIMEOUT_S", 0.3)
+    kv = _FakeKV()
+    a = P2PTransport(0, 2, kv, label="s")
+    b = None
+    silent = None
+    try:
+        host, _, port = str(kv.blocking_key_value_get("s/ep/0", 1000)
+                            ).rpartition(":")
+        # park a silent connection in the accept loop FIRST...
+        silent = socket.create_connection((host, int(port)), timeout=5)
+        time.sleep(0.05)
+        # ...then bring up the real subscriber behind it
+        b = P2PTransport(1, 2, kv, label="s")
+        a.send(0, b"r0")
+        # deliverable only once the accept loop times the silent hello
+        # out and reaches b's queued subscription
+        assert _drain(b, 0, 0, 1, timeout=15) == [b"r0"]
+    finally:
+        if silent is not None:
+            silent.close()
+        if b is not None:
+            b.stop()
+        a.stop()
+
+
+def test_out_of_contract_resume_surfaces_death_to_bus():
+    """A peer resuming below the released window is transport-dead; the
+    fix surfaces that through on_dead so the BUS ack quorum shrinks too
+    (instead of the publisher burning the 600-s backpressure fatal,
+    ADVICE r5)."""
+    kv = _FakeKV()
+    reported = []
+    a = P2PTransport(0, 2, kv, label="d",
+                     on_dead=lambda ranks: reported.extend(ranks))
+    conn = None
+    try:
+        a.send(0, b"x")
+        a.send(1, b"y")
+        a.release(0)
+        a.release(1)
+        host, _, port = str(kv.blocking_key_value_get("d/ep/0", 1000)
+                            ).rpartition(":")
+        # pose as rank 1 resuming from the GC'd seq 0
+        conn = socket.create_connection((host, int(port)), timeout=5)
+        conn.sendall(_HELLO.pack(1, 0))
+        deadline = time.monotonic() + 10
+        while reported != [1]:
+            assert time.monotonic() < deadline, "on_dead never fired"
+            time.sleep(0.01)
+        assert 1 in a._dead
+    finally:
+        if conn is not None:
+            conn.close()
+        a.stop()
+
+
 def test_three_process_sigstop_transient_stall(tmp_path):
     """One of three async-training processes is SIGSTOP'd for ~3 s
     (shorter than the 10 s watchdog window) then SIGCONT'd: the bus

@@ -25,26 +25,55 @@ import numpy as np
 import pytest
 
 
+def _dp1_contended(baseline_ms: float, band: float = 0.05) -> bool:
+    """Contention sentinel (VERDICT r5 weak 1): re-measure the dp=1
+    baseline twice; spread beyond the banked ±5% tunnel (BASELINE.md)
+    means the host is contended RIGHT NOW and an eff_norm miss is
+    environmental, not a data-plane regression."""
+    from tools.scaling_bench import w2v_weak_scaling
+
+    # repeats=2 matches dryrun_sweep's best-of-2 estimator — single-shot
+    # re-measurements are systematically slower than a best-of-2 and
+    # would inflate spread, mis-classifying real regressions as noise
+    times = [baseline_ms] + [
+        w2v_weak_scaling([1], per_dev_batch=2048, vocab=20000, dim=128,
+                         steps=25, repeats=2)[0]["time_ms"]
+        for _ in range(2)]
+    return (max(times) - min(times)) / min(times) > band
+
+
 def test_w2v_real_shape_efficiency_floor():
     from tools.scaling_bench import dryrun_sweep
 
-    rows = dryrun_sweep([1, 8])
-    by_dp = {r["dp"]: r for r in rows}
-    assert by_dp[1]["eff_norm"] == 1.0
-    for r in rows:
-        assert np.isfinite(r["pairs_per_sec"]) and r["pairs_per_sec"] > 0
     # r5 floor, tightened to the measured band: the dispatch exchange
     # measures eff_norm 0.96-0.97 at dp=8 on an idle host (overhead ~3%,
     # MULTICHIP_r04); 0.85 holds ~11 points of margin for host noise
     # (banked tunnel spread is ±5%) while still failing a reintroduction
     # of the r3 per-batch dense-allreduce path (which measured 0.43).
-    # The r4 floor of 0.55 would have let a 40-point regression — most
-    # of the r4 win — ship green (VERDICT r4 weak 4).
-    assert by_dp[8]["eff_norm"] >= 0.85, rows
-    # bench-band guard on the sweep's own overhead accounting (the
-    # number MULTICHIP_r*.json embeds): dispatch exchange measures ~3%;
-    # 10% is the band edge (VERDICT r4 item 5)
-    assert by_dp[8]["overhead_frac"] <= 0.10, rows
+    # A miss only COUNTS on a quiet host: the sentinel re-measures the
+    # dp=1 baseline and retries/skips when its spread exceeds the noise
+    # band, so the floor can't intermittently fail for environmental
+    # reasons and train people to rerun red CI (VERDICT r5 weak 1).
+    rows = None
+    for attempt in range(3):
+        rows = dryrun_sweep([1, 8])
+        by_dp = {r["dp"]: r for r in rows}
+        assert by_dp[1]["eff_norm"] == 1.0
+        for r in rows:
+            assert np.isfinite(r["pairs_per_sec"]) and r["pairs_per_sec"] > 0
+        floor_ok = by_dp[8]["eff_norm"] >= 0.85
+        # bench-band guard on the sweep's own overhead accounting (the
+        # number MULTICHIP_r*.json embeds): dispatch exchange measures
+        # ~3%; 10% is the band edge (VERDICT r4 item 5)
+        band_ok = by_dp[8]["overhead_frac"] <= 0.10
+        if floor_ok and band_ok:
+            return
+        if not _dp1_contended(by_dp[1]["time_ms"]):
+            # quiet host: the miss is attributable — a real regression
+            assert floor_ok, rows
+            assert band_ok, rows
+    pytest.skip("host contended (dp=1 spread beyond the ±5% noise band "
+                f"on every attempt); eff_norm floor not attributable: {rows}")
 
 
 def test_quick_sweep_sane_and_saturation_annotated():

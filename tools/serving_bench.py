@@ -1,0 +1,161 @@
+"""Closed-loop serving load generator -> one JSON line.
+
+Drives the three serving workloads (word2vec neighbor lookup, logreg
+predict, LM greedy decode) through ``serving.InferenceServer`` with N
+closed-loop clients each (issue -> wait -> issue; sheds back off briefly),
+and emits ONE JSON line with qps / p50 / p99 / shed_rate per workload —
+the serving counterpart of bench.py's training line, so BENCH rounds can
+track both sides of the train/serve stack.
+
+Each workload is also measured with the scheduler degraded to batch=1
+(same jitted workload, bucket set {1}) to price micro-batching itself:
+``speedup_batched`` is saturated batched qps over batch=1 qps.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/serving_bench.py [-duration 2.0]
+        [-clients 32] [-quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _closed_loop(server, model: str, payload_fn, duration_s: float,
+                 clients: int) -> dict:
+    """N clients issuing blocking predicts for ``duration_s``; returns
+    qps/latency/shed stats measured OVER THE LOOP (warmup excluded)."""
+    from multiverso_tpu.serving import OverloadedError
+
+    stop = time.monotonic() + duration_s
+    counts = [0] * clients
+    sheds = [0] * clients
+
+    def client(ix: int) -> None:
+        rng = np.random.default_rng(ix)
+        while time.monotonic() < stop:
+            try:
+                server.predict(model, payload_fn(rng), timeout_s=60.0)
+                counts[ix] += 1
+            except OverloadedError:
+                sheds[ix] += 1
+                time.sleep(0.0005)          # shed: back off, retry
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 120)
+    elapsed = time.monotonic() - t0
+    done, shed = sum(counts), sum(sheds)
+    stats = server.stats(model)
+    return {
+        "qps": round(done / elapsed, 1),
+        "p50_ms": round(stats["p50_ms"], 3),
+        "p99_ms": round(stats["p99_ms"], 3),
+        "shed_rate": round(shed / (done + shed), 4) if done + shed else 0.0,
+        "completed": done,
+    }
+
+
+def _warm(workload, snap_mgr, buckets) -> None:
+    """Compile every bucket outside the timed loop (and outside the
+    latency histogram)."""
+    snap = snap_mgr.current()
+    for b in buckets:
+        payloads = [workload._warm_payload() for _ in range(b)]
+        workload.run(payloads, b, snap)
+
+
+def run(duration_s: float = 2.0, clients: int = 32,
+        quick: bool = False) -> dict:
+    import multiverso_tpu as mv
+
+    mv.init(["serving_bench", "-log_level=error"])
+    from multiverso_tpu.models.logreg import LogReg, LogRegConfig
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import (EmbeddingNeighbors, InferenceServer,
+                                        LMGreedyDecode, LogRegPredict)
+
+    if quick:
+        duration_s = min(duration_s, 1.0)
+
+    server = InferenceServer("bench")
+    vocab, dim = 8192, 128
+    w2v_table = mv.create_table("matrix", vocab, dim, init_value="random",
+                                name="serve_w2v")
+    w2v = EmbeddingNeighbors(w2v_table, k=8)
+    w2v._warm_payload = lambda: 1
+    lr_table = mv.create_table("matrix", 10, 129, updater="sgd",
+                               name="serve_lr")
+    logreg = LogRegPredict(LogReg(LogRegConfig(
+        input_size=128, output_size=10, objective_type="softmax"), lr_table))
+    logreg._warm_payload = lambda: np.zeros(128, np.float32)
+    lm_cfg = TransformerConfig(vocab_size=256, d_model=64, n_heads=4,
+                               n_layers=2, d_ff=128, max_seq=32)
+    lm = LMGreedyDecode(TransformerLM(lm_cfg), max_prompt=8, max_new=4)
+    lm._warm_payload = lambda: np.ones(4, np.int32)
+
+    # lm compiles are the expensive ones: keep its bucket set minimal
+    specs = {
+        "w2v": (w2v, dict(max_batch=64, deadline_ms=2.0, max_queue=128,
+                          buckets=(1, 8, 64)), clients,
+                lambda rng: int(rng.integers(0, vocab))),
+        "logreg": (logreg, dict(max_batch=64, deadline_ms=2.0, max_queue=128,
+                                buckets=(1, 8, 64)), clients,
+                   lambda rng: rng.random(128).astype(np.float32)),
+        "lm": (lm, dict(max_batch=8, deadline_ms=4.0, max_queue=64,
+                        buckets=(1, 8)), max(4, clients // 4),
+               lambda rng: rng.integers(1, 256, 6).astype(np.int32)),
+    }
+
+    out: dict = {"bench": "serving", "clients": clients,
+                 "duration_s": duration_s, "workloads": {}}
+    for name, (workload, knobs, n_clients, payload_fn) in specs.items():
+        server.register(name, workload, **knobs)
+        server.register(f"{name}_b1", workload, max_batch=1,
+                        deadline_ms=knobs["deadline_ms"],
+                        max_queue=knobs["max_queue"], buckets=(1,))
+        entry = server._entry(name)
+        _warm(workload, entry.manager, knobs["buckets"])
+        row = _closed_loop(server, name, payload_fn, duration_s, n_clients)
+        b1 = _closed_loop(server, f"{name}_b1", payload_fn,
+                          min(duration_s, 1.5), n_clients)
+        row["qps_batch1"] = b1["qps"]
+        row["speedup_batched"] = (round(row["qps"] / b1["qps"], 2)
+                                  if b1["qps"] else float("inf"))
+        row["jit_traces"] = workload.jit_cache_size()
+        out["workloads"][name] = row
+    out["max_speedup_batched"] = max(
+        r["speedup_batched"] for r in out["workloads"].values())
+    mv.shutdown()
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-duration", type=float, default=2.0,
+                    help="seconds of closed-loop load per workload")
+    ap.add_argument("-clients", type=int, default=32)
+    ap.add_argument("-quick", action="store_true",
+                    help="cap duration at 1 s (CI smoke)")
+    args, _ = ap.parse_known_args()
+    result = run(args.duration, args.clients, args.quick)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
