@@ -112,6 +112,14 @@ class Histogram:
             self._pos = (self._pos + 1) % len(self._buf)
             self._n = min(self._n + 1, len(self._buf))
 
+    def reset(self) -> None:
+        """Drop retained samples (benches: exclude warmup compiles from
+        the measured distribution)."""
+        with self._lock:
+            self.count = 0
+            self._n = 0
+            self._pos = 0
+
     def percentiles(self, ps) -> Dict[float, float]:
         """Nearest-rank percentiles over the retained window in ONE sort
         (0s if empty) — summary()/stats() pollers would otherwise pay a
@@ -144,11 +152,40 @@ class Histogram:
                 f"p99 = {s['p99_ms']:.3f} ms")
 
 
+class Gauge:
+    """Last-value instrument: a point-in-time level, not a distribution.
+
+    The serving engine's occupancy/throughput readouts (slots in use,
+    decode tokens/sec) are levels — a histogram of them would average
+    away exactly the saturation signal an operator looks for. ``set``
+    overwrites; ``get`` reads the latest value.
+    """
+
+    def __init__(self, name: str, register: bool = True) -> None:
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+        if register:
+            Dashboard.add_gauge(self)
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def get(self) -> float:
+        with self._lock:
+            return self._value
+
+    def info_string(self) -> str:
+        return f"[{self.name}] value = {self.get():.3f}"
+
+
 class Dashboard:
     """Process-global monitor registry (reference ``dashboard.h:16-24``)."""
 
     _monitors: Dict[str, Monitor] = {}
     _histograms: Dict[str, "Histogram"] = {}
+    _gauges: Dict[str, "Gauge"] = {}
     _lock = threading.Lock()
 
     @classmethod
@@ -162,6 +199,11 @@ class Dashboard:
             cls._histograms[hist.name] = hist
 
     @classmethod
+    def add_gauge(cls, gauge: "Gauge") -> None:
+        with cls._lock:
+            cls._gauges[gauge.name] = gauge
+
+    @classmethod
     def get_or_create_histogram(cls, name: str) -> "Histogram":
         with cls._lock:
             hist = cls._histograms.get(name)
@@ -169,6 +211,15 @@ class Dashboard:
                 hist = Histogram(name, register=False)
                 cls._histograms[name] = hist
             return hist
+
+    @classmethod
+    def get_or_create_gauge(cls, name: str) -> "Gauge":
+        with cls._lock:
+            gauge = cls._gauges.get(name)
+            if gauge is None:
+                gauge = Gauge(name, register=False)
+                cls._gauges[name] = gauge
+            return gauge
 
     @classmethod
     def get_or_create(cls, name: str) -> Monitor:
@@ -190,11 +241,14 @@ class Dashboard:
         with cls._lock:
             mon = cls._monitors.get(name)
             hist = cls._histograms.get(name)
+            gauge = cls._gauges.get(name)
         if mon is not None:
             return {"count": mon.count, "total_ms": mon.total_ms,
                     "avg_ms": mon.average_ms()}
         if hist is not None:
             return hist.summary()
+        if gauge is not None:
+            return {"value": gauge.get()}
         return None
 
     @classmethod
@@ -202,9 +256,11 @@ class Dashboard:
         with cls._lock:
             monitors = list(cls._monitors.values())
             histograms = list(cls._histograms.values())
+            gauges = list(cls._gauges.values())
         lines = ["--------------Dashboard--------------"]
         lines += [m.info_string() for m in monitors]
         lines += [h.info_string() for h in histograms]
+        lines += [g.info_string() for g in gauges]
         text = "\n".join(lines)
         if emit is None:
             from .log import Log
@@ -217,6 +273,7 @@ class Dashboard:
         with cls._lock:
             cls._monitors.clear()
             cls._histograms.clear()
+            cls._gauges.clear()
 
 
 @contextmanager

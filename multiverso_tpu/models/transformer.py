@@ -292,15 +292,91 @@ def prefill(cfg: TransformerConfig, params: Dict[str, Any],
     return logits, jnp.stack(ks), jnp.stack(vs)
 
 
+def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
+                k_cache: jax.Array, v_cache: jax.Array, tok: jax.Array,
+                pos: jax.Array, active: jax.Array
+                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One fused token step over S persistent slots.
+
+    ``k_cache``/``v_cache`` [L, S, T, D], ``tok``/``pos`` [S] int32,
+    ``active`` [S] bool. Each slot is an independent sequence: writes its
+    token's K/V at ``pos``, attends the cache through ``pos``
+    (:func:`_cached_attention` — the math of :func:`greedy_decode`'s scan
+    body, with the batch dim reinterpreted as the slot dim), and emits its
+    greedy next token. Dead slots still flow through the fused program
+    (one compiled trace regardless of which slots live) but emit pad and
+    keep a frozen ``pos``; their cache writes land in slots nothing
+    attends, and an admission's :func:`cache_insert` overwrites the prompt
+    region before the slot goes live again.
+
+    Returns ``(k_cache, v_cache, next_tok [S], pos [S])`` — jit with
+    ``donate_argnums`` on the caches so XLA updates them in place.
+    """
+    S = tok.shape[0]
+    slot_ix = jnp.arange(S)
+    h = (jnp.take(params["embed"], tok, axis=0)
+         + jnp.take(params["pos"], pos, axis=0))
+    for i in range(cfg.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["layers"])
+        x = _rmsnorm(h, layer["ln1_g"])
+        q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
+        k_cache = k_cache.at[i, slot_ix, pos].set(k)
+        v_cache = v_cache.at[i, slot_ix, pos].set(v)
+        h = h + _cached_attention(
+            q, k_cache[i], v_cache[i], cfg.n_heads, pos) @ layer["w_o"]
+        x = _rmsnorm(h, layer["ln2_g"])
+        h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+    h = _rmsnorm(h, params["ln_f_g"])
+    out = jnp.einsum("sd,vd->sv", h, params["embed"],
+                     preferred_element_type=jnp.float32)
+    nxt = jnp.argmax(out, axis=-1).astype(tok.dtype)
+    nxt = jnp.where(active, nxt, jnp.zeros_like(nxt))
+    pos = jnp.where(active, pos + 1, pos)
+    return k_cache, v_cache, nxt, pos
+
+
+def cache_insert(k_cache: jax.Array, v_cache: jax.Array, slots: jax.Array,
+                 ks: jax.Array, vs: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Write b prefilled sequences' K/V [L, b, P, D] into slots ``slots``.
+
+    ``slots`` [b] are traced slot indices (one compiled insert per
+    (batch bucket b, prompt bucket P), reused for every slot choice).
+    The rows land as a CHAIN of dynamic-update-slices, iterated so row 0
+    writes LAST: a caller padding a partial batch up to bucket b points
+    the pad rows at ``slots[0]`` and the real row deterministically
+    overwrites them (an XLA scatter with duplicate indices would be
+    order-undefined). Positions past a prompt's true length hold prefill
+    garbage — decode overwrites position ``pos`` before the attention
+    mask ever reaches it, so the garbage is never observable (the
+    :func:`prefill` contract).
+    """
+    zero = jnp.zeros((), slots.dtype)
+    for i in reversed(range(ks.shape[1])):
+        start = (zero, slots[i], zero, zero)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, ks[:, i][:, None], start)
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, vs[:, i][:, None], start)
+    return k_cache, v_cache
+
+
 def greedy_decode(cfg: TransformerConfig, params: Dict[str, Any],
                   tokens: jax.Array, lengths: jax.Array,
-                  max_new: int) -> jax.Array:
-    """Greedy continuation: ``max_new`` tokens per prompt.
+                  max_new: int, eos_id: Optional[int] = None) -> jax.Array:
+    """Greedy continuation: up to ``max_new`` tokens per prompt.
 
     ``tokens`` [B, P] right-padded prompt ids, ``lengths`` [B] true prompt
     lengths (callers guarantee ``lengths + max_new <= cfg.max_seq``).
     Returns [B, max_new] generated ids. jit-able with static ``max_new``
     (the serving workload jits one instance per (B, P) shape bucket).
+
+    With ``eos_id`` set, a lane that emits ``eos_id`` is FROZEN: later
+    emissions are pad (0) and its ``pos`` stops advancing, so the lane
+    stops widening the attention mask while the rest of the batch
+    finishes — the batch still runs all ``max_new`` scan iterations
+    (static shape), but finished lanes' output prefixes are bit-identical
+    to the ``eos_id=None`` run up to and including the eos token.
     """
     B, P = tokens.shape
     # cache bound: positions can only ever reach P + max_new - 1 (callers
@@ -318,7 +394,7 @@ def greedy_decode(cfg: TransformerConfig, params: Dict[str, Any],
     batch_ix = jnp.arange(B)
 
     def step(carry, _):
-        k_cache, v_cache, pos, tok = carry
+        k_cache, v_cache, pos, tok, done = carry
         h = (jnp.take(params["embed"], tok, axis=0)
              + jnp.take(params["pos"], pos, axis=0))
         for i in range(L):
@@ -335,12 +411,20 @@ def greedy_decode(cfg: TransformerConfig, params: Dict[str, Any],
         out = jnp.einsum("bd,vd->bv", h, params["embed"],
                          preferred_element_type=jnp.float32)
         nxt = jnp.argmax(out, axis=-1).astype(tok.dtype)
-        return (k_cache, v_cache, pos + 1, nxt), nxt
+        # frozen lanes emit pad and stop paying attention width; live
+        # lanes run the exact eos_id=None math (prefix-identical outputs)
+        emit = jnp.where(done, jnp.zeros_like(nxt), nxt)
+        new_done = done if eos_id is None else done | (emit == eos_id)
+        new_pos = jnp.where(done, pos, pos + 1)
+        return (k_cache, v_cache, new_pos, emit, new_done), emit
 
     if max_new <= 1:
         return first[:, None]
+    done0 = (first == eos_id) if eos_id is not None else jnp.zeros(
+        (B,), bool)
     _, rest = jax.lax.scan(
-        step, (k_cache, v_cache, lengths, first), None, length=max_new - 1)
+        step, (k_cache, v_cache, lengths, first, done0), None,
+        length=max_new - 1)
     return jnp.concatenate([first[:, None], rest.T], axis=1)
 
 

@@ -13,10 +13,15 @@ The inference half of the train/serve stack (docs/SERVING.md). Pieces:
   :class:`EmbeddingNeighbors` (word2vec lookup + top-k),
   :class:`LogRegPredict` / :class:`FTRLPredict`, and
   :class:`LMGreedyDecode` (KV-cache greedy decode).
+* :class:`DecodeEngine` — continuous-batching LM decode: persistent
+  slotted KV cache, ONE fused jitted step per iteration,
+  iteration-granular admission/completion
+  (``InferenceServer.register_decoder``).
 """
 
 from .batcher import (BatcherConfig, MicroBatcher, OverloadedError,
                       bucket_for, shape_buckets)
+from .decode_engine import DecodeEngine, DecodeEngineConfig
 from .server import InferenceServer
 from .snapshot import Snapshot, SnapshotManager
 from .workloads import (EmbeddingNeighbors, FTRLPredict, LMGreedyDecode,
@@ -26,4 +31,5 @@ __all__ = [
     "BatcherConfig", "MicroBatcher", "OverloadedError", "bucket_for",
     "shape_buckets", "InferenceServer", "Snapshot", "SnapshotManager",
     "EmbeddingNeighbors", "FTRLPredict", "LMGreedyDecode", "LogRegPredict",
+    "DecodeEngine", "DecodeEngineConfig",
 ]

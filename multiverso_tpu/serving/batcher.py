@@ -117,6 +117,9 @@ class MicroBatcher:
         self.completed = 0
         self.shed = 0
         self.t_first: Optional[float] = None
+        # idle-wait returns (tests assert an idle server never wakes:
+        # the idle wait is untimed, not a poll)
+        self.idle_wakeups = 0
         # recent (n, bucket, cause) flush records, for tests/introspection
         self.flushes: Deque[Tuple[int, int, str]] = collections.deque(
             maxlen=1024)
@@ -156,8 +159,12 @@ class MicroBatcher:
         max_batch = self.config.max_batch
         while True:
             with self._cv:
+                # UNTIMED idle wait: submit() and stop() both notify, so a
+                # poll here only burned 20 wakeups/s per registered model
+                # while idle
                 while not self._q and not self._stop.is_set():
-                    self._cv.wait(0.05)
+                    self._cv.wait()
+                    self.idle_wakeups += 1
                 if self._stop.is_set() and not self._q:
                     return
                 # queue non-empty: wait for a full batch, bounded by the

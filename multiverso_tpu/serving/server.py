@@ -22,7 +22,27 @@ from typing import Any, Dict, List, Optional
 
 from ..log import Log
 from .batcher import BatcherConfig, MicroBatcher
+from .decode_engine import DecodeEngine, DecodeEngineConfig
 from .snapshot import SnapshotManager
+
+
+class _DecoderEntry:
+    """A continuous-batching LM: requests route to a :class:`DecodeEngine`
+    (iteration-level scheduling) instead of a :class:`MicroBatcher`."""
+
+    def __init__(self, name: str, engine: DecodeEngine) -> None:
+        self.name = name
+        self.engine = engine
+
+    def submit(self, payload: Any) -> Future:
+        """Payload: a 1-D prompt id array, or a dict with ``prompt`` and
+        optional per-request ``max_new``."""
+        if isinstance(payload, dict):
+            if "prompt" not in payload:
+                raise ValueError("decoder payload dict needs a 'prompt' key")
+            return self.engine.submit(payload["prompt"],
+                                      payload.get("max_new"))
+        return self.engine.submit(payload)
 
 
 class _ModelEntry:
@@ -83,6 +103,34 @@ class InferenceServer:
         Log.info("serving: model %r up (max_batch %d, deadline %.1f ms, "
                  "queue cap %d)", name, max_batch, deadline_ms, max_queue)
 
+    def register_decoder(self, name: str, lm, *, slots: int = 8,
+                         max_prompt: int = 64, max_new: int = 32,
+                         eos_id: Optional[int] = None, max_queue: int = 256,
+                         max_staleness_s: float = 0.05,
+                         prompt_buckets: Optional[tuple] = None
+                         ) -> DecodeEngine:
+        """Attach a continuous-batching decode engine under ``name``.
+
+        Unlike :meth:`register`'s micro-batched ``LMGreedyDecode``,
+        ``submit`` routes straight into the engine: admission, decode,
+        and completion all happen at iteration granularity (no request
+        ever waits for a co-batched stranger's generation to finish).
+        Payloads are 1-D prompt id arrays, or ``{"prompt": ...,
+        "max_new": n}`` for a per-request generation cap.
+        """
+        cfg = DecodeEngineConfig(
+            slots=slots, max_prompt=max_prompt, max_new=max_new,
+            eos_id=eos_id, max_queue=max_queue,
+            max_staleness_s=max_staleness_s, prompt_buckets=prompt_buckets)
+        with self._lock:
+            if name in self._models:
+                Log.fatal(f"serving: model {name!r} already registered")
+            entry = _DecoderEntry(name, DecodeEngine(name, lm, cfg))
+            self._models[name] = entry
+        Log.info("serving: decoder %r up (%d slots, max_prompt %d, "
+                 "max_new %d)", name, slots, max_prompt, max_new)
+        return entry.engine
+
     def _entry(self, name: str) -> _ModelEntry:
         with self._lock:
             entry = self._models.get(name)
@@ -100,6 +148,8 @@ class InferenceServer:
         resolves to a reply dict:
         ``{"result", "snapshot_version", "staleness_s"}``."""
         entry = self._entry(model)
+        if isinstance(entry, _DecoderEntry):
+            return entry.submit(payload)
         validate = getattr(entry.workload, "validate", None)
         if validate is not None:
             validate(payload)
@@ -113,6 +163,8 @@ class InferenceServer:
     # -- introspection ------------------------------------------------------
     def stats(self, model: str) -> dict:
         entry = self._entry(model)
+        if isinstance(entry, _DecoderEntry):
+            return entry.engine.stats()
         return {**entry.batcher.stats(),
                 "snapshot_publishes": entry.manager.publishes,
                 "queue_depth": entry.batcher.queue_depth()}
@@ -129,4 +181,7 @@ class InferenceServer:
             self._stopped = True
             entries = list(self._models.values())
         for entry in entries:
-            entry.batcher.stop()
+            if isinstance(entry, _DecoderEntry):
+                entry.engine.stop()
+            else:
+                entry.batcher.stop()

@@ -12,13 +12,14 @@ shape; workloads slice to logical rows exactly like the training math
 
 from __future__ import annotations
 
-from typing import Any, List, Tuple
+from typing import Any, List
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..log import Log
+from .snapshot import DerivedCache, replicate_for_decode
 
 
 def _jit_cache_size(fn) -> int:
@@ -50,7 +51,6 @@ class EmbeddingNeighbors:
         rows = table.shape[0]
         if self.k >= rows:
             Log.fatal(f"EmbeddingNeighbors: k={k} >= vocab {rows}")
-        self._derived: Tuple[int, Any] = (-1, None)
 
         logical_rows = rows
 
@@ -70,13 +70,7 @@ class EmbeddingNeighbors:
 
         self._normalize = jax.jit(normalize)
         self._fn = jax.jit(neighbors)
-
-    def _normed(self, snapshot_value, version: int):
-        ver, cached = self._derived
-        if ver != version:
-            cached = self._normalize(snapshot_value)
-            self._derived = (version, cached)
-        return cached
+        self._derived = DerivedCache(self._normalize)
 
     def validate(self, payload) -> None:
         """Host-side id check at SUBMIT time: XLA silently clamps an OOB
@@ -88,7 +82,7 @@ class EmbeddingNeighbors:
                              f"[0, {self.source.shape[0]})")
 
     def run(self, payloads: List[int], bucket: int, snap) -> List[Any]:
-        normed = self._normed(snap.value, snap.version)
+        normed = self._derived.get(snap)
         ids = np.zeros(bucket, np.int32)
         ids[: len(payloads)] = np.asarray(payloads, np.int32)
         scores, nbr = self._fn(normed, jnp.asarray(ids))
@@ -190,7 +184,8 @@ class LMGreedyDecode:
     attention mask.
     """
 
-    def __init__(self, lm, max_prompt: int, max_new: int) -> None:
+    def __init__(self, lm, max_prompt: int, max_new: int,
+                 eos_id: "int | None" = None) -> None:
         from ..models.transformer import greedy_decode
 
         cfg = lm.config
@@ -200,9 +195,16 @@ class LMGreedyDecode:
         self.source = lm
         self.max_prompt = int(max_prompt)
         self.max_new = int(max_new)
+        # eos_id freezes finished lanes (pad emissions, frozen pos) — the
+        # batch still runs all max_new iterations, it just stops paying
+        # attention width for completed sequences
         self._fn = jax.jit(
             lambda params, toks, lens: greedy_decode(
-                cfg, params, toks, lens, int(max_new)))
+                cfg, params, toks, lens, int(max_new), eos_id))
+        # decode serves a replicated single-device params copy (see
+        # snapshot.replicate_for_decode: ~2x flush wall otherwise on the
+        # CPU harness), derived once per snapshot version
+        self._plain = DerivedCache(replicate_for_decode)
 
     def validate(self, payload) -> None:
         """Submit-time check: a bad prompt must reject ITS request, not
@@ -219,8 +221,8 @@ class LMGreedyDecode:
             p = np.asarray(p, np.int32).ravel()
             toks[i, : p.shape[0]] = p
             lens[i] = p.shape[0]
-        out = np.asarray(self._fn(snap.value, jnp.asarray(toks),
-                                  jnp.asarray(lens)))
+        out = np.asarray(self._fn(self._plain.get(snap),
+                                  jnp.asarray(toks), jnp.asarray(lens)))
         return [out[i] for i in range(len(payloads))]
 
     def jit_cache_size(self) -> int:

@@ -24,6 +24,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long benches excluded from the tier-1 run (-m 'not slow')")
+
+
 @pytest.fixture()
 def mv_session():
     """Fresh framework session per test (init -> yield -> shutdown)."""

@@ -1,0 +1,247 @@
+"""Continuous-batching decode engine: correctness under churn.
+
+The acceptance contract of the decode-engine PR (docs/SERVING.md,
+"Continuous batching"):
+
+* **oracle exactness** — for a randomized admission trace (mixed prompt
+  lengths, per-request max_new, arrivals in waves), every request's
+  engine output equals a per-request ``greedy_decode`` run: slot reuse,
+  active-lane masking, and bucketed admission are invisible in the
+  tokens;
+* **one compiled step** — the fused step's jit cache holds exactly ONE
+  trace after warmup, no matter how the request mix churns (the engine's
+  whole point: shapes never depend on scheduling state);
+* **eos slot turnover** — sequences hitting ``eos_id`` free their slot
+  early and return truncated outputs (the oracle's frozen-lane prefix);
+* **snapshot pinning** — an admission pins one params version for its
+  whole generation; concurrent ``train_batch`` never tears an in-flight
+  sequence (the PR 1 tear-free contract, extended from one flush to one
+  generation).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def _small_cfg(**kw):
+    from multiverso_tpu.models.transformer import TransformerConfig
+
+    # vocab/d_model/d_ff divisible by the 8-way test mesh: TransformerLM
+    # shards embed rows and ffn columns over the server axis
+    base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_seq=48)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _oracle(cfg, params, prompt, max_new, eos_id=None):
+    """Per-request greedy_decode, truncated at eos like the engine."""
+    import jax.numpy as jnp
+
+    from multiverso_tpu.models.transformer import greedy_decode
+
+    out = np.asarray(greedy_decode(
+        cfg, params, jnp.asarray(prompt[None]),
+        jnp.asarray([len(prompt)]), max_new, eos_id))[0]
+    if eos_id is not None:
+        hits = np.nonzero(out == eos_id)[0]
+        if hits.size:
+            return out[: hits[0] + 1]
+    return out
+
+
+def test_engine_matches_oracle_random_trace(mv_session):
+    """Property test: random arrival/length trace, bit-exact vs the
+    per-request oracle, and ONE compiled fused step after warmup."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    engine = srv.register_decoder("lm", lm, slots=4, max_prompt=8,
+                                  max_new=10)
+    engine.warmup()
+    params, _ = lm.snapshot_params()
+
+    rng = np.random.default_rng(0)
+    futs, reqs = [], []
+    for wave in range(4):                   # arrivals in bursty waves
+        for _ in range(int(rng.integers(2, 9))):
+            prompt = rng.integers(1, cfg.vocab_size,
+                                  int(rng.integers(1, 9))).astype(np.int32)
+            max_new = int(rng.integers(1, 11))
+            reqs.append((prompt, max_new))
+            futs.append(srv.submit(
+                "lm", {"prompt": prompt, "max_new": max_new}))
+        time.sleep(0.01)
+
+    for (prompt, max_new), fut in zip(reqs, futs):
+        reply = fut.result(timeout=120)
+        np.testing.assert_array_equal(
+            reply["result"], _oracle(cfg, params, prompt, max_new),
+            err_msg=f"prompt {prompt} max_new {max_new}")
+    assert engine.step_cache_size() == 1, "fused step retraced under churn"
+    stats = engine.stats()
+    assert stats["completed"] == len(reqs)
+    assert stats["tokens"] == sum(n for _, n in reqs)
+
+
+def test_engine_eos_frees_slots_and_truncates(mv_session):
+    """Sequences hitting eos_id return early-truncated outputs (oracle
+    prefix incl. the eos token) and their slots turn over."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    eos = 7
+    engine = srv.register_decoder("lm", lm, slots=2, max_prompt=8,
+                                  max_new=12, eos_id=eos)
+    engine.warmup()
+    params, _ = lm.snapshot_params()
+
+    rng = np.random.default_rng(1)
+    futs, prompts = [], []
+    for _ in range(10):                     # 10 requests over 2 slots: reuse
+        prompt = rng.integers(1, cfg.vocab_size,
+                              int(rng.integers(1, 9))).astype(np.int32)
+        prompts.append(prompt)
+        futs.append(srv.submit("lm", prompt))
+    saw_eos = 0
+    for prompt, fut in zip(prompts, futs):
+        out = fut.result(timeout=120)["result"]
+        expect = _oracle(cfg, params, prompt, 12, eos)
+        np.testing.assert_array_equal(out, expect)
+        if expect[-1] == eos:
+            saw_eos += 1
+            assert len(out) <= 12
+    # random params over a 61-token vocab: some sequence should hit eos;
+    # if none did the truncation path was never exercised — regenerate
+    # with a different seed rather than silently passing
+    assert saw_eos >= 1, "trace never hit eos; test needs a new seed"
+    assert engine.stats()["active_slots"] == 0
+
+
+def test_engine_pins_snapshot_per_generation(mv_session):
+    """Admissions pin the params snapshot: while train_batch races, every
+    reply matches the oracle run with the VERSION IT REPORTS, and pinned
+    versions only move when the engine drains."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    engine = srv.register_decoder("lm", lm, slots=4, max_prompt=6,
+                                  max_new=8, max_staleness_s=0.0)
+    engine.warmup()
+
+    # record every published snapshot's params by version
+    published = {}
+    orig_publish = engine._manager.publish
+
+    def publish():
+        snap = orig_publish()
+        published[snap.version] = snap.value
+        return snap
+
+    engine._manager.publish = publish
+
+    stop = threading.Event()
+
+    def trainer():
+        rng = np.random.default_rng(9)
+        while not stop.is_set():
+            lm.train_batch(rng.integers(
+                0, cfg.vocab_size, (2, 12)).astype(np.int32))
+
+    t = threading.Thread(target=trainer, daemon=True)
+    t.start()
+    try:
+        rng = np.random.default_rng(5)
+        for burst in range(4):
+            futs, reqs = [], []
+            for _ in range(6):
+                prompt = rng.integers(1, cfg.vocab_size, int(
+                    rng.integers(1, 7))).astype(np.int32)
+                reqs.append(prompt)
+                futs.append(srv.submit("lm", prompt))
+            for prompt, fut in zip(reqs, futs):
+                reply = fut.result(timeout=120)
+                ver = reply["snapshot_version"]
+                assert ver in published or ver == 0
+                params = published.get(ver)
+                if params is None:      # version 0: the pre-train state
+                    continue
+                np.testing.assert_array_equal(
+                    reply["result"], _oracle(cfg, params, prompt, 8),
+                    err_msg=f"torn generation at version {ver}")
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    # training moved while we served, so at least one refresh happened
+    # at a drain point (max_staleness_s=0 republishes on every idle
+    # admission once the version moved)
+    assert engine.stats()["snapshot_publishes"] >= 1
+
+
+def test_engine_sheds_past_queue_cap(mv_session):
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer, OverloadedError
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    srv.register_decoder("lm", lm, slots=2, max_prompt=4, max_new=8,
+                         max_queue=3)
+    # the engine is cold (no warmup): its first admission sits in a jit
+    # compile for seconds while instant submits pile into the depth-3
+    # queue, so the cap deterministically binds
+    futs = []
+    shed = 0
+    for i in range(64):
+        try:
+            futs.append(srv.submit("lm", np.ones(2, np.int32)))
+        except OverloadedError as exc:
+            shed += 1
+            assert exc.cap == 3
+    assert shed > 0, "queue cap never enforced"
+    for f in futs:
+        f.result(timeout=120)
+    assert srv.stats("lm")["shed"] == shed
+
+
+def test_engine_validates_payloads(mv_session):
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    srv = InferenceServer("t")
+    srv.register_decoder("lm", TransformerLM(cfg), slots=2, max_prompt=4,
+                         max_new=8)
+    with pytest.raises(ValueError):
+        srv.submit("lm", np.ones(5, np.int32))          # prompt too long
+    with pytest.raises(ValueError):
+        srv.submit("lm", np.array([], np.int32))        # empty prompt
+    with pytest.raises(ValueError):
+        srv.submit("lm", {"prompt": np.ones(2, np.int32), "max_new": 9})
+    with pytest.raises(ValueError):
+        srv.submit("lm", {"max_new": 2})                # no prompt key
+
+
+def test_gauge_registry():
+    from multiverso_tpu.dashboard import Dashboard, Gauge
+
+    g = Gauge("t_gauge", register=False)
+    g.set(0.75)
+    assert g.get() == 0.75
+    got = Dashboard.get_or_create_gauge("t_gauge2")
+    got.set(3.0)
+    assert Dashboard.get_or_create_gauge("t_gauge2") is got
+    assert Dashboard.stats("t_gauge2") == {"value": 3.0}
+    assert "t_gauge2" in Dashboard.display(emit=lambda *a: None)

@@ -38,6 +38,41 @@ def test_sharded_forward_matches_unsharded():
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
 
 
+def test_greedy_decode_eos_freezes_lanes():
+    """eos_id freezes finished lanes: output prefixes (through the eos
+    token) are bit-identical to the eos_id=None run, everything after is
+    pad, and unfinished lanes are untouched end to end."""
+    from multiverso_tpu.models.transformer import greedy_decode
+
+    cfg = TransformerConfig(vocab_size=37, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq=32)
+    params = init_params(cfg)
+    rng = np.random.default_rng(2)
+    lengths = np.array([5, 2, 7, 1], np.int32)
+    toks = np.zeros((4, 7), np.int32)
+    for b, l in enumerate(lengths):
+        toks[b, :l] = rng.integers(1, cfg.vocab_size, l)
+    new = 12
+    plain = np.asarray(greedy_decode(
+        cfg, params, jnp.asarray(toks), jnp.asarray(lengths), new))
+    # pick the most common generated token as eos so some lane freezes
+    eos = int(np.bincount(plain.ravel()).argmax())
+    froze = np.asarray(greedy_decode(
+        cfg, params, jnp.asarray(toks), jnp.asarray(lengths), new, eos))
+    assert froze.shape == plain.shape
+    hit_any = False
+    for b in range(4):
+        hits = np.nonzero(plain[b] == eos)[0]
+        if hits.size:
+            hit_any = True
+            cut = hits[0] + 1
+            np.testing.assert_array_equal(froze[b, :cut], plain[b, :cut])
+            assert (froze[b, cut:] == 0).all(), "frozen lane kept emitting"
+        else:
+            np.testing.assert_array_equal(froze[b], plain[b])
+    assert hit_any, "no lane hit eos; test seed needs regenerating"
+
+
 def test_training_decreases_loss(mv_session):
     cfg = TransformerConfig(vocab_size=16, d_model=32, n_heads=4,
                             n_layers=2, d_ff=64, max_seq=16,

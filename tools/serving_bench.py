@@ -11,6 +11,14 @@ Each workload is also measured with the scheduler degraded to batch=1
 (same jitted workload, bucket set {1}) to price micro-batching itself:
 ``speedup_batched`` is saturated batched qps over batch=1 qps.
 
+The LM decode path is additionally A/B'd on a mixed-length arrival
+trace (zipf output lengths, Poisson arrivals): the continuous-batching
+``DecodeEngine`` (slot KV cache, iteration-level scheduling) vs the
+static micro-batched ``LMGreedyDecode`` path, both serving the same
+trace. The static path locks every co-batched request through a full
+``max_new`` generation (head-of-line blocking), so on mixed lengths the
+engine's useful-tokens/sec should win by >= 2x (``speedup_engine``).
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/serving_bench.py [-duration 2.0]
@@ -67,6 +75,106 @@ def _closed_loop(server, model: str, payload_fn, duration_s: float,
         "p99_ms": round(stats["p99_ms"], 3),
         "shed_rate": round(shed / (done + shed), 4) if done + shed else 0.0,
         "completed": done,
+    }
+
+
+def _decode_trace(n: int, seed: int, max_prompt: int, max_new_cap: int,
+                  mean_gap_s: float, vocab: int):
+    """Mixed-length arrival trace: Poisson arrivals (exponential gaps),
+    uniform prompt lengths, zipf-distributed output lengths clipped to
+    the cap — most requests want a few tokens, a heavy tail wants many."""
+    rng = np.random.default_rng(seed)
+    trace, t = [], 0.0
+    for _ in range(n):
+        t += float(rng.exponential(mean_gap_s))
+        plen = int(rng.integers(1, max_prompt + 1))
+        prompt = rng.integers(1, vocab, plen).astype(np.int32)
+        n_new = int(min(max_new_cap, rng.zipf(1.6)))
+        trace.append((t, prompt, n_new))
+    return trace
+
+
+def _play_decode_trace(server, model: str, trace, per_request_max_new: bool):
+    """Open-loop arrival playback; returns (results, elapsed_s)."""
+    from multiverso_tpu.serving import OverloadedError
+
+    futs = []
+    t0 = time.monotonic()
+    for at, prompt, n_new in trace:
+        delay = at - (time.monotonic() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        payload = ({"prompt": prompt, "max_new": n_new}
+                   if per_request_max_new else prompt)
+        while True:
+            try:
+                futs.append(server.submit(model, payload))
+                break
+            except OverloadedError:
+                time.sleep(0.001)
+    results = [f.result(timeout=300) for f in futs]
+    return results, time.monotonic() - t0
+
+
+def _decode_ab(server, lm_model, quick: bool) -> dict:
+    """Engine-vs-static A/B on one mixed-length trace.
+
+    Useful tokens are the per-request zipf lengths for BOTH paths: the
+    engine generates exactly that many (per-request ``max_new``); the
+    static path must run its full compiled ``max_new`` for every request
+    and the surplus is discarded — that surplus, plus batch-drain
+    admission stalls, is precisely the head-of-line cost being priced.
+    """
+    from multiverso_tpu.serving import LMGreedyDecode
+
+    max_prompt, cap = 8, 96
+    n = 32 if quick else 96
+    trace = _decode_trace(n, seed=7, max_prompt=max_prompt, max_new_cap=cap,
+                          mean_gap_s=0.0005, vocab=lm_model.config.vocab_size)
+    useful = sum(n_new for _, _, n_new in trace)
+
+    # one prompt bucket (prompts here are all <= 8): admission compiles
+    # per (batch bucket, prompt bucket), so the warmable trace set stays
+    # at 4 batch buckets x 1 prompt bucket + 1 fused step
+    engine = server.register_decoder(
+        "lm_engine", lm_model, slots=8, max_prompt=max_prompt, max_new=cap,
+        max_queue=max(64, n), prompt_buckets=(max_prompt,))
+    static = LMGreedyDecode(lm_model, max_prompt=max_prompt, max_new=cap)
+    static._warm_payload = lambda: np.ones(4, np.int32)
+    server.register("lm_static", static, max_batch=8, deadline_ms=4.0,
+                    max_queue=max(64, n), buckets=(1, 8))
+
+    # warm both paths outside the timed trace (engine: every admission
+    # bucket combo + the fused step; static: both batch buckets)
+    engine.warmup()
+    _play_decode_trace(server, "lm_engine",
+                       [(0.0, np.ones(4, np.int32), 2)] * 4, True)
+    _warm(static, server._entry("lm_static").manager, (1, 8))
+    engine.reset_stats()
+
+    _, eng_elapsed = _play_decode_trace(server, "lm_engine", trace, True)
+    eng_stats = engine.stats()
+    _, static_elapsed = _play_decode_trace(server, "lm_static", trace, False)
+    static_stats = server.stats("lm_static")
+
+    eng_tps = useful / eng_elapsed
+    static_tps = useful / static_elapsed
+    return {
+        "requests": n,
+        "useful_tokens": useful,
+        "tokens_per_s": round(eng_tps, 1),
+        "ttft_p50_ms": round(eng_stats["ttft_p50_ms"], 3),
+        "ttft_p99_ms": round(eng_stats["ttft_p99_ms"], 3),
+        "itl_p50_ms": round(eng_stats["itl_p50_ms"], 3),
+        "slot_occupancy": round(eng_stats["slot_occupancy"], 3),
+        "step_traces": eng_stats["step_traces"],
+        "tokens_per_s_static": round(static_tps, 1),
+        # the static path's first token only exists when the whole batch
+        # drains: its reply latency IS its TTFT
+        "ttft_p50_ms_static": round(static_stats["p50_ms"], 3),
+        "ttft_p99_ms_static": round(static_stats["p99_ms"], 3),
+        "speedup_engine": (round(eng_tps / static_tps, 2)
+                           if static_tps else float("inf")),
     }
 
 
@@ -141,6 +249,13 @@ def run(duration_s: float = 2.0, clients: int = 32,
         out["workloads"][name] = row
     out["max_speedup_batched"] = max(
         r["speedup_batched"] for r in out["workloads"].values())
+    # continuous-batching decode A/B rides the same JSON line; its own
+    # model is sized so per-step compute (which the static path spends
+    # cap/mean-fold on dead tokens) outweighs per-iteration dispatch
+    ab_cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                               n_layers=2, d_ff=256, max_seq=112)
+    out["workloads"]["lm_decode"] = _decode_ab(
+        server, TransformerLM(ab_cfg), quick)
     mv.shutdown()
     return out
 
