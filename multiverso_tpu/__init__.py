@@ -16,10 +16,12 @@ from __future__ import annotations
 
 from typing import Any, List, Optional, Sequence
 
-from . import config
+from . import config, trace
 from .config import (define_bool, define_float, define_int, define_string,
                      get_flag, parse_cmd_flags, set_flag)
-from .dashboard import Dashboard, Monitor, Timer, monitor, profile_trace
+from .dashboard import (Counter, Dashboard, Gauge, Histogram,
+                        MetricsExporter, Monitor, Timer, monitor,
+                        profile_trace, render_prometheus)
 from .log import Log, LogLevel, check, check_notnull
 from .quantization import SparseFilter
 from .runtime import Session

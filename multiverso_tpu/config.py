@@ -220,3 +220,14 @@ define_float("failure_timeout_s", 0.0,
              "survivor mode); 0 disables the watchdog")
 define_string("log_file", "", "optional log sink file")
 define_string("log_level", "info", "debug|info|error|fatal")
+define_bool("trace", False,
+            "record host-side request spans (trace.py ring collector); "
+            "export Chrome/Perfetto JSON via trace.export_chrome()")
+define_int("trace_buffer", 65536,
+           "span ring-buffer capacity while -trace is on (oldest spans "
+           "are overwritten past it)")
+define_string("metrics_jsonl", "",
+              "append periodic Dashboard.snapshot() JSON lines (with "
+              "interval deltas) to this file while the session runs")
+define_float("metrics_interval_s", 10.0,
+             "reporting period for -metrics_jsonl")

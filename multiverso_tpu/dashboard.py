@@ -15,10 +15,12 @@ not just async dispatch.
 
 from __future__ import annotations
 
+import json
+import re
 import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 
 class Timer:
@@ -120,36 +122,70 @@ class Histogram:
             self._n = 0
             self._pos = 0
 
+    def _window(self):
+        """ONE lock acquisition -> (lifetime count, sorted live window).
+        The single source of the ring-unwrap + sort both percentile
+        consumers share (a wrap-handling fix lands in both). Only the
+        COPY happens under the lock: the O(n log n) sort of a full
+        65536-slot window would otherwise stall every concurrent
+        ``record`` on the serving hot path each time a poller (now
+        including the periodic ``MetricsExporter``) asks for a summary."""
+        with self._lock:
+            n = self._n
+            count = self.count
+            # unwrapped: slots [0, n) are the live samples; wrapped: all are
+            data = (list(self._buf) if n == len(self._buf)
+                    else self._buf[:n])
+        data.sort()
+        return count, data
+
+    @staticmethod
+    def _rank(data, p: float) -> float:
+        """Nearest-rank percentile over a sorted window."""
+        n = len(data)
+        return data[min(n - 1, max(0, int(round(p / 100.0 * (n - 1)))))]
+
     def percentiles(self, ps) -> Dict[float, float]:
         """Nearest-rank percentiles over the retained window in ONE sort
         (0s if empty) — summary()/stats() pollers would otherwise pay a
         full sort per percentile while contending with record()."""
-        with self._lock:
-            n = self._n
-            if n == 0:
-                return {p: 0.0 for p in ps}
-            # unwrapped: slots [0, n) are the live samples; wrapped: all are
-            data = sorted(self._buf if n == len(self._buf) else self._buf[:n])
-        return {p: data[min(n - 1, max(0, int(round(p / 100.0 * (n - 1)))))]
-                for p in ps}
+        _, data = self._window()
+        if not data:
+            return {p: 0.0 for p in ps}
+        return {p: self._rank(data, p) for p in ps}
 
     def percentile(self, p: float) -> float:
         return self.percentiles((p,))[p]
 
     def summary(self) -> Dict[str, float]:
-        qs = self.percentiles((50, 95, 99))
+        """count + nearest-rank p50/p95/p99 + mean/max over the window.
+
+        mean and max ride along because percentile triage alone can't
+        rank outliers: a p99 says where the tail STARTS, the max says
+        how bad the worst request actually was, and mean-vs-p50 skew is
+        the cheapest "long tail present" signal. Count and window are
+        read under ONE lock acquisition so the summary is internally
+        consistent even while ``record`` hammers concurrently.
+        """
+        count, data = self._window()
+        if not data:
+            return {"count": count, "p50_ms": 0.0, "p95_ms": 0.0,
+                    "p99_ms": 0.0, "mean_ms": 0.0, "max_ms": 0.0}
         return {
-            "count": self.count,
-            "p50_ms": qs[50],
-            "p95_ms": qs[95],
-            "p99_ms": qs[99],
+            "count": count,
+            "p50_ms": self._rank(data, 50),
+            "p95_ms": self._rank(data, 95),
+            "p99_ms": self._rank(data, 99),
+            "mean_ms": sum(data) / len(data),
+            "max_ms": data[-1],
         }
 
     def info_string(self) -> str:
         s = self.summary()
         return (f"[{self.name}] count = {int(s['count'])} "
                 f"p50 = {s['p50_ms']:.3f} ms p95 = {s['p95_ms']:.3f} ms "
-                f"p99 = {s['p99_ms']:.3f} ms")
+                f"p99 = {s['p99_ms']:.3f} ms mean = {s['mean_ms']:.3f} ms "
+                f"max = {s['max_ms']:.3f} ms")
 
 
 class Gauge:
@@ -180,12 +216,43 @@ class Gauge:
         return f"[{self.name}] value = {self.get():.3f}"
 
 
+class Counter:
+    """Monotonic event counter: things that HAPPENED, never un-happen.
+
+    The Monitor measures durations and the Gauge levels; neither fits
+    "requests shed", "idle wakeups", "tokens emitted" — monotonic
+    totals whose interval-deltas (``MetricsExporter``) become rates.
+    Maps to the Prometheus ``counter`` type in the text exposition.
+    """
+
+    def __init__(self, name: str, register: bool = True) -> None:
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+        if register:
+            Dashboard.add_counter(self)
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError(f"Counter {self.name!r}: negative increment {n}")
+        with self._lock:
+            self._value += n
+
+    def get(self) -> int:
+        with self._lock:
+            return self._value
+
+    def info_string(self) -> str:
+        return f"[{self.name}] total = {self.get()}"
+
+
 class Dashboard:
     """Process-global monitor registry (reference ``dashboard.h:16-24``)."""
 
     _monitors: Dict[str, Monitor] = {}
     _histograms: Dict[str, "Histogram"] = {}
     _gauges: Dict[str, "Gauge"] = {}
+    _counters: Dict[str, "Counter"] = {}
     _lock = threading.Lock()
 
     @classmethod
@@ -202,6 +269,11 @@ class Dashboard:
     def add_gauge(cls, gauge: "Gauge") -> None:
         with cls._lock:
             cls._gauges[gauge.name] = gauge
+
+    @classmethod
+    def add_counter(cls, counter: "Counter") -> None:
+        with cls._lock:
+            cls._counters[counter.name] = counter
 
     @classmethod
     def get_or_create_histogram(cls, name: str) -> "Histogram":
@@ -231,10 +303,23 @@ class Dashboard:
             return mon
 
     @classmethod
-    def watch(cls, name: str) -> str:
+    def get_or_create_counter(cls, name: str) -> "Counter":
         with cls._lock:
-            mon = cls._monitors.get(name)
-        return mon.info_string() if mon else f"[{name}] not monitored"
+            counter = cls._counters.get(name)
+            if counter is None:
+                counter = Counter(name, register=False)
+                cls._counters[name] = counter
+            return counter
+
+    @classmethod
+    def watch(cls, name: str) -> str:
+        """Live one-liner for ANY registered instrument. Resolves every
+        kind — ``watch("SERVE_TTFT[lm]")`` must report the histogram,
+        not "not monitored" (it used to check Monitors only)."""
+        with cls._lock:
+            inst = (cls._monitors.get(name) or cls._histograms.get(name)
+                    or cls._gauges.get(name) or cls._counters.get(name))
+        return inst.info_string() if inst else f"[{name}] not monitored"
 
     @classmethod
     def stats(cls, name: str) -> Optional[Dict[str, float]]:
@@ -242,6 +327,7 @@ class Dashboard:
             mon = cls._monitors.get(name)
             hist = cls._histograms.get(name)
             gauge = cls._gauges.get(name)
+            counter = cls._counters.get(name)
         if mon is not None:
             return {"count": mon.count, "total_ms": mon.total_ms,
                     "avg_ms": mon.average_ms()}
@@ -249,7 +335,35 @@ class Dashboard:
             return hist.summary()
         if gauge is not None:
             return {"value": gauge.get()}
+        if counter is not None:
+            return {"value": counter.get()}
         return None
+
+    @classmethod
+    def snapshot(cls) -> Dict[str, Dict[str, Any]]:
+        """EVERY instrument's current state as one plain dict.
+
+        ``{name: {"type": kind, ...stats}}`` — JSON-serializable floats
+        and ints only, so the same object feeds the JSON-lines reporter,
+        the Prometheus renderer, and bench archives
+        (``tools/serving_bench.py``) without per-sink formats.
+        """
+        with cls._lock:
+            monitors = list(cls._monitors.values())
+            histograms = list(cls._histograms.values())
+            gauges = list(cls._gauges.values())
+            counters = list(cls._counters.values())
+        out: Dict[str, Dict[str, Any]] = {}
+        for m in monitors:
+            out[m.name] = {"type": "monitor", "count": m.count,
+                           "total_ms": m.total_ms, "avg_ms": m.average_ms()}
+        for h in histograms:
+            out[h.name] = {"type": "histogram", **h.summary()}
+        for g in gauges:
+            out[g.name] = {"type": "gauge", "value": g.get()}
+        for c in counters:
+            out[c.name] = {"type": "counter", "value": c.get()}
+        return out
 
     @classmethod
     def display(cls, emit=None) -> str:
@@ -257,10 +371,12 @@ class Dashboard:
             monitors = list(cls._monitors.values())
             histograms = list(cls._histograms.values())
             gauges = list(cls._gauges.values())
+            counters = list(cls._counters.values())
         lines = ["--------------Dashboard--------------"]
         lines += [m.info_string() for m in monitors]
         lines += [h.info_string() for h in histograms]
         lines += [g.info_string() for g in gauges]
+        lines += [c.info_string() for c in counters]
         text = "\n".join(lines)
         if emit is None:
             from .log import Log
@@ -274,6 +390,7 @@ class Dashboard:
             cls._monitors.clear()
             cls._histograms.clear()
             cls._gauges.clear()
+            cls._counters.clear()
 
 
 @contextmanager
@@ -325,3 +442,226 @@ def profile_trace(log_dir: str, name: str = "PROFILE") -> Iterator[Monitor]:
     finally:
         jax.profiler.stop_trace()
         mon.end()
+
+
+# -- metrics export ----------------------------------------------------------
+
+# The ONE definition of which snapshot stats are monotonic, shared by the
+# Prometheus renderer (# TYPE counter vs gauge) and the JSONL reporter's
+# interval deltas — two hardcoded copies would drift and make the sinks
+# disagree about which stats are rates.
+_MONOTONE_STATS = frozenset({
+    ("counter", "value"), ("monitor", "count"), ("monitor", "total_ms"),
+    ("histogram", "count"),
+})
+
+
+def _prom_split(name: str):
+    """``SERVE_TTFT[lm]`` -> (``serve_ttft``, ``lm``); plain names pass
+    through with no instance label. The bracket convention is how every
+    per-model instrument in this codebase is named."""
+    instance = None
+    base = name
+    if name.endswith("]") and "[" in name:
+        base, _, rest = name.partition("[")
+        instance = rest[:-1]
+    metric = re.sub(r"[^a-zA-Z0-9_]", "_", base.lower()).strip("_")
+    return metric or "unnamed", instance
+
+
+def _prom_escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _prom_format(value: Any) -> str:
+    # repr() floats round-trip exactly through float() — the renderer's
+    # half of the snapshot-identity contract the tests assert
+    return repr(float(value)) if isinstance(value, float) else str(value)
+
+
+def render_prometheus(snapshot: Optional[Dict[str, Dict[str, Any]]] = None
+                      ) -> str:
+    """Prometheus text exposition of a :meth:`Dashboard.snapshot`.
+
+    One sample per (instrument, stat field): the histogram
+    ``SERVE_TTFT[lm]`` renders as ``mv_serve_ttft_p50_ms{name="...",
+    instance="lm"} 1.25`` and so on. The full original instrument name
+    always rides the ``name`` label, so the mapping is lossless (and the
+    round-trip test can reconstruct the snapshot from the text).
+    Monotonic stats (counter values, monitor/histogram counts,
+    monitor total_ms) carry ``# TYPE counter``; everything else is a
+    gauge.
+    """
+    snap = Dashboard.snapshot() if snapshot is None else snapshot
+    families: Dict[str, List[str]] = {}
+    family_type: Dict[str, str] = {}
+    for name in sorted(snap):
+        row = dict(snap[name])
+        kind = row.pop("type", "gauge")
+        metric, instance = _prom_split(name)
+        for field in sorted(row):
+            value = row[field]
+            full = (f"mv_{metric}" if field == "value"
+                    else f"mv_{metric}_{field}")
+            monotone = (kind, field) in _MONOTONE_STATS
+            labels = f'name="{_prom_escape(name)}"'
+            if instance is not None:
+                labels += f',instance="{_prom_escape(instance)}"'
+            family_type.setdefault(full,
+                                   "counter" if monotone else "gauge")
+            families.setdefault(full, []).append(
+                f"{full}{{{labels}}} {_prom_format(value)}")
+    lines: List[str] = []
+    for full in sorted(families):
+        lines.append(f"# TYPE {full} {family_type[full]}")
+        lines.extend(families[full])
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def parse_prometheus(text: str) -> Dict[str, Dict[str, float]]:
+    """Inverse of :func:`render_prometheus` keyed by the ``name`` label:
+    ``{instrument_name: {sample_name: value}}``. Used by the round-trip
+    test and by anyone scraping the text sink without a Prometheus."""
+    out: Dict[str, Dict[str, float]] = {}
+    sample = re.compile(r'^(\w+)\{name="((?:[^"\\]|\\.)*)"[^}]*\} (\S+)$')
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = sample.match(line)
+        if not m:
+            continue
+        full, name, value = m.groups()
+        # unescape left-to-right (sequential .replace would corrupt a
+        # literal backslash followed by 'n' into a newline)
+        name = re.sub(r"\\(.)",
+                      lambda g: {"n": "\n"}.get(g.group(1), g.group(1)),
+                      name)
+        out.setdefault(name, {})[full] = float(value)
+    return out
+
+
+class MetricsExporter:
+    """Periodic metrics reporter: snapshot -> JSON-lines sink + deltas.
+
+    Every ``interval_s`` (and on :meth:`stop`) it takes ONE
+    ``Dashboard.snapshot()`` and appends one JSON line::
+
+        {"ts": <epoch s>, "interval_s": <dt since last report or null>,
+         "snapshot": {...}, "deltas": {name: {field: d, field_per_s: r}}}
+
+    ``deltas`` cover the monotonic stats only (counter values,
+    monitor/histogram counts, monitor total_ms): the interval-dt rates
+    an operator actually plots, computed HERE so the sink needs no
+    state. A snapshot whose monotonic stats went backwards (instrument
+    reset) reports no delta for that instrument rather than a negative
+    rate. :meth:`prometheus` renders the same snapshot for a scrape
+    endpoint; both sinks see identical values by construction.
+    """
+
+    _MONOTONE = _MONOTONE_STATS
+
+    def __init__(self, interval_s: float = 10.0, sink: Any = None,
+                 emit=None) -> None:
+        self.interval_s = float(interval_s)
+        self._sink_path = sink if isinstance(sink, str) else None
+        self._sink_file = sink if sink is not None and not isinstance(
+            sink, str) else None
+        self._emit = emit
+        self._last: Optional[Dict[str, Dict[str, Any]]] = None
+        self._last_ts: Optional[float] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.reports = 0
+
+    # -- one report ---------------------------------------------------------
+    def _deltas(self, snap: Dict[str, Dict[str, Any]],
+                dt: Optional[float]) -> Dict[str, Dict[str, float]]:
+        if self._last is None or not dt or dt <= 0:
+            return {}
+        deltas: Dict[str, Dict[str, float]] = {}
+        for name, row in snap.items():
+            prev = self._last.get(name)
+            if prev is None or prev.get("type") != row.get("type"):
+                continue
+            kind = row.get("type")
+            d: Dict[str, float] = {}
+            for field, value in row.items():
+                if (kind, field) not in self._MONOTONE:
+                    continue
+                diff = value - prev.get(field, 0)
+                if diff < 0:
+                    d = {}
+                    break               # instrument was reset mid-interval
+                d[field] = diff
+                d[f"{field}_per_s"] = diff / dt
+            if d:
+                deltas[name] = d
+        return deltas
+
+    def report_once(self) -> dict:
+        """Take one snapshot, compute interval deltas, write one line.
+
+        The lock covers only the last-snapshot state, NOT the sink
+        write: a stalled sink (full disk, hung NFS) must not block a
+        concurrent ``prometheus()`` scrape or ``stop()``, and an
+        ``emit`` callback may safely call back into the exporter.
+        """
+        with self._lock:
+            snap = Dashboard.snapshot()
+            now = time.time()
+            dt = (now - self._last_ts) if self._last_ts is not None else None
+            record = {"ts": now, "interval_s": dt, "snapshot": snap,
+                      "deltas": self._deltas(snap, dt)}
+            self._last, self._last_ts = snap, now
+            self.reports += 1
+        line = json.dumps(record)
+        if self._sink_path is not None:
+            with open(self._sink_path, "a") as f:
+                f.write(line + "\n")
+        elif self._sink_file is not None:
+            self._sink_file.write(line + "\n")
+        if self._emit is not None:
+            self._emit(line)
+        return record
+
+    def prometheus(self) -> str:
+        """Text exposition of the LAST reported snapshot (a scrape sees
+        the same values the JSON line archived), or a fresh one before
+        any report."""
+        with self._lock:
+            snap = self._last
+        return render_prometheus(snap)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "MetricsExporter":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="mv-metrics", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.report_once()
+            except Exception as exc:    # pragma: no cover - sink errors
+                from .log import Log
+                Log.error("metrics exporter: report failed: %s", exc)
+
+    def stop(self, final_report: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if final_report:
+            try:
+                self.report_once()
+            except Exception as exc:
+                # a dead sink at shutdown (disk full, hung mount) must
+                # not abort the rest of Session teardown
+                from .log import Log
+                Log.error("metrics exporter: final report failed: %s", exc)

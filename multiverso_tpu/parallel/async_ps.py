@@ -81,15 +81,19 @@ from typing import Any, Deque, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import config
+from .. import config, trace
 from ..log import Log
 from ..quantization import SparseFilter
 
 # record kinds
 DENSE, KEYED, KV, PART = 0, 1, 2, 3
 
-_HEADER = struct.Struct("<BBiiffffd")  # kind, n_arrays, table_id, worker_id,
-#                                        lr, momentum, rho, lam, send_ts
+_HEADER = struct.Struct("<BBiiffffdQQ")  # kind, n_arrays, table_id,
+#                          worker_id, lr, momentum, rho, lam, send_ts,
+#                          trace_id, span_id (0,0 = untraced publish) —
+#                          the cross-process trace link: a consumer's
+#                          bus.apply span parents under the publisher's
+#                          bus.publish span by these two u64s
 _PART_HEADER = struct.Struct("<BII")   # kind=PART, part_index, n_parts
 
 # Publication/consumption counters survive init/shutdown cycles within one
@@ -105,8 +109,9 @@ _state_lock = threading.Lock()
 _active_bus: Optional["AsyncDeltaBus"] = None
 
 
-def _serialize(kind: int, table_id: int, option, arrays: Sequence[np.ndarray]
-               ) -> bytes:
+def _serialize(kind: int, table_id: int, option, arrays: Sequence[np.ndarray],
+               ctx: Optional[trace.SpanContext] = None) -> bytes:
+    tid, sid = (ctx.trace_id, ctx.span_id) if ctx is not None else (0, 0)
     buf = io.BytesIO()
     buf.write(_HEADER.pack(kind, len(arrays), table_id,
                            int(getattr(option, "worker_id", 0)),
@@ -114,7 +119,7 @@ def _serialize(kind: int, table_id: int, option, arrays: Sequence[np.ndarray]
                            float(getattr(option, "momentum", 0.0)),
                            float(getattr(option, "rho", 0.0)),
                            float(getattr(option, "lam", 0.0)),
-                           time.time()))
+                           time.time(), tid, sid))
     from ..io.stream import write_array
 
     for arr in arrays:
@@ -128,12 +133,13 @@ def _deserialize(data: bytes):
     from ..io.stream import read_array
 
     buf = io.BytesIO(data)
-    kind, n_arrays, table_id, wid, lr, mom, rho, lam, ts = _HEADER.unpack(
-        buf.read(_HEADER.size))
+    (kind, n_arrays, table_id, wid, lr, mom, rho, lam, ts, trace_id,
+     span_id) = _HEADER.unpack(buf.read(_HEADER.size))
     arrays = [read_array(buf) for _ in range(n_arrays)]
     option = AddOption(worker_id=wid, learning_rate=lr, momentum=mom,
                        rho=rho, lam=lam)
-    return kind, table_id, option, arrays, ts
+    ctx = trace.SpanContext(trace_id, span_id) if trace_id else None
+    return kind, table_id, option, arrays, ts, ctx
 
 
 class AsyncDeltaBus:
@@ -403,12 +409,24 @@ class AsyncDeltaBus:
 
     def publish_dense(self, table_id: int, delta: np.ndarray, option) -> None:
         delta = np.ascontiguousarray(delta)
+        # bus.publish span: its context rides the wire header, so every
+        # consumer's bus.apply span joins THIS trace (the one place a
+        # single trace id crosses the process boundary)
+        sp = trace.start_span("bus.publish", table_id=table_id,
+                              wire="dense")
         blobs = self._filter_for(delta.dtype).filter_in([delta.ravel()])
-        self._publish(_serialize(DENSE, table_id, option, blobs))
+        payload = _serialize(DENSE, table_id, option, blobs, sp.context)
+        self._publish(payload)
+        sp.end(bytes=len(payload))
 
     def publish_keyed(self, table_id: int, ids: np.ndarray,
                       vals: np.ndarray, option) -> None:
-        self._publish(_serialize(KEYED, table_id, option, [ids, vals]))
+        sp = trace.start_span("bus.publish", table_id=table_id,
+                              wire="keyed")
+        payload = _serialize(KEYED, table_id, option, [ids, vals],
+                             sp.context)
+        self._publish(payload)
+        sp.end(bytes=len(payload), rows=int(ids.shape[0]))
 
     def publish_delta(self, table, delta: np.ndarray, option) -> None:
         """Publish a whole-table delta in its cheapest sound representation.
@@ -435,7 +453,10 @@ class AsyncDeltaBus:
 
     def publish_kv(self, table_id: int, keys: np.ndarray,
                    vals: np.ndarray) -> None:
-        self._publish(_serialize(KV, table_id, None, [keys, vals]))
+        sp = trace.start_span("bus.publish", table_id=table_id, wire="kv")
+        payload = _serialize(KV, table_id, None, [keys, vals], sp.context)
+        self._publish(payload)
+        sp.end(bytes=len(payload))
 
     # -- drain (group -> local replica) ------------------------------------
     def _peer_count(self, r: int) -> int:
@@ -518,7 +539,12 @@ class AsyncDeltaBus:
                     Log.error("async PS drain error: %s", exc)
 
     def _apply(self, data: bytes) -> None:
-        kind, table_id, option, arrays, send_ts = _deserialize(data)
+        kind, table_id, option, arrays, send_ts, ctx = _deserialize(data)
+        # the carried context makes this apply a CHILD of the remote
+        # publish span: one trace id covers the cross-process hop, so a
+        # merged view shows publish->apply as one causal chain
+        sp = (trace.start_span("bus.apply", parent=ctx, table_id=table_id)
+              if ctx is not None else trace.NULL_SPAN)
         self._mon_apply.begin()
         table = self._sess.table(table_id)
         if kind == DENSE:
@@ -536,7 +562,9 @@ class AsyncDeltaBus:
         self.apply_bytes += len(data)
         # publish->apply latency from the carried send timestamp (same-host
         # clocks in tests; cross-host numbers inherit NTP skew)
-        self._mon_lat.record(max(0.0, (time.time() - send_ts) * 1e3))
+        wire_lat_ms = max(0.0, (time.time() - send_ts) * 1e3)
+        self._mon_lat.record(wire_lat_ms)
+        sp.end(bytes=len(data), wire_latency_ms=round(wire_lat_ms, 3))
 
     def stats(self) -> dict:
         """Measured bus rates since this bus started (both directions)."""

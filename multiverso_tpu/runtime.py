@@ -40,6 +40,7 @@ class Session:
         self.started = False
         self.async_bus: Optional[Any] = None  # cross-process async PS plane
         self.failure_detector: Optional[Any] = None  # -failure_timeout_s
+        self.metrics_exporter: Optional[Any] = None  # -metrics_jsonl
 
     # -- singleton --------------------------------------------------------
     @classmethod
@@ -69,6 +70,23 @@ class Session:
                     f"every process owns the same number of worker lanes; "
                     f"pass -mesh_shape to fix the layout")
             self.started = True
+            if config.get_flag("trace"):
+                from . import trace
+
+                # enable() resets the span ring; the not-enabled() guard
+                # keeps a redundant init from wiping a live collector
+                if not trace.enabled():
+                    trace.enable(int(config.get_flag("trace_buffer")))
+            metrics_path = config.get_flag("metrics_jsonl")
+            if metrics_path and self.metrics_exporter is None:
+                # started only once init validation passed: a failed
+                # init must not leak a reporter thread, and a retried
+                # init must not double-write the JSONL sink
+                from .dashboard import MetricsExporter
+
+                self.metrics_exporter = MetricsExporter(
+                    interval_s=float(config.get_flag("metrics_interval_s")),
+                    sink=metrics_path).start()
             topology.barrier("mv_init")
             from .parallel.async_ps import AsyncDeltaBus
 
@@ -161,6 +179,11 @@ class Session:
                 if flush is not None:
                     flush()
             self.tables.clear()
+            if self.metrics_exporter is not None:
+                # final report: the shutdown snapshot lands in the JSONL
+                # archive even when the session dies mid-interval
+                self.metrics_exporter.stop(final_report=True)
+                self.metrics_exporter = None
             Dashboard.display()
             self.started = False
             self.topo = None

@@ -35,6 +35,7 @@ from typing import Any, Callable, Deque, List, Optional, Sequence, Tuple
 
 import collections
 
+from .. import trace
 from ..dashboard import Dashboard
 from ..log import Log
 
@@ -83,12 +84,17 @@ class BatcherConfig:
 
 
 class _Pending:
-    __slots__ = ("payload", "future", "t_enq")
+    __slots__ = ("payload", "future", "t_enq", "ctx")
 
-    def __init__(self, payload: Any) -> None:
+    def __init__(self, payload: Any,
+                 ctx: Optional[trace.SpanContext] = None) -> None:
         self.payload = payload
         self.future: Future = Future()
         self.t_enq = time.monotonic()
+        # trace handoff token: the submitting thread's root-span context,
+        # carried across the queue so the flush thread's spans join the
+        # request's trace instead of starting orphan ones
+        self.ctx = ctx
 
 
 class MicroBatcher:
@@ -114,6 +120,8 @@ class MicroBatcher:
         self._stop = threading.Event()
         # -- stats ----------------------------------------------------------
         self.hist = Dashboard.get_or_create_histogram(f"SERVE_LAT[{name}]")
+        self.shed_counter = Dashboard.get_or_create_counter(
+            f"SERVE_SHED[{name}]")
         self.completed = 0
         self.shed = 0
         self.t_first: Optional[float] = None
@@ -128,11 +136,13 @@ class MicroBatcher:
         self._thread.start()
 
     # -- client side --------------------------------------------------------
-    def submit(self, payload: Any) -> Future:
-        """Enqueue one request; fast-rejects at the queue-depth cap."""
+    def submit(self, payload: Any,
+               ctx: Optional[trace.SpanContext] = None) -> Future:
+        """Enqueue one request; fast-rejects at the queue-depth cap.
+        ``ctx`` is the request's trace handoff token (or None)."""
         if self._stop.is_set():
             raise RuntimeError(f"batcher {self.name!r} is stopped")
-        p = _Pending(payload)
+        p = _Pending(payload, ctx)
         with self._cv:
             if self._stop.is_set():
                 # re-check under the lock: a submit that passed the gate
@@ -141,6 +151,7 @@ class MicroBatcher:
                 raise RuntimeError(f"batcher {self.name!r} is stopped")
             if len(self._q) >= self.config.max_queue:
                 self.shed += 1
+                self.shed_counter.inc()
                 raise OverloadedError(self.name, len(self._q),
                                       self.config.max_queue)
             if self.t_first is None:
@@ -190,13 +201,39 @@ class MicroBatcher:
         # cancelled request from killing the flush thread for good
         live = [p for p in batch if p.future.set_running_or_notify_cancel()]
         bucket = bucket_for(len(batch), self._buckets)
+        t_claim = time.monotonic()
+        if trace.enabled():
+            # per-request queue-wait spans: how long each request sat
+            # before THIS flush claimed it, and why the flush fired.
+            # `live` only — a cancelled request's root span closed at
+            # cancel time; stage spans recorded after it would outlive
+            # their parent in the exported tree
+            for p in live:
+                if p.ctx is not None:
+                    trace.record_span("queue.wait", p.ctx, p.t_enq, t_claim,
+                                      cause=cause)
+        error = None
         try:
             results = self._run_batch([p.payload for p in batch], bucket)
         except Exception as exc:
-            for p in live:
-                p.future.set_exception(exc)
-            return
+            error = exc
         now = time.monotonic()
+        if trace.enabled():
+            # one batch execution -> one child span PER co-batched request
+            # (same interval, each under its own trace): a slow request's
+            # tree shows exactly which strangers shared its flush and
+            # which shape bucket the batch padded into
+            err_attr = ({"error": type(error).__name__} if error is not None
+                        else {})
+            for p in live:
+                if p.ctx is not None:
+                    trace.record_span("batch.exec", p.ctx, t_claim, now,
+                                      bucket=bucket, batch_n=len(batch),
+                                      cause=cause, **err_attr)
+        if error is not None:
+            for p in live:
+                p.future.set_exception(error)
+            return
         self.flushes.append((len(batch), bucket, cause))
         done = 0
         for p, r in zip(batch, results):

@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import trace
 from ..dashboard import Dashboard
 from ..log import Log
 from .batcher import OverloadedError, bucket_for, shape_buckets
@@ -83,9 +84,10 @@ class DecodeEngineConfig:
 
 class _Request:
     __slots__ = ("prompt", "max_new", "future", "t_enq", "t_last",
-                 "slot", "out", "version")
+                 "slot", "out", "version", "ctx")
 
-    def __init__(self, prompt: np.ndarray, max_new: int) -> None:
+    def __init__(self, prompt: np.ndarray, max_new: int,
+                 ctx: Optional[trace.SpanContext] = None) -> None:
         self.prompt = prompt
         self.max_new = max_new
         self.future: Future = Future()
@@ -94,6 +96,9 @@ class _Request:
         self.slot = -1
         self.out: List[int] = []
         self.version = -1
+        # trace handoff token (the submitter's root-span context): the
+        # engine thread parents admission/iteration spans under it
+        self.ctx = ctx
 
 
 class DecodeEngine:
@@ -186,6 +191,10 @@ class DecodeEngine:
             f"SERVE_ITL[{name}]")
         self.tps_gauge = Dashboard.get_or_create_gauge(f"DECODE_TPS[{name}]")
         self.occ_gauge = Dashboard.get_or_create_gauge(f"SLOT_OCC[{name}]")
+        self.shed_counter = Dashboard.get_or_create_counter(
+            f"SERVE_SHED[{name}]")
+        self.steps_counter = Dashboard.get_or_create_counter(
+            f"DECODE_STEPS[{name}]")
         self.completed = 0
         self.shed = 0
         self.tokens = 0
@@ -206,17 +215,19 @@ class DecodeEngine:
             raise ValueError(f"max_new {max_new} outside "
                              f"[1, {self.config.max_new}]")
 
-    def submit(self, prompt: np.ndarray,
-               max_new: Optional[int] = None) -> Future:
-        """Enqueue one prompt; fast-rejects at the admission-queue cap."""
+    def submit(self, prompt: np.ndarray, max_new: Optional[int] = None,
+               ctx: Optional[trace.SpanContext] = None) -> Future:
+        """Enqueue one prompt; fast-rejects at the admission-queue cap.
+        ``ctx`` is the request's trace handoff token (or None)."""
         self.validate(prompt, max_new)
         p = np.asarray(prompt, np.int32).ravel()
-        req = _Request(p, int(max_new or self.config.max_new))
+        req = _Request(p, int(max_new or self.config.max_new), ctx)
         with self._cv:
             if self._stop.is_set():
                 raise RuntimeError(f"decode engine {self.name!r} is stopped")
             if len(self._q) >= self.config.max_queue:
                 self.shed += 1
+                self.shed_counter.inc()
                 raise OverloadedError(self.name, len(self._q),
                                       self.config.max_queue)
             if self.t_first is None:
@@ -267,10 +278,13 @@ class DecodeEngine:
             # ~10x per-step wall otherwise; falls back to the sharded
             # snapshot multi-process), amortized over the whole
             # generation stream the pin serves
-            self._pinned = replicate_for_decode(snap.value)
+            with trace.span("snapshot.pin", engine=self.name,
+                            version=snap.version):
+                self._pinned = replicate_for_decode(snap.value)
             self._snap = snap
 
     def _admit(self, arrivals: List[_Request], free: List[int]) -> None:
+        t_admit = time.monotonic()     # queue.wait ends / admission begins
         self._maybe_refresh()
         version = self._snap.version
         # phase 1 — dispatch every admission without blocking: arrivals
@@ -295,12 +309,13 @@ class DecodeEngine:
             first, self._k_cache, self._v_cache = self._admit_fn(
                 self._pinned, self._k_cache, self._v_cache,
                 jnp.asarray(slots), jnp.asarray(toks), jnp.asarray(lens))
-            staged.append((group, slots, first))
+            staged.append((group, slots, first, pb, bb))
         # phase 2 — read the first tokens back (one sync per group, after
         # every group's dispatch is already in the device queue)
-        for group, slots, first in staged:
+        for group, slots, first, pb, bb in staged:
             first = np.asarray(first)
             now = time.monotonic()
+            tracing = trace.enabled()
             for i, req in enumerate(group):
                 tok0 = int(first[i])
                 slot = int(slots[i])
@@ -309,6 +324,17 @@ class DecodeEngine:
                 self.ttft_hist.record((now - req.t_enq) * 1e3)
                 self.tokens += 1
                 req.out.append(tok0)
+                if tracing and req.ctx is not None:
+                    # the two child spans that explain a slow TTFT: how
+                    # long the prompt queued for a free slot, then the
+                    # fused prefill+insert with its bucket choice and
+                    # the pinned snapshot it was admitted under
+                    trace.record_span("queue.wait", req.ctx, req.t_enq,
+                                      t_admit, cause="admission")
+                    trace.record_span(
+                        "decode.admit", req.ctx, t_admit, now, slot=slot,
+                        prompt_len=len(req.prompt), prompt_bucket=pb,
+                        batch_bucket=bb, snapshot_version=version)
                 if self._finished(req, tok0):
                     # slot never goes live; the inserted K/V is dead
                     # weight a later admission overwrites
@@ -321,6 +347,11 @@ class DecodeEngine:
                 self._active[slot] = True
 
     def _step(self) -> None:
+        # ONE branch decides all per-iteration trace work: when tracing
+        # is off this loop allocates nothing trace-related (guarded by
+        # test_observability's overhead test)
+        tracing = trace.enabled()
+        t_it0 = time.monotonic() if tracing else 0.0
         # host state (tok/pos/active) feeds the jit as plain numpy — the
         # same aval signature warmup() uses, so the two share one trace
         self._k_cache, self._v_cache, nxt, _ = self._step_fn(
@@ -332,6 +363,7 @@ class DecodeEngine:
         self._pos[self._active] += 1
         self._tok = nxt               # np.array above: a fresh writable copy
         now = time.monotonic()
+        self.steps_counter.inc()
         n_active = 0
         for s in range(self.config.slots):
             req = self._slot_req[s]
@@ -343,6 +375,13 @@ class DecodeEngine:
             self.tokens += 1
             self.itl_hist.record((now - req.t_last) * 1e3)
             req.t_last = now
+            if tracing and req.ctx is not None:
+                # one fused step serves every live slot; each request
+                # gets the iteration as ITS child span (same interval),
+                # so a slow request's trace shows every co-batched
+                # iteration it sat through and on which slot
+                trace.record_span("decode.iter", req.ctx, t_it0, now,
+                                  slot=s, token_index=len(req.out))
             if self._finished(req, tok):
                 self._active[s] = False
                 self._slot_req[s] = None

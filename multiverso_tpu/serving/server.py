@@ -20,6 +20,7 @@ import threading
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
+from .. import trace
 from ..log import Log
 from .batcher import BatcherConfig, MicroBatcher
 from .decode_engine import DecodeEngine, DecodeEngineConfig
@@ -34,15 +35,16 @@ class _DecoderEntry:
         self.name = name
         self.engine = engine
 
-    def submit(self, payload: Any) -> Future:
+    def submit(self, payload: Any,
+               ctx: Optional[trace.SpanContext] = None) -> Future:
         """Payload: a 1-D prompt id array, or a dict with ``prompt`` and
         optional per-request ``max_new``."""
         if isinstance(payload, dict):
             if "prompt" not in payload:
                 raise ValueError("decoder payload dict needs a 'prompt' key")
             return self.engine.submit(payload["prompt"],
-                                      payload.get("max_new"))
-        return self.engine.submit(payload)
+                                      payload.get("max_new"), ctx=ctx)
+        return self.engine.submit(payload, ctx=ctx)
 
 
 class _ModelEntry:
@@ -146,14 +148,32 @@ class InferenceServer:
         workload's submit-time ``validate`` — a bad request must reject
         HERE, not poison every co-batched request at flush). The future
         resolves to a reply dict:
-        ``{"result", "snapshot_version", "staleness_s"}``."""
+        ``{"result", "snapshot_version", "staleness_s"}``.
+
+        When tracing is on (``trace.enable()`` / ``-trace``), each
+        request gets a ROOT span ``serve.request`` covering
+        submit -> reply; its handoff token rides the queue entry so the
+        batcher/engine threads attach queue-wait, admission and decode
+        child spans to the same trace id (docs/OBSERVABILITY.md)."""
         entry = self._entry(model)
-        if isinstance(entry, _DecoderEntry):
-            return entry.submit(payload)
-        validate = getattr(entry.workload, "validate", None)
-        if validate is not None:
-            validate(payload)
-        return entry.batcher.submit(payload)
+        root = trace.start_span("serve.request", root=True, model=model)
+        try:
+            if isinstance(entry, _DecoderEntry):
+                fut = entry.submit(payload, ctx=root.context)
+            else:
+                validate = getattr(entry.workload, "validate", None)
+                if validate is not None:
+                    validate(payload)
+                fut = entry.batcher.submit(payload, ctx=root.context)
+        except Exception as exc:
+            # shed / validation reject: the root span still closes, so
+            # rejected requests are visible in the trace with the reason
+            root.end(error=type(exc).__name__)
+            raise
+        if root is not trace.NULL_SPAN:
+            fut.add_done_callback(lambda f, sp=root: sp.end(
+                ok=(not f.cancelled()) and f.exception() is None))
+        return fut
 
     def predict(self, model: str, payload: Any,
                 timeout_s: float = 30.0) -> dict:

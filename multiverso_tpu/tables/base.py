@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import config
+from .. import config, trace
 from ..dashboard import Dashboard
 from ..log import Log
 from ..runtime import Session
@@ -294,11 +294,18 @@ class TableBase:
         with self._lock:
             mon = Dashboard.get_or_create(f"TABLE_ADD[{self.name}]")
             mon.begin()
+            # trace twin of the TABLE_ADD monitor: tagged with the table
+            # and the version this apply produced, so a serving trace's
+            # snapshot_version can be joined to the training-side apply
+            # that created it (NULL span while tracing is off)
+            sp = trace.start_span("table.add", table=self.name,
+                                  worker=option.worker_id)
             self._data, self._ustate = self._apply_fn(
                 self._data, self._ustate, staged,
                 *_option_scalars(option, self.dtype),
             )
             self.version += 1
+            sp.end(version=self.version)
             mon.end()
 
     # -- public ops --------------------------------------------------------

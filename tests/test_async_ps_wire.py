@@ -13,13 +13,31 @@ from multiverso_tpu.quantization import SparseFilter
 from multiverso_tpu.updaters import AddOption
 
 
+def test_wire_trace_context_round_trip():
+    """The two trace-id header fields: a publish span's context survives
+    serialization (so a consumer's apply span joins the publisher's
+    trace), and an untraced record deserializes to ctx=None."""
+    from multiverso_tpu import trace
+
+    ids = np.array([1, 2], np.int32)
+    vals = np.ones((2, 3), np.float32)
+    ctx = trace.SpanContext(trace_id=0xDEADBEEF1234, span_id=0x42)
+    data = async_ps._serialize(async_ps.KEYED, 4, None, [ids, vals], ctx)
+    *_, ctx2 = async_ps._deserialize(data)
+    assert ctx2 == ctx
+
+    bare = async_ps._serialize(async_ps.KEYED, 4, None, [ids, vals])
+    *_, ctx3 = async_ps._deserialize(bare)
+    assert ctx3 is None
+
+
 def test_dense_record_round_trip():
     opt = AddOption(worker_id=3, learning_rate=0.125, momentum=0.5,
                     rho=0.25, lam=0.0625)
     delta = np.arange(12, dtype=np.float32)
     blobs = SparseFilter(clip=0.0, dtype=np.float32).filter_in([delta])
     data = async_ps._serialize(async_ps.DENSE, 7, opt, blobs)
-    kind, table_id, opt2, arrays, ts = async_ps._deserialize(data)
+    kind, table_id, opt2, arrays, ts, ctx = async_ps._deserialize(data)
     assert (kind, table_id) == (async_ps.DENSE, 7)
     assert opt2.worker_id == 3
     assert opt2.learning_rate == pytest.approx(0.125)
@@ -34,7 +52,7 @@ def test_keyed_record_preserves_dtypes():
     ids = np.array([5, 1, 9], np.int32)
     vals = np.arange(6, dtype=np.float64).reshape(3, 2) * 0.1
     data = async_ps._serialize(async_ps.KEYED, 2, None, [ids, vals])
-    kind, table_id, opt, (ids2, vals2), ts = async_ps._deserialize(data)
+    kind, table_id, opt, (ids2, vals2), ts, ctx = async_ps._deserialize(data)
     assert kind == async_ps.KEYED and table_id == 2
     assert ids2.dtype == np.int32 and vals2.dtype == np.float64
     np.testing.assert_array_equal(ids2, ids)
@@ -47,7 +65,7 @@ def test_bfloat16_wire_round_trip():
 
     arr = np.array([1.5, -2.5, 0.0, 3.0], ml_dtypes.bfloat16)
     data = async_ps._serialize(async_ps.DENSE, 0, None, [arr])
-    _, _, _, (out,), _ = async_ps._deserialize(data)
+    _, _, _, (out,), _, _ = async_ps._deserialize(data)
     assert out.dtype == np.dtype(ml_dtypes.bfloat16)
     np.testing.assert_array_equal(out.astype(np.float32),
                                   arr.astype(np.float32))
@@ -57,7 +75,7 @@ def test_kv_record():
     keys = np.array([7, -3], np.int64)
     vals = np.array([1.0, 0.5], np.float64)
     data = async_ps._serialize(async_ps.KV, 1, None, [keys, vals])
-    kind, table_id, _, (k2, v2), _ = async_ps._deserialize(data)
+    kind, table_id, _, (k2, v2), _, _ = async_ps._deserialize(data)
     assert kind == async_ps.KV
     np.testing.assert_array_equal(k2, keys)
     np.testing.assert_array_equal(v2, vals)
@@ -110,6 +128,6 @@ def test_sparse_filter_compresses_sparse_dense_payload():
     blobs = f.filter_in([delta])
     wire = async_ps._serialize(async_ps.DENSE, 0, None, blobs)
     assert len(wire) < delta.nbytes // 2   # actually compressed
-    _, _, _, arrays, _ = async_ps._deserialize(wire)
+    _, _, _, arrays, _, _ = async_ps._deserialize(wire)
     out = f.filter_out(arrays)[0]
     np.testing.assert_array_equal(out, delta)

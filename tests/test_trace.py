@@ -1,0 +1,224 @@
+"""Unit tests for the span/trace layer (multiverso_tpu/trace.py).
+
+Pure host-side: no session, no jax. The serving-path integration
+(root spans, batcher handoff, decode iterations, the e2e Chrome-trace
+smoke) lives in tests/test_observability.py.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from multiverso_tpu import trace
+
+
+@pytest.fixture()
+def traced():
+    """Tracing on for the test, off + cleared afterwards (the collector
+    is module-global)."""
+    trace.enable(4096)
+    trace.collector().clear()
+    yield trace.collector()
+    trace.disable()
+    trace.collector().clear()
+
+
+def test_ambient_nesting_and_ids(traced):
+    with trace.span("root", root=True, model="m") as root:
+        assert trace.current_span() is root
+        with trace.span("child") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            assert child.span_id != root.span_id
+        # sibling after the first child closed: still parented to root
+        with trace.span("child2") as child2:
+            assert child2.parent_id == root.span_id
+    assert trace.current_span() is None
+    spans = traced.spans()
+    assert [s.name for s in spans] == ["child", "child2", "root"]
+    assert spans[2].attrs["model"] == "m"
+    # children recorded before the root (they END first), one trace total
+    assert len({s.trace_id for s in spans}) == 1
+
+
+def test_root_spans_do_not_nest_under_ambient(traced):
+    with trace.span("outer", root=True) as outer:
+        inner = trace.start_span("fresh", root=True)
+        assert inner.trace_id != outer.trace_id
+        assert inner.parent_id is None
+        inner.end()
+
+
+def test_handoff_token_across_threads(traced):
+    """The batcher-boundary contract: a worker-thread span opened from a
+    handoff token joins the submitter's trace; two interleaved requests
+    never leak into each other's trace."""
+    roots = [trace.start_span(f"req{i}", root=True) for i in range(2)]
+    tokens = [r.context for r in roots]
+    done = threading.Barrier(3)
+
+    def worker(ix: int) -> None:
+        # interleave: both workers run concurrently on their own threads
+        with trace.span("work", parent=tokens[ix], ix=ix):
+            done.wait(timeout=5)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    done.wait(timeout=5)
+    for t in threads:
+        t.join(timeout=5)
+    for r in roots:
+        r.end()
+    spans = traced.spans()
+    for ix in range(2):
+        work = [s for s in spans if s.name == "work"
+                and s.attrs["ix"] == ix]
+        assert len(work) == 1
+        assert work[0].trace_id == roots[ix].trace_id
+        assert work[0].parent_id == roots[ix].span_id
+    assert roots[0].trace_id != roots[1].trace_id
+
+
+def test_explicit_end_idempotent_and_attrs(traced):
+    sp = trace.start_span("s", root=True, a=1)
+    sp.set(b=2)
+    sp.end(c=3)
+    t1 = sp.t1
+    sp.end(d=4)                       # second end: no re-record, no attr
+    assert sp.t1 == t1
+    spans = traced.spans()
+    assert len(spans) == 1
+    assert spans[0].attrs == {"a": 1, "b": 2, "c": 3}
+
+
+def test_record_span_post_hoc(traced):
+    root = trace.start_span("root", root=True)
+    t1 = time.monotonic()
+    trace.record_span("measured", root.context, t1 - 0.005, t1, bucket=8)
+    root.end()
+    sp = [s for s in traced.spans() if s.name == "measured"][0]
+    assert sp.trace_id == root.trace_id
+    assert sp.parent_id == root.span_id
+    assert 4.0 < sp.duration_ms() < 50.0
+    assert sp.attrs["bucket"] == 8
+
+
+def test_ring_wraparound_bounds_memory():
+    trace.enable(capacity=8)
+    try:
+        col = trace.collector()
+        col.clear()
+        for i in range(20):
+            trace.start_span(f"s{i}", root=True).end()
+        spans = col.spans()
+        assert len(spans) == 8                      # bounded
+        assert [s.name for s in spans] == [f"s{i}" for i in range(12, 20)]
+        assert col.dropped == 12
+        assert col.recorded == 20
+    finally:
+        trace.disable()
+        trace.collector().clear()
+
+
+def test_disabled_is_free():
+    """Off by default: no span objects, no records, shared null span."""
+    assert not trace.enabled()
+    assert trace.start_span("x") is trace.NULL_SPAN
+    assert trace.span("x") is trace.NULL_SPAN
+    assert trace.NULL_SPAN.context is None
+    with trace.span("x") as sp:
+        assert sp is trace.NULL_SPAN
+    trace.record_span("x", None, 0.0, 1.0)
+    assert trace.collector().spans() == []
+    assert trace.current_context() is None
+
+
+def test_chrome_export_structure(traced, tmp_path):
+    with trace.span("root", root=True, model="lm") as root:
+        tok = root.context
+    with trace.span("child", parent=tok, slot=1):
+        pass
+    path = str(tmp_path / "t.json")
+    doc = trace.export_chrome(path)
+    on_disk = json.load(open(path))
+    assert on_disk["traceEvents"] == doc["traceEvents"]
+    events = doc["traceEvents"]
+    stats = trace.validate_chrome_events(events, root_name="root")
+    assert stats["spans"] == 2
+    assert stats["traces"] == 1
+    assert stats["roots"] == 1
+    # epoch-us timebase: within a day of now (merge-by-range contract)
+    now_us = time.time() * 1e6
+    assert all(abs(e["ts"] - now_us) < 86400e6 for e in events)
+
+
+def test_validator_rejects_malformed():
+    ok = [
+        {"name": "r", "ph": "B", "ts": 1.0, "pid": 1, "tid": 1,
+         "args": {"trace_id": "a", "span_id": "1"}},
+        {"name": "r", "ph": "E", "ts": 2.0, "pid": 1, "tid": 1},
+    ]
+    trace.validate_chrome_events(ok)
+    # non-monotonic ts (a well-formed span pair ordered after a later one)
+    early = [
+        {"name": "q", "ph": "B", "ts": 0.5, "pid": 1, "tid": 2,
+         "args": {"trace_id": "b", "span_id": "3"}},
+        {"name": "q", "ph": "E", "ts": 0.6, "pid": 1, "tid": 2},
+    ]
+    with pytest.raises(ValueError, match="time-sorted"):
+        trace.validate_chrome_events(ok + early)
+    # unmatched B
+    with pytest.raises(ValueError, match="never closed"):
+        trace.validate_chrome_events(ok[:1])
+    # E without B
+    with pytest.raises(ValueError, match="no open B"):
+        trace.validate_chrome_events(ok[1:])
+    # interleaved (not nested) on one track
+    bad = [
+        {"name": "a", "ph": "B", "ts": 1.0, "pid": 1, "tid": 1,
+         "args": {"trace_id": "t", "span_id": "1"}},
+        {"name": "b", "ph": "B", "ts": 2.0, "pid": 1, "tid": 1,
+         "args": {"trace_id": "t", "span_id": "2", "parent_id": "1"}},
+        {"name": "a", "ph": "E", "ts": 3.0, "pid": 1, "tid": 1},
+        {"name": "b", "ph": "E", "ts": 4.0, "pid": 1, "tid": 1},
+    ]
+    with pytest.raises(ValueError, match="interleaved"):
+        trace.validate_chrome_events(bad)
+    # dangling parent in a ROOTED trace (the root is here, the cited
+    # parent is not) — an export bug, not a fragment
+    with pytest.raises(ValueError, match="unknown parent"):
+        trace.validate_chrome_events([
+            {"name": "r", "ph": "B", "ts": 1.0, "pid": 1, "tid": 1,
+             "args": {"trace_id": "t", "span_id": "1"}},
+            {"name": "r", "ph": "E", "ts": 2.0, "pid": 1, "tid": 1},
+            {"name": "c", "ph": "B", "ts": 3.0, "pid": 1, "tid": 1,
+             "args": {"trace_id": "t", "span_id": "9", "parent_id": "8"}},
+            {"name": "c", "ph": "E", "ts": 4.0, "pid": 1, "tid": 1},
+        ])
+    # the same orphan WITHOUT a local root is a fragment (cross-process
+    # bus.apply, or a request still in flight at export) and passes
+    trace.validate_chrome_events([
+        {"name": "bus.apply", "ph": "B", "ts": 1.0, "pid": 1, "tid": 1,
+         "args": {"trace_id": "t2", "span_id": "9", "parent_id": "8"}},
+        {"name": "bus.apply", "ph": "E", "ts": 2.0, "pid": 1, "tid": 1},
+    ], root_name="serve.request")
+    # two roots in one trace flagged when a root name is asserted
+    two_roots = ok + [
+        {"name": "r", "ph": "B", "ts": 3.0, "pid": 1, "tid": 1,
+         "args": {"trace_id": "a", "span_id": "2"}},
+        {"name": "r", "ph": "E", "ts": 4.0, "pid": 1, "tid": 1},
+    ]
+    trace.validate_chrome_events(two_roots)          # fine without
+    with pytest.raises(ValueError, match="root"):
+        trace.validate_chrome_events(two_roots, root_name="r")
+
+
+def test_span_error_attr_on_exception(traced):
+    with pytest.raises(RuntimeError):
+        with trace.span("boom", root=True):
+            raise RuntimeError("x")
+    sp = traced.spans()[0]
+    assert sp.attrs["error"] == "RuntimeError"

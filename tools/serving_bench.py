@@ -19,10 +19,18 @@ trace. The static path locks every co-batched request through a full
 ``max_new`` generation (head-of-line blocking), so on mixed lengths the
 engine's useful-tokens/sec should win by >= 2x (``speedup_engine``).
 
+The JSON line also archives the FULL ``Dashboard.snapshot()`` (every
+Monitor/Histogram/Gauge/Counter), so a bench run preserves the complete
+instrument state — not just the hand-picked fields above — and
+``--trace FILE`` additionally records request-level spans
+(``multiverso_tpu.trace``) and writes a Chrome/Perfetto trace JSON so a
+slow bench percentile can be explained request by request
+(docs/OBSERVABILITY.md).
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/serving_bench.py [-duration 2.0]
-        [-clients 32] [-quick]
+        [-clients 32] [-quick] [--trace /tmp/serve_trace.json]
 """
 
 from __future__ import annotations
@@ -188,10 +196,15 @@ def _warm(workload, snap_mgr, buckets) -> None:
 
 
 def run(duration_s: float = 2.0, clients: int = 32,
-        quick: bool = False) -> dict:
+        quick: bool = False, trace_path: str = "") -> dict:
     import multiverso_tpu as mv
+    from multiverso_tpu import trace
+    from multiverso_tpu.dashboard import Dashboard
 
-    mv.init(["serving_bench", "-log_level=error"])
+    argv = ["serving_bench", "-log_level=error"]
+    if trace_path:
+        argv.append("-trace=true")
+    mv.init(argv)
     from multiverso_tpu.models.logreg import LogReg, LogRegConfig
     from multiverso_tpu.models.transformer import (TransformerConfig,
                                                    TransformerLM)
@@ -256,6 +269,12 @@ def run(duration_s: float = 2.0, clients: int = 32,
                                n_layers=2, d_ff=256, max_seq=112)
     out["workloads"]["lm_decode"] = _decode_ab(
         server, TransformerLM(ab_cfg), quick)
+    # the FULL instrument state rides the same line: bench archives keep
+    # every histogram/gauge/counter, not just the hand-picked fields
+    out["dashboard"] = Dashboard.snapshot()
+    if trace_path:
+        trace.export_chrome(trace_path)
+        out["trace"] = {"file": trace_path, **trace.collector().stats()}
     mv.shutdown()
     return out
 
@@ -267,8 +286,11 @@ def main() -> None:
     ap.add_argument("-clients", type=int, default=32)
     ap.add_argument("-quick", action="store_true",
                     help="cap duration at 1 s (CI smoke)")
+    ap.add_argument("-trace", "--trace", default="",
+                    help="record request spans and write Chrome/Perfetto "
+                         "trace JSON here")
     args, _ = ap.parse_known_args()
-    result = run(args.duration, args.clients, args.quick)
+    result = run(args.duration, args.clients, args.quick, args.trace)
     print(json.dumps(result))
 
 

@@ -1,4 +1,4 @@
-"""Summarise an xprof trace by op and by source line.
+"""Summarise an xprof trace by op/source — and explain per-request time.
 
 Companion to ``dashboard.profile_trace`` (and any ``jax.profiler`` trace):
 reads the ``*.trace.json.gz`` a capture writes and prints hardware-measured
@@ -14,7 +14,22 @@ Wall-clock micro-benchmarks are unreliable on tunneled devices (dispatch
 acks return early); the trace's ``device_duration_ps`` values come from
 the hardware counters and are the trustworthy number.
 
-Usage: python tools/trace_summary.py TRACE_DIR [--top 20] [--by op|source]
+``--host-trace FILE`` adds the REQUEST dimension (docs/OBSERVABILITY.md):
+FILE is a Chrome trace JSON from ``multiverso_tpu.trace`` (e.g.
+``tools/serving_bench.py --trace``). Per request (one root span per
+trace id) the report breaks host wall time into queue wait, admission/
+prefill, batch execution and decode iterations — the stages that explain
+a p99 outlier. Given BOTH a host trace and an xprof TRACE_DIR, the two
+are merged by time range: device-op time whose timeline falls inside a
+request's root-span window is attributed to that request (the captures
+must cover the same run; the tool aligns the two clocks by their first
+events, so co-captured traces line up within scheduling jitter).
+
+Usage::
+
+    python tools/trace_summary.py TRACE_DIR [--top 20] [--by op|source]
+    python tools/trace_summary.py --host-trace serve.json [TRACE_DIR]
+        [--top 20] [--sort total|queue|device]
 """
 
 from __future__ import annotations
@@ -69,14 +84,141 @@ def summarize(events, by: str = "source"):
     return dur, count, label
 
 
+def load_host_spans(path: str):
+    """Rebuild spans from a ``multiverso_tpu.trace`` Chrome export:
+    matched B/E pairs per (pid, tid) track -> span dicts."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    stacks: dict = {}
+    spans = []
+    for e in events:
+        ph = e.get("ph")
+        key = (e.get("pid"), e.get("tid"))
+        if ph == "B":
+            stacks.setdefault(key, []).append(e)
+        elif ph == "E":
+            stack = stacks.get(key)
+            if not stack:
+                continue
+            b = stack.pop()
+            args = b.get("args", {})
+            spans.append({
+                "name": b.get("name", "?"),
+                "ts": float(b.get("ts", 0.0)),
+                "dur": float(e.get("ts", 0.0)) - float(b.get("ts", 0.0)),
+                "trace_id": args.get("trace_id"),
+                "parent_id": args.get("parent_id"),
+                "args": args,
+            })
+    return spans
+
+
+# child span names folded into per-request report columns
+_STAGE_COLUMNS = (
+    ("queue_ms", ("queue.wait",)),
+    ("admit_ms", ("decode.admit",)),
+    ("exec_ms", ("batch.exec",)),
+    ("decode_ms", ("decode.iter",)),
+)
+
+
+def request_report(spans, device_events=None):
+    """Per-request rows from host spans (+ optional device-time merge).
+
+    A request = one root span (no parent_id) and every span sharing its
+    trace id. Device events (xprof, ``device_duration_ps``) are merged
+    BY TIME RANGE: the two timelines are aligned on their first events,
+    then device-op time inside a request's window is attributed to it
+    (overlapping requests both count a shared interval — attribution,
+    not accounting).
+    """
+    by_trace: dict = {}
+    for sp in spans:
+        if sp["trace_id"] is not None:
+            by_trace.setdefault(sp["trace_id"], []).append(sp)
+    device = []
+    offset = 0.0
+    if device_events:
+        xs = [e for e in device_events
+              if e.get("ph") == "X"
+              and "device_duration_ps" in e.get("args", {})]
+        if xs and spans:
+            offset = (min(s["ts"] for s in spans)
+                      - min(float(e.get("ts", 0.0)) for e in xs))
+        device = [(float(e["ts"]) + offset,
+                   float(e["ts"]) + offset + float(e.get("dur", 0.0)),
+                   int(e["args"]["device_duration_ps"]) / 1e9) for e in xs]
+    rows = []
+    for trace_id, group in by_trace.items():
+        roots = [s for s in group if s["parent_id"] is None]
+        if len(roots) != 1:
+            continue            # cross-process fragments / partial capture
+        root = roots[0]
+        row = {
+            "trace_id": trace_id,
+            "name": root["name"],
+            "model": root["args"].get("model", ""),
+            "total_ms": root["dur"] / 1e3,
+            "iters": sum(s["name"] == "decode.iter" for s in group),
+        }
+        for col, names in _STAGE_COLUMNS:
+            row[col] = sum(s["dur"] for s in group
+                           if s["name"] in names) / 1e3
+        if device:
+            w0, w1 = root["ts"], root["ts"] + root["dur"]
+            row["device_ms"] = sum(
+                d for (t0, t1, d) in device if t0 < w1 and t1 > w0)
+        rows.append(row)
+    return rows
+
+
+def print_request_report(rows, top: int, sort: str) -> None:
+    key = {"total": "total_ms", "queue": "queue_ms",
+           "device": "device_ms"}.get(sort, "total_ms")
+    rows = sorted(rows, key=lambda r: r.get(key, 0.0), reverse=True)
+    has_dev = any("device_ms" in r for r in rows)
+    print(f"{len(rows)} request(s); slowest by {key}:")
+    hdr = (f"{'total':>9} {'queue':>8} {'admit':>8} {'exec':>8} "
+           f"{'decode':>8} {'iters':>6}")
+    if has_dev:
+        hdr += f" {'device':>9}"
+    print(hdr + "  trace_id [model]")
+    for r in rows[:top]:
+        line = (f"{r['total_ms']:9.3f} {r['queue_ms']:8.3f} "
+                f"{r['admit_ms']:8.3f} {r['exec_ms']:8.3f} "
+                f"{r['decode_ms']:8.3f} {r['iters']:6d}")
+        if has_dev:
+            line += f" {r.get('device_ms', 0.0):9.3f}"
+        # non-request roots (snapshot.pin, table.add, bus.publish) label
+        # themselves by span name instead of a model
+        print(line + f"  {r['trace_id']} [{r['model'] or r['name']}]")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("trace_dir")
+    ap.add_argument("trace_dir", nargs="?", default=None,
+                    help="xprof capture directory (*.trace.json.gz)")
     ap.add_argument("--top", type=int, default=20)
     ap.add_argument("--by", choices=["source", "op"], default="source")
+    ap.add_argument("--host-trace", default=None,
+                    help="multiverso_tpu.trace Chrome JSON: per-request "
+                         "host breakdown (+ device merge with TRACE_DIR)")
+    ap.add_argument("--sort", choices=["total", "queue", "device"],
+                    default="total", help="request-report sort column")
     args = ap.parse_args(argv)
 
-    events = load_events(args.trace_dir)
+    if args.host_trace is None and args.trace_dir is None:
+        ap.error("need an xprof TRACE_DIR, a --host-trace file, or both")
+
+    events = load_events(args.trace_dir) if args.trace_dir else None
+    if args.host_trace is not None:
+        spans = load_host_spans(args.host_trace)
+        rows = request_report(spans, events)
+        print_request_report(rows, args.top, args.sort)
+        if events is None:
+            return 0
+        print()
     dur, count, label = summarize(events, args.by)
     total = sum(dur.values())
     print(f"device time total: {total:.2f} ms "
