@@ -218,6 +218,11 @@ define_float("failure_timeout_s", 0.0,
              "declare a peer dead after this many seconds of missed "
              "heartbeats and keep training without it (async bus "
              "survivor mode); 0 disables the watchdog")
+define_int("prefill_token_budget", 32,
+           "decode engine: per-iteration chunked-prefill token budget "
+           "(Sarathi-style stall-free admission — inter-token latency is "
+           "bounded by one budget-sized chunk regardless of arriving "
+           "prompt length); 0 = monolithic whole-prompt admission")
 define_string("log_file", "", "optional log sink file")
 define_string("log_level", "info", "debug|info|error|fatal")
 define_bool("trace", False,

@@ -305,23 +305,34 @@ def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
     body, with the batch dim reinterpreted as the slot dim), and emits its
     greedy next token. Dead slots still flow through the fused program
     (one compiled trace regardless of which slots live) but emit pad and
-    keep a frozen ``pos``; their cache writes land in slots nothing
-    attends, and an admission's :func:`cache_insert` overwrites the prompt
-    region before the slot goes live again.
+    keep a frozen ``pos``; their cache writes are parked at position
+    ``T - 1`` — never at the frozen ``pos``, which could sit inside a
+    prompt region a chunked admission is prefilling between iterations —
+    and a later admission/live decode overwrites anything they left
+    before attending it.
 
     Returns ``(k_cache, v_cache, next_tok [S], pos [S])`` — jit with
     ``donate_argnums`` on the caches so XLA updates them in place.
     """
     S = tok.shape[0]
+    T = k_cache.shape[2]
     slot_ix = jnp.arange(S)
+    # dead lanes still flow through the fused program but must NOT write
+    # at their frozen ``pos``: a chunked prefill may be mid-flight in
+    # that slot (serving/decode_engine.py), and a stale-pos write
+    # between two chunks would clobber prompt K/V already inserted.
+    # Park dead writes at T-1 — a position strictly past any prompt
+    # (T = max_prompt + max_new, max_new >= 1) that a live generation
+    # overwrites before its attention mask ever reaches it.
+    write_pos = jnp.where(active, pos, T - 1)
     h = (jnp.take(params["embed"], tok, axis=0)
          + jnp.take(params["pos"], pos, axis=0))
     for i in range(cfg.n_layers):
         layer = jax.tree.map(lambda a: a[i], params["layers"])
         x = _rmsnorm(h, layer["ln1_g"])
         q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
-        k_cache = k_cache.at[i, slot_ix, pos].set(k)
-        v_cache = v_cache.at[i, slot_ix, pos].set(v)
+        k_cache = k_cache.at[i, slot_ix, write_pos].set(k)
+        v_cache = v_cache.at[i, slot_ix, write_pos].set(v)
         h = h + _cached_attention(
             q, k_cache[i], v_cache[i], cfg.n_heads, pos) @ layer["w_o"]
         x = _rmsnorm(h, layer["ln2_g"])
@@ -333,6 +344,94 @@ def decode_step(cfg: TransformerConfig, params: Dict[str, Any],
     nxt = jnp.where(active, nxt, jnp.zeros_like(nxt))
     pos = jnp.where(active, pos + 1, pos)
     return k_cache, v_cache, nxt, pos
+
+
+def _chunk_attention(q, k_cache, v_cache, n_heads: int, offset) -> jax.Array:
+    """Chunk attention: ``q`` [C, D] against one slot's cache [T, D].
+
+    Chunk position ``i`` (cache position ``offset + i``) attends cache
+    entries at positions ``<= offset + i`` — the already-inserted prefix
+    from earlier chunks plus this chunk's own K/V (written before the
+    call), everything past is masked. Math matches
+    :func:`_cached_attention` (1/sqrt(dh) scale, f32 softmax) so a
+    chunked prefill's last-position logits argmax to the same first
+    token the fused whole-prompt :func:`prefill` produces.
+    """
+    C, D = q.shape
+    T = k_cache.shape[0]
+    dh = D // n_heads
+    qh = q.reshape(C, n_heads, dh)
+    kh = k_cache.reshape(T, n_heads, dh)
+    vh = v_cache.reshape(T, n_heads, dh)
+    scores = jnp.einsum("chd,thd->hct", qh, kh,
+                        preferred_element_type=jnp.float32) / np.sqrt(dh)
+    mask = (jnp.arange(T)[None, :]
+            <= (offset + jnp.arange(C))[:, None])[None, :, :]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("hct,thd->chd", probs.astype(vh.dtype), vh)
+    return out.reshape(C, D).astype(q.dtype)
+
+
+def prefill_chunk(cfg: TransformerConfig, params: Dict[str, Any],
+                  k_cache: jax.Array, v_cache: jax.Array, slot: jax.Array,
+                  tokens: jax.Array, offset: jax.Array, length: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Incremental prefill: one fixed-size chunk of one slot's prompt.
+
+    ``k_cache``/``v_cache`` [L, S, T, D] (the decode engine's slot
+    caches), ``tokens`` [C] right-padded chunk ids, ``slot`` the target
+    slot, ``offset`` the cache position of ``tokens[0]``, ``length`` the
+    real token count in this chunk (``1 <= length <= C``). All of slot/
+    offset/length are traced scalars: ONE compiled trace per chunk size
+    serves every (slot, offset, partial-fill) combination — the
+    Sarathi-style budget knob adds exactly one trace to the engine's
+    accounting, next to the single fused :func:`decode_step`.
+
+    Each chunk position's K/V is written in place at
+    ``[l, slot, offset + i]`` (a per-position scatter) BEFORE attention,
+    so causal attention for position ``offset + i`` covers the already-
+    inserted prefix ``[0, offset)`` from earlier chunks plus the chunk's
+    own positions ``<= i`` via :func:`_chunk_attention`'s mask. The
+    write is a scatter, NOT a C-wide dynamic-update-slice: a final
+    chunk's pad tail can extend past ``T`` (``ceil(P/C)*C`` need not fit
+    ``max_prompt + max_new``), and a DUS would CLAMP its start index
+    back over real prompt positions — silent K/V corruption. Scatter
+    pad writes past ``T - 1`` simply drop (the ``add_rows`` XLA
+    out-of-bounds contract); in-bounds real positions are distinct, so
+    the write stays deterministic. In-bounds pad garbage lands at cache
+    positions the decode mask only reaches AFTER :func:`decode_step`
+    overwrites them (the :func:`prefill` pad contract), and pad
+    position-embedding reads clamp (``jnp.take``'s OOB mode), so the
+    garbage is never observable.
+
+    Returns ``(k_cache, v_cache, last_logits [V])`` — the logits of
+    position ``offset + length - 1``. Callers use them only on the
+    FINAL chunk of a prompt, where they are the prompt's last real
+    position: the first generated token still falls out of the last
+    chunk, exactly as it falls out of a whole-prompt prefill.
+    """
+    C = tokens.shape[0]
+    pos_ix = offset + jnp.arange(C)
+    h = (jnp.take(params["embed"], tokens, axis=0)
+         + jnp.take(params["pos"], pos_ix, axis=0))
+    for i in range(cfg.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["layers"])
+        x = _rmsnorm(h, layer["ln1_g"])
+        q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
+        k_cache = k_cache.at[i, slot, pos_ix].set(k)
+        v_cache = v_cache.at[i, slot, pos_ix].set(v)
+        kc = jax.lax.dynamic_index_in_dim(k_cache[i], slot, 0, keepdims=False)
+        vc = jax.lax.dynamic_index_in_dim(v_cache[i], slot, 0, keepdims=False)
+        h = h + _chunk_attention(
+            q, kc, vc, cfg.n_heads, offset) @ layer["w_o"]
+        x = _rmsnorm(h, layer["ln2_g"])
+        h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+    h = _rmsnorm(h, params["ln_f_g"])
+    last = jnp.take(h, length - 1, axis=0)
+    logits = jnp.einsum("d,vd->v", last, params["embed"],
+                        preferred_element_type=jnp.float32)
+    return k_cache, v_cache, logits
 
 
 def cache_insert(k_cache: jax.Array, v_cache: jax.Array, slots: jax.Array,

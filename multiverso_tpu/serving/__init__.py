@@ -16,7 +16,9 @@ The inference half of the train/serve stack (docs/SERVING.md). Pieces:
 * :class:`DecodeEngine` — continuous-batching LM decode: persistent
   slotted KV cache, ONE fused jitted step per iteration,
   iteration-granular admission/completion
-  (``InferenceServer.register_decoder``).
+  (``InferenceServer.register_decoder``), chunked prefill under a
+  per-iteration token budget (``prefill_token_budget``) so admissions
+  never stall in-flight generations for more than one chunk of work.
 """
 
 from .batcher import (BatcherConfig, MicroBatcher, OverloadedError,

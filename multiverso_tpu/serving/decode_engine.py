@@ -16,12 +16,23 @@ cache (the fixed-slot precursor to vLLM's PagedAttention):
   :func:`models.transformer.decode_step` over all S slots, live or
   dead. Shapes never depend on the request mix, so the step compiles
   exactly once per engine config.
-* **iteration-granular admission** — an arriving prompt is prefilled
-  through the existing bucketed :func:`models.transformer.prefill`
-  (admissions batched per iteration, padded to batch/prompt buckets),
-  its K/V written into a free slot by a jitted donated
-  :func:`models.transformer.cache_insert`, and it decodes on the very
-  next step. Its first token falls out of the prefill, so TTFT is one
+* **chunked, budget-bounded admission** — an arriving prompt prefills
+  in fixed-size chunks (:func:`models.transformer.prefill_chunk`, K/V
+  written straight into its reserved slot), AT MOST ONE chunk per
+  iteration interleaved with the fused decode step. Inter-token latency
+  for in-flight generations is therefore bounded by one budget-sized
+  chunk of work regardless of the arriving prompt's length (the
+  Sarathi-Serve stall-free schedule), and a long prompt's TTFT
+  amortizes across iterations instead of blocking the world. The chunk
+  size is the ``prefill_token_budget`` config knob; its fixed shape
+  adds exactly ONE compiled trace per engine config. Setting the
+  budget to 0 restores **monolithic admission**: arrivals batched per
+  iteration through the bucketed :func:`models.transformer.prefill` +
+  fused :func:`models.transformer.cache_insert` (one synchronous
+  whole-prompt prefill between decode iterations — cheapest for
+  uniformly short prompts, and the A/B baseline the chunked path is
+  benched against in ``tools/serving_bench.py``). Either way the first
+  token falls out of the (last chunk of the) prefill, so TTFT is one
   prefill — not one full batch drain.
 * **iteration-granular completion** — a slot frees the moment its
   sequence emits ``eos_id`` or reaches its per-request ``max_new``;
@@ -74,17 +85,29 @@ class DecodeEngineConfig:
     max_staleness_s: float = 0.05
     # prompt pad buckets (powers of two up to max_prompt by default):
     # one compiled prefill/insert per bucket, step compiles ONCE regardless
+    # (monolithic admission only; chunked admission needs no buckets)
     prompt_buckets: Optional[Tuple[int, ...]] = None
+    # per-iteration chunked-prefill token budget; None = the
+    # -prefill_token_budget flag, 0 = monolithic whole-prompt admission
+    prefill_token_budget: Optional[int] = None
 
     def resolved_prompt_buckets(self) -> Tuple[int, ...]:
         if self.prompt_buckets:
             return tuple(self.prompt_buckets)
         return shape_buckets(self.max_prompt)
 
+    def resolved_prefill_budget(self) -> int:
+        if self.prefill_token_budget is not None:
+            return int(self.prefill_token_budget)
+        from ..config import get_flag
+
+        return int(get_flag("prefill_token_budget"))
+
 
 class _Request:
     __slots__ = ("prompt", "max_new", "future", "t_enq", "t_last",
-                 "slot", "out", "version", "ctx")
+                 "slot", "out", "version", "ctx", "pf_off", "pf_chunks",
+                 "t_admit")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  ctx: Optional[trace.SpanContext] = None) -> None:
@@ -99,6 +122,11 @@ class _Request:
         # trace handoff token (the submitter's root-span context): the
         # engine thread parents admission/iteration spans under it
         self.ctx = ctx
+        # chunked-prefill progress: next chunk's prompt offset, chunks
+        # run so far, and when admission began (queue.wait boundary)
+        self.pf_off = 0
+        self.pf_chunks = 0
+        self.t_admit = 0.0
 
 
 class DecodeEngine:
@@ -113,7 +141,8 @@ class DecodeEngine:
 
     def __init__(self, name: str, lm, config: Optional[DecodeEngineConfig]
                  = None) -> None:
-        from ..models.transformer import (cache_insert, decode_step, prefill)
+        from ..models.transformer import (cache_insert, decode_step, prefill,
+                                          prefill_chunk)
 
         self.name = name
         self.config = config or DecodeEngineConfig()
@@ -160,6 +189,22 @@ class DecodeEngine:
             return first, kc, vc
 
         self._admit_fn = jax.jit(_admit_insert, donate_argnums=donate)
+        # chunked admission: a fixed-size chunk prefilled straight into
+        # the slot cache at a traced (slot, offset, length) — the chunk
+        # shape is the ONLY static, so this is exactly one extra
+        # compiled trace per engine config (asserted in the tests)
+        self._budget = ec.resolved_prefill_budget()
+        if self._budget < 0:
+            Log.fatal(f"DecodeEngine {name!r}: negative "
+                      f"prefill_token_budget {self._budget}")
+        # a chunk never needs more tokens than the longest admissible
+        # prompt (and must fit the [.., T, ..] cache): clamp the chunk
+        # shape — budgets past max_prompt just mean one-chunk admission
+        self._budget = min(self._budget, ec.max_prompt)
+        self._chunk_fn = jax.jit(
+            lambda params, kc, vc, slot, toks, off, n: prefill_chunk(
+                cfg, params, kc, vc, slot, toks, off, n),
+            donate_argnums=donate)
         # THE fused step: all shapes fixed by the engine config -> exactly
         # one compiled trace no matter which slots are live
         self._step_fn = jax.jit(
@@ -180,6 +225,9 @@ class DecodeEngine:
         self._tok = np.zeros(S, np.int32)
         self._pos = np.zeros(S, np.int32)
         self._active = np.zeros(S, bool)
+        # the one admission currently prefilling in chunks (its slot is
+        # reserved — excluded from the free pool — but not yet live)
+        self._pf: Optional[_Request] = None
         self._q: Deque[_Request] = collections.deque()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -195,9 +243,20 @@ class DecodeEngine:
             f"SERVE_SHED[{name}]")
         self.steps_counter = Dashboard.get_or_create_counter(
             f"DECODE_STEPS[{name}]")
+        # token-accounting split: prompt tokens prefilled vs tokens
+        # emitted — interval-deltas (MetricsExporter) become the two
+        # rates whose ratio says where the engine's FLOPs are going
+        self.prefill_tok_counter = Dashboard.get_or_create_counter(
+            f"PREFILL_TOKENS[{name}]")
+        self.decode_tok_counter = Dashboard.get_or_create_counter(
+            f"DECODE_TOKENS[{name}]")
         self.completed = 0
         self.shed = 0
         self.tokens = 0
+        # engine-local prefill-token count: the PREFILL_TOKENS Counter is
+        # monotonic by contract (MetricsExporter rates), so stats() and
+        # reset_stats() read/zero this mirror instead
+        self.prefill_tokens = 0
         self.t_first: Optional[float] = None
         self._occ_sum = 0.0          # mean occupancy over iterations
         self._occ_n = 0
@@ -241,22 +300,46 @@ class DecodeEngine:
             return len(self._q)
 
     # -- engine loop --------------------------------------------------------
+    def _free_slots(self) -> List[int]:
+        """Slots holding no live sequence and not reserved by the
+        in-flight chunked prefill."""
+        pf_slot = self._pf.slot if self._pf is not None else -1
+        return [s for s in range(self.config.slots)
+                if not self._active[s] and s != pf_slot]
+
     def _loop(self) -> None:
+        chunked = self._budget > 0
         while True:
             with self._cv:
-                while (not self._q and not self._active.any()
+                while (not self._q and self._pf is None
+                       and not self._active.any()
                        and not self._stop.is_set()):
                     self._cv.wait()
                 if (self._stop.is_set() and not self._q
-                        and not self._active.any()):
+                        and self._pf is None and not self._active.any()):
                     return
-                free = [s for s in range(self.config.slots)
-                        if not self._active[s]]
-                arrivals = [self._q.popleft()
-                            for _ in range(min(len(free), len(self._q)))]
+                free = collections.deque(self._free_slots())
+                if chunked:
+                    # one admission prefills at a time; the NEXT request
+                    # is only picked up once the current one goes live
+                    arrivals = ([self._q.popleft()]
+                                if self._pf is None and free and self._q
+                                else [])
+                else:
+                    arrivals = [self._q.popleft()
+                                for _ in range(min(len(free), len(self._q)))]
             try:
-                if arrivals:
-                    self._admit(arrivals, free)
+                if chunked:
+                    if arrivals:
+                        self._begin_prefill(arrivals[0], free.popleft())
+                    if self._pf is not None:
+                        # AT MOST one budget-sized chunk per iteration:
+                        # the stall an admission can add to every live
+                        # generation's next token is one chunk of work
+                        self._prefill_one_chunk()
+                else:
+                    if arrivals:
+                        self._admit(arrivals, free)
                 if self._active.any():
                     self._step()
             except Exception as exc:          # pragma: no cover - defensive
@@ -266,12 +349,13 @@ class DecodeEngine:
                 return
 
     def _maybe_refresh(self) -> None:
-        """Move the pinned snapshot only while NO generation is in flight —
-        an admission therefore pins one params version for its lifetime."""
+        """Move the pinned snapshot only while NO generation is in flight
+        (neither live slots nor a mid-prefill admission) — an admission
+        therefore pins one params version for its lifetime."""
         snap = self._snap
         if snap is None:
             snap = self._manager.current()
-        elif not self._active.any():
+        elif not self._active.any() and self._pf is None:
             snap = self._manager.ensure_fresh(self.config.max_staleness_s)
         if self._snap is not snap or self._pinned is None:
             # one replica copy per PIN (snapshot.replicate_for_decode:
@@ -283,7 +367,81 @@ class DecodeEngine:
                 self._pinned = replicate_for_decode(snap.value)
             self._snap = snap
 
-    def _admit(self, arrivals: List[_Request], free: List[int]) -> None:
+    def _begin_prefill(self, req: _Request, slot: int) -> None:
+        """Reserve ``slot`` and pin the snapshot for one admission; its
+        prompt then prefills one chunk per iteration."""
+        self._maybe_refresh()
+        req.version = self._snap.version
+        req.slot = slot
+        req.pf_off = 0
+        req.pf_chunks = 0
+        req.t_admit = time.monotonic()   # queue.wait ends here
+        self._pf = req
+
+    def _prefill_one_chunk(self) -> None:
+        """Run ONE budget-sized chunk of the in-flight admission's
+        prefill; on the final chunk the first token falls out and the
+        slot goes live (or resolves immediately on eos-at-first-token,
+        never occupying the slot)."""
+        req = self._pf
+        C = self._budget
+        off = req.pf_off
+        n = min(C, len(req.prompt) - off)
+        toks = np.zeros(C, np.int32)
+        toks[: n] = req.prompt[off: off + n]
+        tracing = trace.enabled()
+        t0 = time.monotonic() if tracing else 0.0
+        self._k_cache, self._v_cache, logits = self._chunk_fn(
+            self._pinned, self._k_cache, self._v_cache,
+            np.int32(req.slot), toks, np.int32(off), np.int32(n))
+        # block per chunk: letting chunk dispatches run ahead
+        # asynchronously looks free, but an idle->busy transition can
+        # queue several chunks on the device and the NEXT fused step's
+        # sync pays for all of them at once — exactly the unbounded ITL
+        # spike the budget exists to prevent (measured: p99 went from
+        # ~1 chunk+step to >100 ms under ramp). One chunk per iteration,
+        # retired per iteration, keeps the bound honest.
+        jax.block_until_ready(self._k_cache)
+        req.pf_off = off + n
+        req.pf_chunks += 1
+        self.prefill_tokens += n
+        self.prefill_tok_counter.inc(n)
+        final = req.pf_off >= len(req.prompt)
+        if tracing and req.ctx is not None:
+            trace.record_span(
+                "decode.prefill_chunk", req.ctx, t0, time.monotonic(),
+                slot=req.slot, offset=off, chunk=req.pf_chunks - 1,
+                tokens=n, budget=C)
+        if not final:
+            return
+        # final chunk: the prompt's last real position's logits are the
+        # first generated token (exactly the monolithic prefill's gather)
+        tok0 = int(np.argmax(np.asarray(logits)))
+        now = time.monotonic()
+        req.t_last = now
+        self.ttft_hist.record((now - req.t_enq) * 1e3)
+        self.tokens += 1
+        self.decode_tok_counter.inc()
+        req.out.append(tok0)
+        if tracing and req.ctx is not None:
+            trace.record_span("queue.wait", req.ctx, req.t_enq,
+                              req.t_admit, cause="admission")
+            trace.record_span(
+                "decode.admit", req.ctx, req.t_admit, now, slot=req.slot,
+                prompt_len=len(req.prompt), chunks=req.pf_chunks,
+                budget=C, snapshot_version=req.version)
+        self._pf = None
+        if self._finished(req, tok0):
+            # slot never goes live; the inserted K/V is dead weight a
+            # later admission overwrites (tested)
+            self._resolve(req)
+            return
+        self._slot_req[req.slot] = req
+        self._tok[req.slot] = tok0
+        self._pos[req.slot] = len(req.prompt)
+        self._active[req.slot] = True
+
+    def _admit(self, arrivals: List[_Request], free: Deque[int]) -> None:
         t_admit = time.monotonic()     # queue.wait ends / admission begins
         self._maybe_refresh()
         version = self._snap.version
@@ -304,7 +462,12 @@ class DecodeEngine:
             for i, req in enumerate(group):
                 toks[i, : len(req.prompt)] = req.prompt
                 lens[i] = len(req.prompt)
-                slots[i] = free.pop(0)
+                # popleft: the free pool arrives as a deque — list.pop(0)
+                # here was O(slots) per admission, O(slots^2) across a
+                # full admission wave on a large slot pool
+                slots[i] = free.popleft()
+                self.prefill_tokens += len(req.prompt)
+                self.prefill_tok_counter.inc(len(req.prompt))
             slots[len(group):] = slots[0]    # pad rows: overwritten by row 0
             first, self._k_cache, self._v_cache = self._admit_fn(
                 self._pinned, self._k_cache, self._v_cache,
@@ -323,6 +486,7 @@ class DecodeEngine:
                 req.t_last = now
                 self.ttft_hist.record((now - req.t_enq) * 1e3)
                 self.tokens += 1
+                self.decode_tok_counter.inc()
                 req.out.append(tok0)
                 if tracing and req.ctx is not None:
                     # the two child spans that explain a slow TTFT: how
@@ -373,6 +537,7 @@ class DecodeEngine:
             tok = int(nxt[s])
             req.out.append(tok)
             self.tokens += 1
+            self.decode_tok_counter.inc()
             self.itl_hist.record((now - req.t_last) * 1e3)
             req.t_last = now
             if tracing and req.ctx is not None:
@@ -418,6 +583,9 @@ class DecodeEngine:
             pending = list(self._q)
             self._q.clear()
         live = [r for r in self._slot_req if r is not None]
+        if self._pf is not None:      # mid-prefill admission dies too
+            live.append(self._pf)
+            self._pf = None
         self._active[:] = False
         self._slot_req = [None] * self.config.slots
         seen = set()
@@ -434,13 +602,23 @@ class DecodeEngine:
         whole point of fixed slots + active-lane masking)."""
         return _jit_cache_size(self._step_fn)
 
+    def prefill_cache_size(self) -> int:
+        """Compiled-trace count of the admission path: the single
+        fixed-shape chunk program when chunked, or the (batch bucket x
+        prompt bucket) fused prefill+insert set when monolithic."""
+        if self._budget > 0:
+            return _jit_cache_size(self._chunk_fn)
+        return _jit_cache_size(self._admit_fn)
+
     def warmup(self) -> None:
-        """Compile every (batch bucket, prompt bucket) admission trace and
-        the fused step before taking traffic, against scratch caches —
-        deadline-sensitive deployments call this BEFORE submitting so no
-        live request ever pays a compile. Pins the snapshot through the
-        serving path itself, so the warmup params copy (and placement,
-        hence the compiled traces) IS the one the first admission serves.
+        """Compile every admission trace (the ONE chunk program when
+        chunked, else every (batch bucket, prompt bucket) fused
+        prefill+insert) and the fused step before taking traffic,
+        against scratch caches — deadline-sensitive deployments call
+        this BEFORE submitting so no live request ever pays a compile.
+        Pins the snapshot through the serving path itself, so the warmup
+        params copy (and placement, hence the compiled traces) IS the
+        one the first admission serves.
         """
         self._maybe_refresh()
         params = self._pinned
@@ -452,13 +630,19 @@ class DecodeEngine:
             return (jax.device_put(jnp.zeros(shape, dtype), jax.devices()[0]),
                     jax.device_put(jnp.zeros(shape, dtype), jax.devices()[0]))
 
-        for pb in self._prompt_buckets:
-            for bb in self._batch_buckets:
-                kc, vc = scratch()
-                self._admit_fn(params, kc, vc,
-                               np.arange(bb, dtype=np.int32) % S,
-                               np.ones((bb, pb), np.int32),
-                               np.ones(bb, np.int32))
+        if self._budget > 0:
+            kc, vc = scratch()
+            self._chunk_fn(params, kc, vc, np.int32(0),
+                           np.ones(self._budget, np.int32), np.int32(0),
+                           np.int32(1))
+        else:
+            for pb in self._prompt_buckets:
+                for bb in self._batch_buckets:
+                    kc, vc = scratch()
+                    self._admit_fn(params, kc, vc,
+                                   np.arange(bb, dtype=np.int32) % S,
+                                   np.ones((bb, pb), np.int32),
+                                   np.ones(bb, np.int32))
         kc, vc = scratch()
         jax.block_until_ready(self._step_fn(
             params, kc, vc, np.zeros(S, np.int32), np.zeros(S, np.int32),
@@ -471,6 +655,7 @@ class DecodeEngine:
         self.completed = 0
         self.shed = 0
         self.tokens = 0
+        self.prefill_tokens = 0
         self.t_first = None
         self._occ_sum = 0.0
         self._occ_n = 0
@@ -497,6 +682,9 @@ class DecodeEngine:
             "queue_depth": self.queue_depth(),
             "snapshot_publishes": self._manager.publishes,
             "step_traces": self.step_cache_size(),
+            "prefill_traces": self.prefill_cache_size(),
+            "prefill_token_budget": self._budget,
+            "prefill_tokens": self.prefill_tokens,
         }
 
     # -- lifecycle ----------------------------------------------------------

@@ -109,7 +109,8 @@ class InferenceServer:
                          max_prompt: int = 64, max_new: int = 32,
                          eos_id: Optional[int] = None, max_queue: int = 256,
                          max_staleness_s: float = 0.05,
-                         prompt_buckets: Optional[tuple] = None
+                         prompt_buckets: Optional[tuple] = None,
+                         prefill_token_budget: Optional[int] = None
                          ) -> DecodeEngine:
         """Attach a continuous-batching decode engine under ``name``.
 
@@ -119,11 +120,15 @@ class InferenceServer:
         ever waits for a co-batched stranger's generation to finish).
         Payloads are 1-D prompt id arrays, or ``{"prompt": ...,
         "max_new": n}`` for a per-request generation cap.
+        ``prefill_token_budget`` bounds the prefill work any single
+        iteration interleaves with decode (chunked admission; None =
+        the ``-prefill_token_budget`` flag, 0 = monolithic).
         """
         cfg = DecodeEngineConfig(
             slots=slots, max_prompt=max_prompt, max_new=max_new,
             eos_id=eos_id, max_queue=max_queue,
-            max_staleness_s=max_staleness_s, prompt_buckets=prompt_buckets)
+            max_staleness_s=max_staleness_s, prompt_buckets=prompt_buckets,
+            prefill_token_budget=prefill_token_budget)
         with self._lock:
             if name in self._models:
                 Log.fatal(f"serving: model {name!r} already registered")

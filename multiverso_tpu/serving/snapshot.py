@@ -52,13 +52,20 @@ class DerivedCache:
     def __init__(self, fn: Callable[[Any], Any]) -> None:
         self._fn = fn
         self._cached: Tuple[int, Any] = (-1, None)
+        # the check-then-compute must be atomic: two readers racing a
+        # publish could BOTH miss and recompute fn(snap.value) — a
+        # doubled derived-artifact cost (replica copy, normalized
+        # matrix) exactly at the publish spike. Serializing get() is
+        # the point: one thread computes, the rest wait and reuse.
+        self._lock = threading.Lock()
 
     def get(self, snap: Snapshot) -> Any:
-        ver, value = self._cached
-        if ver != snap.version:
-            value = self._fn(snap.value)
-            self._cached = (snap.version, value)
-        return value
+        with self._lock:
+            ver, value = self._cached
+            if ver != snap.version:
+                value = self._fn(snap.value)
+                self._cached = (snap.version, value)
+            return value
 
 
 def replicate_for_decode(value: Any) -> Any:

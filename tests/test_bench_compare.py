@@ -1,0 +1,115 @@
+"""tools/bench_compare.py: the standing serving-perf regression gate.
+
+Pure-host unit tests (no jax, no session): direction rules, relative
+thresholds, per-metric overrides, the min-ms latency-noise floor, and
+the CLI's exit-code contract over real files.
+"""
+
+import json
+
+from tools.bench_compare import (compare, flatten_workloads, main,
+                                 metric_direction)
+
+
+def _line(**workloads):
+    return {"bench": "serving", "workloads": workloads,
+            "dashboard": {"SERVE_LAT[x]": {"p50_ms": 1.0}}}
+
+
+BASE = _line(
+    w2v={"qps": 1000.0, "p50_ms": 4.0, "p99_ms": 20.0, "shed_rate": 0.01,
+         "completed": 500, "speedup_batched": 5.0},
+    lm_chunked_prefill={"itl_p99_speedup": 3.0, "tokens_per_s_ratio": 1.0,
+                        "chunked": {"itl_p99_ms": 10.0,
+                                    "tokens_per_s": 400.0}},
+)
+
+
+def test_metric_direction_rules():
+    assert metric_direction("qps") == 1
+    assert metric_direction("tokens_per_s") == 1
+    assert metric_direction("speedup_engine") == 1
+    assert metric_direction("itl_p99_speedup") == 1
+    assert metric_direction("tokens_per_s_ratio") == 1
+    assert metric_direction("p99_ms") == -1
+    assert metric_direction("shed_rate") == -1
+    assert metric_direction("completed") == 0       # informational
+    assert metric_direction("jit_traces") == 0
+    assert metric_direction("step_traces") == 0
+
+
+def test_flatten_skips_dashboard_archive():
+    flat = flatten_workloads(BASE)
+    assert "w2v.qps" in flat
+    assert "lm_chunked_prefill.chunked.itl_p99_ms" in flat
+    assert not any(k.startswith("SERVE_LAT") for k in flat)
+
+
+def test_no_regression_within_tolerance():
+    new = json.loads(json.dumps(BASE))
+    new["workloads"]["w2v"]["qps"] = 900.0           # -10% < 25% tol
+    new["workloads"]["w2v"]["p99_ms"] = 23.0         # +15% < 25% tol
+    regressions, rows = compare(BASE, new)
+    assert regressions == []
+    assert any(r["metric"] == "w2v.qps" for r in rows)
+
+
+def test_detects_throughput_and_latency_regressions():
+    new = json.loads(json.dumps(BASE))
+    new["workloads"]["w2v"]["qps"] = 500.0                      # -50%
+    new["workloads"]["lm_chunked_prefill"]["chunked"]["itl_p99_ms"] = 40.0
+    regressions, _ = compare(BASE, new)
+    names = {r["metric"] for r in regressions}
+    assert names == {"w2v.qps", "lm_chunked_prefill.chunked.itl_p99_ms"}
+    # worst first
+    assert regressions[0]["worse_frac"] >= regressions[-1]["worse_frac"]
+
+
+def test_per_metric_override_and_min_ms_floor():
+    new = json.loads(json.dumps(BASE))
+    new["workloads"]["w2v"]["p50_ms"] = 5.2          # +30%
+    # default tolerance flags it, a 50% override clears it
+    assert any(r["metric"] == "w2v.p50_ms" for r in compare(BASE, new)[0])
+    assert compare(BASE, new, overrides={"p50_ms": 0.5})[0] == []
+    # most-specific override wins: a tight full-path gate beats a loose
+    # leaf gate for that metric (and only that metric)
+    tight = compare(BASE, new,
+                    overrides={"p50_ms": 0.5, "w2v.p50_ms": 0.1})[0]
+    assert [r["metric"] for r in tight] == ["w2v.p50_ms"]
+    # sub-min-ms latencies never gate (scheduler noise)
+    tiny_base = _line(w2v={"p50_ms": 0.2})
+    tiny_new = _line(w2v={"p50_ms": 0.9})            # +350% but < 1 ms
+    assert compare(tiny_base, tiny_new)[0] == []
+
+
+def test_zero_baseline_lower_is_better_still_gates():
+    """A healthy baseline sheds nothing (shed_rate 0.0); a candidate that
+    starts shedding must NOT slip through the relative-threshold math —
+    the new value stands in for the worseness when the base is zero."""
+    base = _line(w2v={"shed_rate": 0.0, "qps": 0.0})
+    bad = _line(w2v={"shed_rate": 0.4, "qps": 100.0})
+    regressions, _ = compare(base, bad)
+    assert [r["metric"] for r in regressions] == ["w2v.shed_rate"]
+    # a sub-tolerance shed rate still passes; a zero->zero is clean; and
+    # the zero-qps baseline (broken base run) never gates
+    ok = _line(w2v={"shed_rate": 0.1, "qps": 100.0})
+    assert compare(base, ok)[0] == []
+    assert compare(base, base)[0] == []
+
+
+def test_cli_exit_codes(tmp_path):
+    base_f = tmp_path / "base.json"
+    new_f = tmp_path / "new.json"
+    base_f.write_text(json.dumps(BASE) + "\n")
+
+    new = json.loads(json.dumps(BASE))
+    new["workloads"]["w2v"]["qps"] = 500.0
+    new_f.write_text("some log line\n" + json.dumps(new) + "\n")
+
+    assert main([str(base_f), str(base_f)]) == 0             # self-diff
+    assert main([str(base_f), str(new_f)]) == 1              # regression
+    assert main([str(base_f), str(new_f),
+                 "--metric", "qps=0.6"]) == 0                # overridden
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json at all\n")
+    assert main([str(base_f), str(bad)]) == 2                # malformed

@@ -234,6 +234,151 @@ def test_engine_validates_payloads(mv_session):
         srv.submit("lm", {"max_new": 2})                # no prompt key
 
 
+def test_chunked_admission_matches_oracle_across_boundaries(mv_session):
+    """Chunked-prefill oracle: randomized prompts whose lengths straddle
+    every chunk boundary (B-1, B, B+1, 2B, 2B+1, max_prompt) produce
+    output tokens identical to the whole-prompt ``greedy_decode`` oracle
+    — the admission schedule is invisible in the results — with exactly
+    ONE compiled chunk trace and ONE fused-step trace."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    B = 4
+    engine = srv.register_decoder("lm", lm, slots=3, max_prompt=11,
+                                  max_new=8, prefill_token_budget=B)
+    engine.warmup()
+    params, _ = lm.snapshot_params()
+
+    rng = np.random.default_rng(2)
+    lens = [1, B - 1, B, B + 1, 2 * B, 2 * B + 1, 11, 11]
+    lens += [int(rng.integers(1, 12)) for _ in range(8)]
+    futs, reqs = [], []
+    for plen in lens:
+        prompt = rng.integers(1, cfg.vocab_size, plen).astype(np.int32)
+        max_new = int(rng.integers(1, 9))
+        reqs.append((prompt, max_new))
+        futs.append(srv.submit("lm", {"prompt": prompt, "max_new": max_new}))
+    for (prompt, max_new), fut in zip(reqs, futs):
+        reply = fut.result(timeout=120)
+        np.testing.assert_array_equal(
+            reply["result"], _oracle(cfg, params, prompt, max_new),
+            err_msg=f"prompt len {len(prompt)} max_new {max_new} "
+                    f"budget {B}")
+    assert engine.step_cache_size() == 1, "fused step retraced"
+    assert engine.prefill_cache_size() == 1, \
+        "chunk program retraced (slot/offset/length must all be traced)"
+    stats = engine.stats()
+    assert stats["prefill_token_budget"] == B
+    assert stats["prefill_tokens"] == sum(len(p) for p, _ in reqs)
+    assert stats["tokens"] == sum(n for _, n in reqs)
+
+
+def test_chunk_pad_tail_past_cache_end_is_dropped(mv_session):
+    """Regression: a final chunk whose PAD tail extends past the cache
+    (ceil(max_prompt/budget)*budget > max_prompt + max_new) must not
+    corrupt prompt K/V — the scatter write drops out-of-bounds pad
+    positions instead of clamping a full-chunk window back over real
+    ones (a dynamic-update-slice here returned silently wrong tokens:
+    max_prompt=10, max_new=1, budget=4, 9-token prompt)."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    engine = srv.register_decoder("lm", lm, slots=2, max_prompt=10,
+                                  max_new=1, prefill_token_budget=4)
+    engine.warmup()
+    params, _ = lm.snapshot_params()
+    rng = np.random.default_rng(6)
+    # lengths whose last chunk's 4-wide pad tail crosses T = 11
+    for plen in (9, 10):
+        prompt = rng.integers(1, cfg.vocab_size, plen).astype(np.int32)
+        reply = srv.submit("lm", {"prompt": prompt, "max_new": 1}).result(
+            timeout=120)
+        np.testing.assert_array_equal(
+            reply["result"], _oracle(cfg, params, prompt, 1),
+            err_msg=f"prompt len {plen}: pad tail past cache end corrupted "
+                    "prompt K/V")
+
+
+def test_chunked_vs_monolithic_identical_outputs(mv_session):
+    """Fast A/B smoke (the tier-1 face of the slow serving_bench A/B):
+    the SAME request set through a chunked engine and a monolithic
+    (budget=0) engine on one model returns identical tokens, and each
+    side's admission-trace accounting holds."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    engines = {
+        b: srv.register_decoder(f"lm{b}", lm, slots=2, max_prompt=8,
+                                max_new=6, prompt_buckets=(8,),
+                                prefill_token_budget=b)
+        for b in (3, 0)
+    }
+    for e in engines.values():
+        e.warmup()
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, cfg.vocab_size,
+                            int(rng.integers(1, 9))).astype(np.int32)
+               for _ in range(8)]
+    outs = {}
+    for b in engines:
+        futs = [srv.submit(f"lm{b}", p) for p in prompts]
+        outs[b] = [f.result(timeout=120)["result"] for f in futs]
+    for chunked, mono in zip(outs[3], outs[0]):
+        np.testing.assert_array_equal(chunked, mono)
+    assert engines[3].prefill_cache_size() == 1
+    assert engines[3].step_cache_size() == 1
+    # one budget=3 chunk program serves 1..8-token prompts: 1-3 chunks
+    assert engines[3].stats()["prefill_tokens"] == sum(map(len, prompts))
+    assert engines[0].stats()["prefill_token_budget"] == 0
+
+
+@pytest.mark.parametrize("budget", [3, 0])
+def test_eos_at_first_token_slot_never_goes_live(mv_session, budget):
+    """A prompt whose FIRST generated token is eos resolves straight out
+    of admission: the reserved slot never goes live, and the dead K/V it
+    left behind is overwritten by later admissions through the same slot
+    (slots=1 forces the reuse) — their outputs still match the oracle."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    params, _ = lm.snapshot_params()
+    rng = np.random.default_rng(3)
+    probe = rng.integers(1, cfg.vocab_size, 5).astype(np.int32)
+    eos = int(_oracle(cfg, params, probe, 1)[0])
+
+    srv = InferenceServer("t")
+    engine = srv.register_decoder("lm", lm, slots=1, max_prompt=8,
+                                  max_new=10, eos_id=eos,
+                                  prefill_token_budget=budget)
+    engine.warmup()
+    out = srv.submit("lm", probe).result(timeout=120)["result"]
+    np.testing.assert_array_equal(out, [eos])
+    stats = engine.stats()
+    assert stats["active_slots"] == 0
+    assert stats["completed"] == 1
+    assert stats["tokens"] == 1
+    assert engine.stats()["queue_depth"] == 0
+    for _ in range(4):
+        prompt = rng.integers(1, cfg.vocab_size,
+                              int(rng.integers(1, 9))).astype(np.int32)
+        reply = srv.submit("lm", prompt).result(timeout=120)
+        np.testing.assert_array_equal(
+            reply["result"], _oracle(cfg, params, prompt, 10, eos),
+            err_msg=f"budget {budget} prompt {prompt}")
+    assert engine.stats()["active_slots"] == 0
+
+
 def test_gauge_registry():
     from multiverso_tpu.dashboard import Dashboard, Gauge
 

@@ -309,11 +309,18 @@ def test_traced_decode_request_end_to_end(mv_session, traced, tmp_path):
         assert "queue.wait" in names
         admits = [s for s in tree if s.name == "decode.admit"]
         assert len(admits) == 1
-        # admission explains itself: slot, buckets, and the pinned
+        # admission explains itself: slot, its schedule (chunk count +
+        # budget for the default chunked admission) and the pinned
         # snapshot version — which must match the reply's
         a = admits[0].attrs
-        assert {"slot", "prompt_bucket", "batch_bucket",
+        assert {"slot", "chunks", "budget",
                 "snapshot_version", "prompt_len"} <= set(a)
+        # every chunk of the admission is its own span under the same
+        # trace, and their count is what the admit span claims
+        chunks = [s for s in tree if s.name == "decode.prefill_chunk"]
+        assert len(chunks) == a["chunks"] >= 1
+        assert all(s.parent_id == root.span_id for s in chunks)
+        assert all(s.attrs["budget"] == a["budget"] for s in chunks)
         iters = [s for s in tree if s.name == "decode.iter"]
         assert len(iters) >= 1                    # max_new=4 -> 3 iters
         assert all(s.parent_id == root.span_id for s in iters)

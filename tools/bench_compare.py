@@ -1,0 +1,163 @@
+"""Diff two serving_bench JSON lines -> regression verdict (exit code).
+
+The standing perf gate for serving PRs: run ``tools/serving_bench.py``
+on the base and on the candidate, feed both JSON lines here, and the
+exit code says whether any tracked metric regressed past its threshold
+— no eyeballing twenty numbers per round.
+
+Direction is metric-aware: throughput-like metrics (``qps``,
+``tokens_per_s``, ``speedup_*``) regress DOWN, latency/overload-like
+metrics (``*_ms``, ``shed_rate``) regress UP. Everything else
+(``completed``, ``jit_traces``, trace counts, ...) is informational
+and never gates. Thresholds are relative: a metric regresses when it
+is more than ``--tolerance`` (default 25%, sized for CI-container
+noise) worse than the baseline; ``--metric NAME=TOL`` overrides the
+tolerance for one metric name (applies wherever that name appears),
+and tiny latencies below ``--min-ms`` are ignored (sub-millisecond
+percentiles are scheduler noise, not signal).
+
+Usage::
+
+    python tools/serving_bench.py > base.json     # on main
+    python tools/serving_bench.py > new.json      # on the candidate
+    python tools/bench_compare.py base.json new.json [--tolerance 0.25]
+        [--metric itl_p99_ms=0.5] [--min-ms 1.0]
+
+Exit status: 0 = no regression, 1 = at least one metric regressed,
+2 = inputs malformed/incomparable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Tuple
+
+# metric-name suffix/prefix rules deciding gating direction
+_HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio")
+_LOWER_BETTER = ("_ms", "shed_rate")
+
+
+def metric_direction(name: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 informational."""
+    for pat in _HIGHER_BETTER:
+        if name == pat or name.startswith(pat) or name.endswith(pat):
+            return 1
+    for pat in _LOWER_BETTER:
+        if name.endswith(pat) or name == pat:
+            return -1
+    return 0
+
+
+def _flatten(prefix: str, node, out: Dict[str, float]) -> None:
+    if isinstance(node, dict):
+        for k, v in node.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+    elif isinstance(node, (int, float)) and not isinstance(node, bool):
+        out[prefix] = float(node)
+
+
+def flatten_workloads(line: dict) -> Dict[str, float]:
+    """Dotted metric paths under ``workloads`` (the gated surface; the
+    archived ``dashboard`` snapshot is diagnostic, not a gate)."""
+    out: Dict[str, float] = {}
+    _flatten("", line.get("workloads", {}), out)
+    return out
+
+
+def compare(base: dict, new: dict, tolerance: float = 0.25,
+            overrides: Dict[str, float] = {}, min_ms: float = 1.0
+            ) -> Tuple[List[dict], List[dict]]:
+    """Return ``(regressions, rows)``: every compared metric as a row,
+    the over-threshold subset as regressions (worst first)."""
+    b, n = flatten_workloads(base), flatten_workloads(new)
+    rows: List[dict] = []
+    regressions: List[dict] = []
+    for path in sorted(set(b) & set(n)):
+        leaf = path.rsplit(".", 1)[-1]
+        sign = metric_direction(leaf)
+        if sign == 0:
+            continue
+        bv, nv = b[path], n[path]
+        if sign == -1 and max(bv, nv) < min_ms and leaf.endswith("_ms"):
+            continue                      # sub-threshold latency noise
+        if bv == 0.0 and sign == 1:
+            continue                      # broken baseline: nothing to gate
+        # worseness > 0 means NEW is worse, as a fraction of base. A
+        # ZERO baseline on a lower-is-better metric (shed_rate 0.0 on a
+        # healthy run) must still gate — skipping it would wave through
+        # a candidate that starts shedding — so the new value itself
+        # stands in as the worseness (0.4 shed_rate > 0.25 tol -> gate;
+        # a zero-base *_ms metric past the min-ms floor gates likewise)
+        if bv == 0.0:
+            worse = nv
+        else:
+            worse = (bv - nv) / bv if sign == 1 else (nv - bv) / bv
+        # most-specific override wins: full dotted path before leaf name
+        tol = overrides.get(path, overrides.get(leaf, tolerance))
+        row = {"metric": path, "base": bv, "new": nv,
+               "worse_frac": round(worse, 4), "tolerance": tol,
+               "direction": "up" if sign == 1 else "down",
+               "regressed": worse > tol}
+        rows.append(row)
+        if row["regressed"]:
+            regressions.append(row)
+    regressions.sort(key=lambda r: r["worse_frac"], reverse=True)
+    return regressions, rows
+
+
+def _load_line(path: str) -> dict:
+    """First JSON object found in the file (serving_bench prints ONE
+    line, but logs may precede it when stderr was merged)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+    raise ValueError(f"{path}: no JSON object line found")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two serving_bench JSON lines; exit 1 on regression")
+    ap.add_argument("base", help="baseline serving_bench JSON line file")
+    ap.add_argument("new", help="candidate serving_bench JSON line file")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="relative worseness gate (default 0.25)")
+    ap.add_argument("--metric", action="append", default=[],
+                    metavar="NAME=TOL",
+                    help="per-metric tolerance override (leaf name or "
+                         "full dotted path; repeatable)")
+    ap.add_argument("--min-ms", type=float, default=1.0,
+                    help="ignore latency metrics where both sides are "
+                         "below this (default 1.0 ms)")
+    args = ap.parse_args(argv)
+    overrides: Dict[str, float] = {}
+    for spec in args.metric:
+        name, _, tol = spec.partition("=")
+        if not tol:
+            ap.error(f"--metric needs NAME=TOL, got {spec!r}")
+        overrides[name] = float(tol)
+    try:
+        base, new = _load_line(args.base), _load_line(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"bench_compare: {exc}", file=sys.stderr)
+        return 2
+    regressions, rows = compare(base, new, args.tolerance, overrides,
+                                args.min_ms)
+    if not rows:
+        print("bench_compare: no comparable metrics", file=sys.stderr)
+        return 2
+    print(f"{len(rows)} metrics compared, {len(regressions)} regressed "
+          f"(tolerance {args.tolerance:.0%})")
+    print(f"{'metric':<52} {'base':>10} {'new':>10} {'worse':>8}")
+    for r in rows:
+        flag = " <-- REGRESSED" if r["regressed"] else ""
+        print(f"{r['metric']:<52} {r['base']:>10.3f} {r['new']:>10.3f} "
+              f"{r['worse_frac']:>+7.1%}{flag}")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,10 +114,13 @@ def load_host_spans(path: str):
     return spans
 
 
-# child span names folded into per-request report columns
+# child span names folded into per-request report columns. NB
+# decode.prefill_chunk spans lie INSIDE their decode.admit window —
+# prefill_ms is the dispatch-side slice of admit_ms, not extra time
 _STAGE_COLUMNS = (
     ("queue_ms", ("queue.wait",)),
     ("admit_ms", ("decode.admit",)),
+    ("prefill_ms", ("decode.prefill_chunk",)),
     ("exec_ms", ("batch.exec",)),
     ("decode_ms", ("decode.iter",)),
 )
@@ -179,14 +182,15 @@ def print_request_report(rows, top: int, sort: str) -> None:
     rows = sorted(rows, key=lambda r: r.get(key, 0.0), reverse=True)
     has_dev = any("device_ms" in r for r in rows)
     print(f"{len(rows)} request(s); slowest by {key}:")
-    hdr = (f"{'total':>9} {'queue':>8} {'admit':>8} {'exec':>8} "
-           f"{'decode':>8} {'iters':>6}")
+    hdr = (f"{'total':>9} {'queue':>8} {'admit':>8} {'prefill':>8} "
+           f"{'exec':>8} {'decode':>8} {'iters':>6}")
     if has_dev:
         hdr += f" {'device':>9}"
     print(hdr + "  trace_id [model]")
     for r in rows[:top]:
         line = (f"{r['total_ms']:9.3f} {r['queue_ms']:8.3f} "
-                f"{r['admit_ms']:8.3f} {r['exec_ms']:8.3f} "
+                f"{r['admit_ms']:8.3f} {r.get('prefill_ms', 0.0):8.3f} "
+                f"{r['exec_ms']:8.3f} "
                 f"{r['decode_ms']:8.3f} {r['iters']:6d}")
         if has_dev:
             line += f" {r.get('device_ms', 0.0):9.3f}"
