@@ -223,6 +223,17 @@ define_int("prefill_token_budget", 32,
            "(Sarathi-style stall-free admission — inter-token latency is "
            "bounded by one budget-sized chunk regardless of arriving "
            "prompt length); 0 = monolithic whole-prompt admission")
+define_int("kv_block_size", 16,
+           "decode engine: paged KV cache block size in token positions "
+           "(vLLM-style block pool — per-slot block tables ride the jitted "
+           "step as traced data, so capacity, not slot geometry, bounds "
+           "concurrency); 0 = contiguous per-slot strips")
+define_int("kv_pool_blocks", 0,
+           "decode engine: usable KV pool blocks (+1 scratch block is "
+           "added); 0 = auto-size to the contiguous-equivalent capacity "
+           "slots * ceil((max_prompt + max_new) / kv_block_size). "
+           "serving.block_pool.blocks_for_bytes converts a device-bytes "
+           "budget into this count")
 define_string("log_file", "", "optional log sink file")
 define_string("log_level", "info", "debug|info|error|fatal")
 define_bool("trace", False,

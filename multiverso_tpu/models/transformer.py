@@ -434,6 +434,178 @@ def prefill_chunk(cfg: TransformerConfig, params: Dict[str, Any],
     return k_cache, v_cache, logits
 
 
+# -- serving: paged KV cache --------------------------------------------------
+#
+# The slotted [L, S, T, D] cache above gives every slot a contiguous strip
+# sized for the worst case T = max_prompt + max_new; a short sequence wastes
+# almost its whole strip. The paged layout (vLLM/PagedAttention) replaces the
+# strips with ONE block pool [L, n_blocks, block_size, D] plus a per-slot
+# BLOCK TABLE [S, max_blocks_per_seq] of int32 block ids: logical cache
+# position p of slot s lives at physical (block_tables[s, p // Bs], p % Bs).
+# Block tables are TRACED DATA (fixed [S, M] shape), so the one-compiled-
+# trace-per-engine-config invariant survives paging: reads become gathers
+# through the table, writes become (block, offset) scatters, and which blocks
+# a slot owns never touches a shape.
+#
+# Conventions shared by the three paged entry points below (and by
+# serving/block_pool.py, which owns the host-side allocator):
+#
+# * block id 0 is the SCRATCH block: the block-table pad sentinel, the
+#   parking target for dead-lane decode writes, and where pad-position
+#   prefill garbage lands. Nothing a live attention mask can reach ever
+#   maps there — a slot's reservation covers prompt + max_new positions, so
+#   every position <= pos resolves to a real allocated block.
+# * gathered per-slot views are SLICED to the engine's logical cache length
+#   ``t_logical`` (= max_prompt + max_new) before attention, so the paged
+#   attention operand has the exact shape (and therefore the exact reduction
+#   order, hence bit-exact outputs) of the contiguous cache it replaces —
+#   the gather's tail positions past a slot's allocation hold scratch
+#   garbage, masked off exactly like the contiguous strips' dead writes.
+
+
+def decode_step_paged(cfg: TransformerConfig, params: Dict[str, Any],
+                      k_pool: jax.Array, v_pool: jax.Array,
+                      block_tables: jax.Array, tok: jax.Array,
+                      pos: jax.Array, active: jax.Array,
+                      t_logical: Optional[int] = None
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One fused token step over S slots against the paged KV pool.
+
+    ``k_pool``/``v_pool`` [L, N, Bs, D] (block 0 = scratch),
+    ``block_tables`` [S, M] int32 (traced — one compiled trace per
+    engine config regardless of block assignment), ``tok``/``pos``/
+    ``active`` as in :func:`decode_step`. Each live slot writes its
+    token's K/V at ``(block_tables[s, pos // Bs], pos % Bs)`` and
+    attends its gathered view sliced to ``t_logical``; dead lanes park
+    their writes in the scratch block (the paged analogue of the
+    contiguous path's ``T - 1`` parking — scratch is never reachable by
+    a live mask, so a mid-flight chunked prefill's prompt region cannot
+    be clobbered).
+
+    Returns ``(k_pool, v_pool, next_tok [S], pos [S])``.
+    """
+    S = tok.shape[0]
+    Bs = k_pool.shape[2]
+    M = block_tables.shape[1]
+    T = M * Bs if t_logical is None else int(t_logical)
+    blk = jnp.take_along_axis(block_tables, (pos // Bs)[:, None],
+                              axis=1)[:, 0]
+    write_blk = jnp.where(active, blk, 0)      # dead lanes -> scratch
+    write_off = jnp.where(active, pos % Bs, 0)
+    h = (jnp.take(params["embed"], tok, axis=0)
+         + jnp.take(params["pos"], pos, axis=0))
+    for i in range(cfg.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["layers"])
+        x = _rmsnorm(h, layer["ln1_g"])
+        q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
+        k_pool = k_pool.at[i, write_blk, write_off].set(k)
+        v_pool = v_pool.at[i, write_blk, write_off].set(v)
+        # gather each slot's blocks into a contiguous [S, T, D] view —
+        # the same operand shape as the contiguous cache, so the
+        # attention math (and its reduction order) is unchanged
+        kv_shape = (S, M * Bs, -1)
+        kc = jnp.take(k_pool[i], block_tables, axis=0).reshape(kv_shape)
+        vc = jnp.take(v_pool[i], block_tables, axis=0).reshape(kv_shape)
+        h = h + _cached_attention(
+            q, kc[:, :T], vc[:, :T], cfg.n_heads, pos) @ layer["w_o"]
+        x = _rmsnorm(h, layer["ln2_g"])
+        h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+    h = _rmsnorm(h, params["ln_f_g"])
+    out = jnp.einsum("sd,vd->sv", h, params["embed"],
+                     preferred_element_type=jnp.float32)
+    nxt = jnp.argmax(out, axis=-1).astype(tok.dtype)
+    nxt = jnp.where(active, nxt, jnp.zeros_like(nxt))
+    pos = jnp.where(active, pos + 1, pos)
+    return k_pool, v_pool, nxt, pos
+
+
+def prefill_chunk_paged(cfg: TransformerConfig, params: Dict[str, Any],
+                        k_pool: jax.Array, v_pool: jax.Array,
+                        block_tables: jax.Array, slot: jax.Array,
+                        tokens: jax.Array, offset: jax.Array,
+                        length: jax.Array, t_logical: Optional[int] = None
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Incremental prefill of one fixed-size chunk into the paged pool.
+
+    The paged :func:`prefill_chunk`: same contract (``slot``/``offset``/
+    ``length`` all traced, ONE compiled trace per chunk size), but K/V
+    writes scatter to ``(block_tables[slot, p // Bs], p % Bs)`` and the
+    chunk attends the slot's gathered view. Pad positions (``i >=
+    length``) route to the scratch block explicitly — the paged
+    analogue of the contiguous scatter's drop-past-``T`` contract: a
+    final chunk's pad tail must not clamp onto real prompt blocks, and
+    with the table gather it would (the table row gather clamps), so
+    the pad lanes are masked to scratch before the scatter instead.
+    In-bounds pad garbage (real positions past ``length`` inside the
+    reservation) lands in allocated blocks that decode overwrites
+    before its mask reaches them, exactly as in the contiguous layout.
+
+    Returns ``(k_pool, v_pool, last_logits [V])``.
+    """
+    C = tokens.shape[0]
+    Bs = k_pool.shape[2]
+    M = block_tables.shape[1]
+    T = M * Bs if t_logical is None else int(t_logical)
+    bt_row = jax.lax.dynamic_index_in_dim(block_tables, slot, 0,
+                                          keepdims=False)        # [M]
+    pos_ix = offset + jnp.arange(C)
+    valid = jnp.arange(C) < length
+    blk = jnp.where(
+        valid, jnp.take(bt_row, jnp.clip(pos_ix // Bs, 0, M - 1)), 0)
+    off = jnp.where(valid, pos_ix % Bs, 0)
+    h = (jnp.take(params["embed"], tokens, axis=0)
+         + jnp.take(params["pos"], pos_ix, axis=0))
+    for i in range(cfg.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["layers"])
+        x = _rmsnorm(h, layer["ln1_g"])
+        q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
+        k_pool = k_pool.at[i, blk, off].set(k)
+        v_pool = v_pool.at[i, blk, off].set(v)
+        kc = jnp.take(k_pool[i], bt_row, axis=0).reshape(M * Bs, -1)
+        vc = jnp.take(v_pool[i], bt_row, axis=0).reshape(M * Bs, -1)
+        h = h + _chunk_attention(
+            q, kc[:T], vc[:T], cfg.n_heads, offset) @ layer["w_o"]
+        x = _rmsnorm(h, layer["ln2_g"])
+        h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+    h = _rmsnorm(h, params["ln_f_g"])
+    last = jnp.take(h, length - 1, axis=0)
+    logits = jnp.einsum("d,vd->v", last, params["embed"],
+                        preferred_element_type=jnp.float32)
+    return k_pool, v_pool, logits
+
+
+def cache_insert_paged(k_pool: jax.Array, v_pool: jax.Array,
+                       block_tables: jax.Array, ks: jax.Array, vs: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Write b prefilled sequences' K/V [L, b, P, D] through block tables.
+
+    The paged :func:`cache_insert`: ``block_tables`` [b, M] carries one
+    PER-ROW table (traced), so placement is encoded in data, not in a
+    DUS chain — row ``r``'s position ``p`` scatters to
+    ``(block_tables[r, p // Bs], p % Bs)``. A caller padding a partial
+    batch points the pad rows' tables entirely at the scratch sentinel
+    (block 0): their writes land in scratch, where the order-undefined
+    duplicate-index scatter is harmless because nothing reads it (the
+    contiguous path needed the row-0-last DUS ordering for exactly this;
+    the paged path needs only the sentinel). Positions past a row's
+    true prompt length write garbage into its reservation (overwritten
+    by decode before the mask reaches them — the :func:`prefill`
+    contract) or, past the reservation, into scratch via the table's
+    sentinel padding.
+    """
+    L, b, P, _ = ks.shape
+    Bs = k_pool.shape[2]
+    M = block_tables.shape[1]
+    p_ix = jnp.arange(P)
+    blk = jnp.take(block_tables, jnp.clip(p_ix // Bs, 0, M - 1),
+                   axis=1)                                       # [b, P]
+    off = jnp.broadcast_to(p_ix % Bs, (b, P))
+    for i in range(L):
+        k_pool = k_pool.at[i, blk, off].set(ks[i])
+        v_pool = v_pool.at[i, blk, off].set(vs[i])
+    return k_pool, v_pool
+
+
 def cache_insert(k_cache: jax.Array, v_cache: jax.Array, slots: jax.Array,
                  ks: jax.Array, vs: jax.Array
                  ) -> Tuple[jax.Array, jax.Array]:

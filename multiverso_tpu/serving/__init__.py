@@ -13,16 +13,19 @@ The inference half of the train/serve stack (docs/SERVING.md). Pieces:
   :class:`EmbeddingNeighbors` (word2vec lookup + top-k),
   :class:`LogRegPredict` / :class:`FTRLPredict`, and
   :class:`LMGreedyDecode` (KV-cache greedy decode).
-* :class:`DecodeEngine` — continuous-batching LM decode: persistent
-  slotted KV cache, ONE fused jitted step per iteration,
-  iteration-granular admission/completion
-  (``InferenceServer.register_decoder``), chunked prefill under a
-  per-iteration token budget (``prefill_token_budget``) so admissions
-  never stall in-flight generations for more than one chunk of work.
+* :class:`DecodeEngine` — continuous-batching LM decode: paged KV
+  cache (:class:`BlockPool` block allocator + per-slot block tables
+  traced as data; capacity, not slot geometry, bounds concurrency),
+  ONE fused jitted step per iteration, iteration-granular
+  admission/completion (``InferenceServer.register_decoder``), chunked
+  prefill under a per-iteration token budget
+  (``prefill_token_budget``) so admissions never stall in-flight
+  generations for more than one chunk of work.
 """
 
 from .batcher import (BatcherConfig, MicroBatcher, OverloadedError,
                       bucket_for, shape_buckets)
+from .block_pool import BlockPool, blocks_for_bytes, kv_bytes_per_block
 from .decode_engine import DecodeEngine, DecodeEngineConfig
 from .server import InferenceServer
 from .snapshot import Snapshot, SnapshotManager
@@ -33,5 +36,6 @@ __all__ = [
     "BatcherConfig", "MicroBatcher", "OverloadedError", "bucket_for",
     "shape_buckets", "InferenceServer", "Snapshot", "SnapshotManager",
     "EmbeddingNeighbors", "FTRLPredict", "LMGreedyDecode", "LogRegPredict",
-    "DecodeEngine", "DecodeEngineConfig",
+    "DecodeEngine", "DecodeEngineConfig", "BlockPool", "blocks_for_bytes",
+    "kv_bytes_per_block",
 ]

@@ -41,15 +41,22 @@ from ..log import Log
 
 
 class OverloadedError(RuntimeError):
-    """Typed fast-reject: the model's queue is at its depth cap."""
+    """Typed fast-reject: the model is out of a bounded resource.
 
-    def __init__(self, model: str, depth: int, cap: int) -> None:
+    ``what`` names the resource — the queue-depth cap here, or the
+    decode engine's KV block pool when a request's ``prompt + max_new``
+    could never fit it (``depth``/``cap`` then carry blocks needed vs
+    pool capacity)."""
+
+    def __init__(self, model: str, depth: int, cap: int,
+                 what: str = "queue depth") -> None:
         super().__init__(
-            f"serving queue for {model!r} at depth cap ({depth}/{cap}); "
+            f"serving {what} for {model!r} at cap ({depth}/{cap}); "
             "request shed")
         self.model = model
         self.depth = depth
         self.cap = cap
+        self.what = what
 
 
 def shape_buckets(max_batch: int) -> Tuple[int, ...]:

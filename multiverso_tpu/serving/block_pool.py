@@ -1,0 +1,194 @@
+"""Block-pool allocator for the paged KV cache.
+
+The decode engine's original cache gave every slot a contiguous
+``[T, D]`` strip sized for the worst case ``max_prompt + max_new`` — a
+short sequence wasted almost its whole strip, so concurrency was capped
+by slot geometry rather than by actual KV bytes. The paged layout
+(vLLM/PagedAttention) carves the same memory into fixed-size **blocks**
+of ``block_size`` token positions each; a sequence owns
+``ceil((prompt_len + max_new) / block_size)`` blocks, recorded in a
+per-slot **block table** the jitted programs consume as traced data.
+
+This module is the host-side half: a free-list allocator over block ids.
+Device memory itself lives in the engine (``[L, n_blocks + 1,
+block_size, D]`` pools); the allocator only hands out integer ids and
+keeps the books honest:
+
+* block id ``0`` is the reserved **scratch block** — never allocated.
+  Block tables pad with it (the sentinel), dead decode lanes park their
+  K/V writes in it, and pad-position scatter garbage lands in it, so
+  every write in the jitted programs has a defined, in-bounds target
+  that no live attention mask ever reads.
+* ``alloc``/``free`` are guarded: allocating past the free list or
+  freeing an id that is not live raises — a leak or double-allocation
+  is a bug in the engine's admission/completion bookkeeping, not a
+  condition to limp through (the property test churns this).
+* occupancy is observable: ``KV_BLOCKS_FREE[name]``/
+  ``KV_BLOCKS_LIVE[name]`` gauges and ``BLOCK_ALLOC[name]``/
+  ``BLOCK_FREE[name]`` counters land in the Dashboard next to the
+  engine's slot metrics (docs/OBSERVABILITY.md).
+
+Capacity math lives here too (:func:`kv_bytes_per_block`,
+:func:`blocks_for_bytes`): the ``-kv_pool_blocks`` flag sizes the pool
+in blocks, and the bench's equal-KV-bytes A/B converts a bytes budget
+into the equivalent block count.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, List
+
+import numpy as np
+
+from ..dashboard import Dashboard
+
+# block id 0: reserved scratch — the block-table pad sentinel and the
+# parking target for dead-lane / pad-position writes. Never allocated.
+SCRATCH_BLOCK = 0
+
+
+def kv_bytes_per_block(n_layers: int, d_model: int, block_size: int,
+                       dtype=np.float32) -> int:
+    """Device bytes one block costs across BOTH pools (K and V)."""
+    return 2 * n_layers * block_size * d_model * np.dtype(dtype).itemsize
+
+
+def blocks_for_bytes(budget_bytes: int, n_layers: int, d_model: int,
+                     block_size: int, dtype=np.float32) -> int:
+    """Usable blocks a KV-bytes budget buys (scratch block excluded:
+    its bytes ride along, but it holds no sequence).
+
+    Raises for a budget too small for scratch + one usable block: the
+    result feeds ``kv_pool_blocks``, where ``0`` means AUTO-size — a
+    silent 0 here would turn "tiny budget" into "contiguous-equivalent
+    pool", a many-fold device-memory overshoot."""
+    per = kv_bytes_per_block(n_layers, d_model, block_size, dtype)
+    n = budget_bytes // per - 1
+    if n < 1:
+        raise ValueError(
+            f"KV budget {budget_bytes} B buys no usable block: need >= "
+            f"{2 * per} B (scratch + 1 block of {per} B at block_size "
+            f"{block_size})")
+    return int(n)
+
+
+class BlockPool:
+    """Free-list allocator over ``n_blocks`` usable KV-cache blocks.
+
+    Block ids run ``1 .. n_blocks`` (id 0 is the scratch block). The
+    engine allocates a sequence's whole reservation up front at
+    admission (``prompt + max_new`` worth of positions) and frees it at
+    eos/completion, so pool occupancy — not slot geometry — is what
+    bounds concurrency.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int,
+                 name: str = "") -> None:
+        if n_blocks < 1:
+            raise ValueError(f"BlockPool needs >= 1 usable block, "
+                             f"got {n_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.capacity = int(n_blocks)
+        self.block_size = int(block_size)
+        self._free: List[int] = list(range(n_blocks, 0, -1))  # pop() -> 1 first
+        self._live: set = set()
+        self._lock = threading.Lock()
+        self.allocs = 0                # blocks handed out (monotonic)
+        self.frees = 0                 # blocks returned (monotonic)
+        label = name or "pool"
+        self.free_gauge = Dashboard.get_or_create_gauge(
+            f"KV_BLOCKS_FREE[{label}]")
+        self.live_gauge = Dashboard.get_or_create_gauge(
+            f"KV_BLOCKS_LIVE[{label}]")
+        self.alloc_counter = Dashboard.get_or_create_counter(
+            f"BLOCK_ALLOC[{label}]")
+        self.free_counter = Dashboard.get_or_create_counter(
+            f"BLOCK_FREE[{label}]")
+        self.free_gauge.set(float(n_blocks))
+        self.live_gauge.set(0.0)
+
+    # -- sizing -------------------------------------------------------------
+    def blocks_needed(self, n_tokens: int) -> int:
+        """Blocks covering ``n_tokens`` cache positions."""
+        return -(-int(n_tokens) // self.block_size)
+
+    def covers(self, n_tokens: int) -> bool:
+        """Whether the pool could EVER hold ``n_tokens`` positions
+        (capacity check — the submit-time shed gate)."""
+        return self.blocks_needed(n_tokens) <= self.capacity
+
+    # -- allocation ---------------------------------------------------------
+    @property
+    def n_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def n_live(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def can_alloc(self, n: int) -> bool:
+        with self._lock:
+            return n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Hand out ``n`` block ids; raises if the free list is short
+        (callers gate on :meth:`can_alloc` — running dry mid-admission
+        is an accounting bug, not an overload condition)."""
+        with self._lock:
+            if n > len(self._free):
+                raise RuntimeError(
+                    f"BlockPool: alloc({n}) with only {len(self._free)} "
+                    f"free of {self.capacity}")
+            blocks = [self._free.pop() for _ in range(n)]
+            self._live.update(blocks)
+            self.allocs += n
+            self._update_gauges_locked()
+        self.alloc_counter.inc(n)
+        return blocks
+
+    def free(self, blocks: Iterable[int]) -> None:
+        """Return blocks to the pool; double-free or foreign ids raise."""
+        blocks = list(blocks)
+        with self._lock:
+            for b in blocks:
+                if b not in self._live:
+                    raise RuntimeError(
+                        f"BlockPool: freeing block {b} that is not live "
+                        f"(double-free or foreign id)")
+                self._live.discard(b)
+                self._free.append(b)
+            self.frees += len(blocks)
+            self._update_gauges_locked()
+        self.free_counter.inc(len(blocks))
+
+    def _update_gauges_locked(self) -> None:
+        self.free_gauge.set(float(len(self._free)))
+        self.live_gauge.set(float(len(self._live)))
+
+    # -- introspection ------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "block_size": self.block_size,
+                "free": len(self._free),
+                "live": len(self._live),
+                "allocs": self.allocs,
+                "frees": self.frees,
+            }
+
+    def check(self) -> None:
+        """Invariant check (tests): free + live == capacity, disjoint."""
+        with self._lock:
+            free = set(self._free)
+            assert len(free) == len(self._free), "duplicate ids in free list"
+            assert not (free & self._live), "id both free and live"
+            assert len(free) + len(self._live) == self.capacity, \
+                f"leak: {len(free)} free + {len(self._live)} live " \
+                f"!= {self.capacity}"
+            assert SCRATCH_BLOCK not in free | self._live, \
+                "scratch block entered circulation"

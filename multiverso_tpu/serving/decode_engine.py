@@ -8,10 +8,20 @@ at completion AND admission). This engine removes both stalls with the
 Orca design — iteration-level scheduling over a persistent slotted KV
 cache (the fixed-slot precursor to vLLM's PagedAttention):
 
-* **slots** — the engine owns per-layer K/V caches of S fixed slots
-  (``[L, S, T, D]``, jit-donated so XLA updates them in place). A slot
-  is one in-flight sequence; the set of live slots is an ``active``
-  lanes vector.
+* **slots** — a slot is one in-flight sequence; the set of live slots
+  is an ``active`` lanes vector. With the default **paged KV cache**
+  the engine owns one block pool ``[L, n_blocks + 1, block_size, D]``
+  plus a host-side allocator (``serving/block_pool.py``) and per-slot
+  block tables ``[S, max_blocks_per_seq]`` handed to the jitted
+  programs as traced data — a sequence reserves
+  ``ceil((prompt + max_new) / block_size)`` blocks at admission and
+  frees them at eos/completion, so CAPACITY (KV bytes), not slot
+  geometry, bounds concurrency: slots can outnumber what contiguous
+  strips would fit, short sequences hold only the blocks they need,
+  and a submit whose ``prompt + max_new`` can never fit the pool sheds
+  with :class:`OverloadedError` (``kv_block_size=0`` restores the
+  contiguous ``[L, S, T, D]`` strips — the A/B baseline). Caches are
+  jit-donated so XLA updates them in place off-CPU.
 * **one fused step per iteration** — every iteration runs ONE jitted
   :func:`models.transformer.decode_step` over all S slots, live or
   dead. Shapes never depend on the request mix, so the step compiles
@@ -71,6 +81,7 @@ from .. import trace
 from ..dashboard import Dashboard
 from ..log import Log
 from .batcher import OverloadedError, bucket_for, shape_buckets
+from .block_pool import SCRATCH_BLOCK, BlockPool
 from .snapshot import SnapshotManager, replicate_for_decode
 from .workloads import _jit_cache_size
 
@@ -90,6 +101,12 @@ class DecodeEngineConfig:
     # per-iteration chunked-prefill token budget; None = the
     # -prefill_token_budget flag, 0 = monolithic whole-prompt admission
     prefill_token_budget: Optional[int] = None
+    # paged KV cache: block size in token positions (None = the
+    # -kv_block_size flag, 0 = contiguous per-slot strips) and usable
+    # pool blocks (None = the -kv_pool_blocks flag, <= 0 = auto-size to
+    # the contiguous-equivalent capacity slots * ceil(T / block_size))
+    kv_block_size: Optional[int] = None
+    kv_pool_blocks: Optional[int] = None
 
     def resolved_prompt_buckets(self) -> Tuple[int, ...]:
         if self.prompt_buckets:
@@ -103,11 +120,28 @@ class DecodeEngineConfig:
 
         return int(get_flag("prefill_token_budget"))
 
+    def resolved_kv_block_size(self) -> int:
+        if self.kv_block_size is not None:
+            return int(self.kv_block_size)
+        from ..config import get_flag
+
+        return int(get_flag("kv_block_size"))
+
+    def resolved_kv_pool_blocks(self, blocks_per_seq: int) -> int:
+        n = self.kv_pool_blocks
+        if n is None:
+            from ..config import get_flag
+
+            n = int(get_flag("kv_pool_blocks"))
+        if n <= 0:                   # auto: contiguous-equivalent capacity
+            n = self.slots * blocks_per_seq
+        return int(n)
+
 
 class _Request:
     __slots__ = ("prompt", "max_new", "future", "t_enq", "t_last",
                  "slot", "out", "version", "ctx", "pf_off", "pf_chunks",
-                 "t_admit")
+                 "t_admit", "blocks")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  ctx: Optional[trace.SpanContext] = None) -> None:
@@ -119,6 +153,7 @@ class _Request:
         self.slot = -1
         self.out: List[int] = []
         self.version = -1
+        self.blocks: List[int] = []  # paged KV: the admission's reservation
         # trace handoff token (the submitter's root-span context): the
         # engine thread parents admission/iteration spans under it
         self.ctx = ctx
@@ -141,8 +176,10 @@ class DecodeEngine:
 
     def __init__(self, name: str, lm, config: Optional[DecodeEngineConfig]
                  = None) -> None:
-        from ..models.transformer import (cache_insert, decode_step, prefill,
-                                          prefill_chunk)
+        from ..models.transformer import (cache_insert, cache_insert_paged,
+                                          decode_step, decode_step_paged,
+                                          prefill, prefill_chunk,
+                                          prefill_chunk_paged)
 
         self.name = name
         self.config = config or DecodeEngineConfig()
@@ -164,6 +201,31 @@ class DecodeEngine:
         self._cache_len = ec.max_prompt + ec.max_new
         T = self._cache_len
 
+        # -- paged KV cache geometry ----------------------------------------
+        # block size 0 = contiguous [L, S, T, D] strips (the pre-paging
+        # layout, kept as the A/B baseline); > 0 = one block pool
+        # [L, n_blocks + 1, block_size, D] (physical block 0 is the
+        # scratch/sentinel block) + per-slot block tables [S, M]
+        self._block_size = ec.resolved_kv_block_size()
+        if self._block_size < 0:
+            Log.fatal(f"DecodeEngine {name!r}: negative kv_block_size "
+                      f"{self._block_size}")
+        self._paged = self._block_size > 0
+        if self._paged:
+            Bs = self._block_size
+            self._blocks_per_seq = -(-T // Bs)          # M = ceil(T / Bs)
+            n_blocks = ec.resolved_kv_pool_blocks(self._blocks_per_seq)
+            self._pool: Optional[BlockPool] = BlockPool(
+                n_blocks, Bs, name=name)
+            # all-sentinel rows: every position maps to scratch until an
+            # admission installs its reservation
+            self._block_tables = np.full(
+                (S, self._blocks_per_seq), SCRATCH_BLOCK, np.int32)
+        else:
+            self._blocks_per_seq = 0
+            self._pool = None
+            self._block_tables = None
+
         self._manager = SnapshotManager.of(lm, name=name)
         self._snap = None            # pinned while any slot is live
         self._pinned = None          # the pinned snapshot's DECODE params
@@ -178,15 +240,27 @@ class DecodeEngine:
         # fused admission: prefill a group of prompts (padded to a batch
         # bucket x prompt bucket), gather each last REAL position's logits
         # -> first tokens, and insert every prompt's K/V into its free
-        # slot, all in ONE dispatch (traced slot indices). One trace per
-        # (batch bucket, prompt bucket), shared by every slot choice.
-        def _admit_insert(params, kc, vc, slots, toks, lengths):
-            logits, ks, vs = prefill(cfg, params, toks)
+        # slot, all in ONE dispatch. Placement is traced either way — slot
+        # indices for the contiguous DUS chain, per-row block tables for
+        # the paged scatter — so there is one trace per (batch bucket,
+        # prompt bucket), shared by every slot/block choice.
+        def _first_tokens(logits, lengths, dtype):
             last = jnp.take_along_axis(
                 logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-            first = jnp.argmax(last, axis=-1).astype(toks.dtype)
-            kc, vc = cache_insert(kc, vc, slots, ks, vs)
-            return first, kc, vc
+            return jnp.argmax(last, axis=-1).astype(dtype)
+
+        if self._paged:
+            def _admit_insert(params, kc, vc, bts, toks, lengths):
+                logits, ks, vs = prefill(cfg, params, toks)
+                first = _first_tokens(logits, lengths, toks.dtype)
+                kc, vc = cache_insert_paged(kc, vc, bts, ks, vs)
+                return first, kc, vc
+        else:
+            def _admit_insert(params, kc, vc, slots, toks, lengths):
+                logits, ks, vs = prefill(cfg, params, toks)
+                first = _first_tokens(logits, lengths, toks.dtype)
+                kc, vc = cache_insert(kc, vc, slots, ks, vs)
+                return first, kc, vc
 
         self._admit_fn = jax.jit(_admit_insert, donate_argnums=donate)
         # chunked admission: a fixed-size chunk prefilled straight into
@@ -201,27 +275,52 @@ class DecodeEngine:
         # prompt (and must fit the [.., T, ..] cache): clamp the chunk
         # shape — budgets past max_prompt just mean one-chunk admission
         self._budget = min(self._budget, ec.max_prompt)
-        self._chunk_fn = jax.jit(
-            lambda params, kc, vc, slot, toks, off, n: prefill_chunk(
-                cfg, params, kc, vc, slot, toks, off, n),
-            donate_argnums=donate)
-        # THE fused step: all shapes fixed by the engine config -> exactly
-        # one compiled trace no matter which slots are live
-        self._step_fn = jax.jit(
-            lambda params, kc, vc, tok, pos, active: decode_step(
-                cfg, params, kc, vc, tok, pos, active),
-            donate_argnums=donate)
+        if self._paged:
+            # block tables ride every call as DATA ([S, M] int32, fixed
+            # shape): which blocks a slot owns never touches an aval, so
+            # the one-trace-per-config invariant survives paging. The
+            # gathered views are sliced to T inside the kernels, keeping
+            # the attention operand (and outputs) bit-identical to the
+            # contiguous layout's.
+            self._chunk_fn = jax.jit(
+                lambda params, kc, vc, bt, slot, toks, off, n:
+                prefill_chunk_paged(cfg, params, kc, vc, bt, slot, toks,
+                                    off, n, t_logical=T),
+                donate_argnums=donate)
+            self._step_fn = jax.jit(
+                lambda params, kc, vc, bt, tok, pos, active:
+                decode_step_paged(cfg, params, kc, vc, bt, tok, pos,
+                                  active, t_logical=T),
+                donate_argnums=donate)
+        else:
+            self._chunk_fn = jax.jit(
+                lambda params, kc, vc, slot, toks, off, n: prefill_chunk(
+                    cfg, params, kc, vc, slot, toks, off, n),
+                donate_argnums=donate)
+            # THE fused step: all shapes fixed by the engine config ->
+            # exactly one compiled trace no matter which slots are live
+            self._step_fn = jax.jit(
+                lambda params, kc, vc, tok, pos, active: decode_step(
+                    cfg, params, kc, vc, tok, pos, active),
+                donate_argnums=donate)
 
         # -- device state (owned by the loop thread after start) -------------
         # committed placement from birth: warmup scratch caches use the
         # same put, so the traces warmup compiles ARE the serving traces
         # (an uncommitted zeros here would retrace on the first live call)
+        if self._paged:
+            cache_shape = (L, self._pool.capacity + 1, self._block_size, D)
+        else:
+            cache_shape = (L, S, T, D)
         self._k_cache = jax.device_put(
-            jnp.zeros((L, S, T, D), cfg.dtype), jax.devices()[0])
+            jnp.zeros(cache_shape, cfg.dtype), jax.devices()[0])
         self._v_cache = jax.device_put(
-            jnp.zeros((L, S, T, D), cfg.dtype), jax.devices()[0])
+            jnp.zeros(cache_shape, cfg.dtype), jax.devices()[0])
         # -- host state -----------------------------------------------------
         self._slot_req: List[Optional[_Request]] = [None] * S
+        # explicit free-slot set, maintained at admit/complete (the loop
+        # used to rebuild it by scanning all S slots every iteration)
+        self._free_q: Deque[int] = collections.deque(range(S))
         self._tok = np.zeros(S, np.int32)
         self._pos = np.zeros(S, np.int32)
         self._active = np.zeros(S, bool)
@@ -253,6 +352,11 @@ class DecodeEngine:
         self.completed = 0
         self.shed = 0
         self.tokens = 0
+        # peak concurrent sequences (live slots + the mid-prefill
+        # admission): the capacity headline the paged A/B compares —
+        # at a fixed KV-bytes budget, paging should hold several times
+        # more of these than contiguous strips
+        self.peak_live = 0
         # engine-local prefill-token count: the PREFILL_TOKENS Counter is
         # monotonic by contract (MetricsExporter rates), so stats() and
         # reset_stats() read/zero this mirror instead
@@ -276,14 +380,25 @@ class DecodeEngine:
 
     def submit(self, prompt: np.ndarray, max_new: Optional[int] = None,
                ctx: Optional[trace.SpanContext] = None) -> Future:
-        """Enqueue one prompt; fast-rejects at the admission-queue cap.
-        ``ctx`` is the request's trace handoff token (or None)."""
+        """Enqueue one prompt; fast-rejects at the admission-queue cap,
+        and (paged KV) when ``prompt + max_new`` needs more blocks than
+        the whole pool holds — such a request could NEVER be admitted,
+        so queueing it would deadlock the admission head. ``ctx`` is the
+        request's trace handoff token (or None)."""
         self.validate(prompt, max_new)
         p = np.asarray(prompt, np.int32).ravel()
         req = _Request(p, int(max_new or self.config.max_new), ctx)
         with self._cv:
             if self._stop.is_set():
                 raise RuntimeError(f"decode engine {self.name!r} is stopped")
+            if self._paged:
+                need = self._pool.blocks_needed(p.shape[0] + req.max_new)
+                if need > self._pool.capacity:
+                    self.shed += 1
+                    self.shed_counter.inc()
+                    raise OverloadedError(self.name, need,
+                                          self._pool.capacity,
+                                          what="kv block pool")
             if len(self._q) >= self.config.max_queue:
                 self.shed += 1
                 self.shed_counter.inc()
@@ -300,12 +415,19 @@ class DecodeEngine:
             return len(self._q)
 
     # -- engine loop --------------------------------------------------------
-    def _free_slots(self) -> List[int]:
-        """Slots holding no live sequence and not reserved by the
-        in-flight chunked prefill."""
-        pf_slot = self._pf.slot if self._pf is not None else -1
-        return [s for s in range(self.config.slots)
-                if not self._active[s] and s != pf_slot]
+    def _blocks_cover(self, req: _Request, reserved: int) -> bool:
+        """Paged-KV admission gate: a request admits only when its WHOLE
+        reservation (``prompt + max_new`` worth of blocks, less what
+        earlier arrivals of the same wave will take) fits the free list.
+        A false verdict leaves it QUEUED — completions free blocks at
+        iteration granularity, so it admits as soon as enough return;
+        only a request larger than the entire pool could wait forever,
+        and ``submit`` shed that case up front (no admission deadlock,
+        tested)."""
+        if not self._paged:
+            return True
+        need = self._pool.blocks_needed(len(req.prompt) + req.max_new)
+        return need + reserved <= self._pool.n_free
 
     def _loop(self) -> None:
         chunked = self._budget > 0
@@ -318,20 +440,31 @@ class DecodeEngine:
                 if (self._stop.is_set() and not self._q
                         and self._pf is None and not self._active.any()):
                     return
-                free = collections.deque(self._free_slots())
+                # admission is FIFO off the explicit free-slot set (kept
+                # current at admit/complete — the loop used to rescan all
+                # S slots here every iteration) and, when paged, gated on
+                # the block pool covering each arrival's reservation
+                arrivals: List[_Request] = []
                 if chunked:
                     # one admission prefills at a time; the NEXT request
                     # is only picked up once the current one goes live
-                    arrivals = ([self._q.popleft()]
-                                if self._pf is None and free and self._q
-                                else [])
+                    if (self._pf is None and self._free_q and self._q
+                            and self._blocks_cover(self._q[0], 0)):
+                        arrivals.append(self._q.popleft())
                 else:
-                    arrivals = [self._q.popleft()
-                                for _ in range(min(len(free), len(self._q)))]
+                    reserved = 0
+                    while (len(arrivals) < len(self._free_q) and self._q
+                           and self._blocks_cover(self._q[0], reserved)):
+                        req = self._q.popleft()
+                        if self._paged:
+                            reserved += self._pool.blocks_needed(
+                                len(req.prompt) + req.max_new)
+                        arrivals.append(req)
             try:
                 if chunked:
                     if arrivals:
-                        self._begin_prefill(arrivals[0], free.popleft())
+                        self._begin_prefill(arrivals[0],
+                                            self._free_q.popleft())
                     if self._pf is not None:
                         # AT MOST one budget-sized chunk per iteration:
                         # the stall an admission can add to every live
@@ -339,7 +472,10 @@ class DecodeEngine:
                         self._prefill_one_chunk()
                 else:
                     if arrivals:
-                        self._admit(arrivals, free)
+                        self._admit(arrivals)
+                live = int(self._active.sum()) + (self._pf is not None)
+                if live > self.peak_live:
+                    self.peak_live = live
                 if self._active.any():
                     self._step()
             except Exception as exc:          # pragma: no cover - defensive
@@ -367,12 +503,41 @@ class DecodeEngine:
                 self._pinned = replicate_for_decode(snap.value)
             self._snap = snap
 
+    def _reserve_blocks(self, req: _Request, slot: int) -> None:
+        """Paged KV: allocate the admission's WHOLE reservation
+        (``prompt + max_new`` positions) up front and install it in the
+        slot's block table row — the loop's ``_blocks_cover`` gate
+        guaranteed coverage, so this cannot fail."""
+        if not self._paged:
+            return
+        need = self._pool.blocks_needed(len(req.prompt) + req.max_new)
+        req.blocks = self._pool.alloc(need)
+        row = self._block_tables[slot]
+        row[:] = SCRATCH_BLOCK
+        row[: need] = req.blocks
+
+    def _release_seq(self, req: _Request) -> None:
+        """Completion (eos / max_new / eos-at-first-token): the slot
+        returns to the free set and, paged, the reservation's blocks
+        return to the pool — at iteration granularity, so a same-
+        iteration queued admission can reuse them on the very next
+        loop pass (tested)."""
+        if self._paged and req.blocks:
+            self._pool.free(req.blocks)
+            req.blocks = []
+            self._block_tables[req.slot][:] = SCRATCH_BLOCK
+        self._free_q.append(req.slot)
+
     def _begin_prefill(self, req: _Request, slot: int) -> None:
-        """Reserve ``slot`` and pin the snapshot for one admission; its
-        prompt then prefills one chunk per iteration."""
+        """Reserve ``slot`` (and its KV blocks) and pin the snapshot for
+        one admission; its prompt then prefills one chunk per iteration.
+        The reserved-not-live admission keeps its blocks for its whole
+        lifetime — a concurrent wave cannot steal a mid-prefill
+        sequence's cache out from under it."""
         self._maybe_refresh()
         req.version = self._snap.version
         req.slot = slot
+        self._reserve_blocks(req, slot)
         req.pf_off = 0
         req.pf_chunks = 0
         req.t_admit = time.monotonic()   # queue.wait ends here
@@ -391,9 +556,15 @@ class DecodeEngine:
         toks[: n] = req.prompt[off: off + n]
         tracing = trace.enabled()
         t0 = time.monotonic() if tracing else 0.0
-        self._k_cache, self._v_cache, logits = self._chunk_fn(
-            self._pinned, self._k_cache, self._v_cache,
-            np.int32(req.slot), toks, np.int32(off), np.int32(n))
+        if self._paged:
+            self._k_cache, self._v_cache, logits = self._chunk_fn(
+                self._pinned, self._k_cache, self._v_cache,
+                self._block_tables, np.int32(req.slot), toks,
+                np.int32(off), np.int32(n))
+        else:
+            self._k_cache, self._v_cache, logits = self._chunk_fn(
+                self._pinned, self._k_cache, self._v_cache,
+                np.int32(req.slot), toks, np.int32(off), np.int32(n))
         # block per chunk: letting chunk dispatches run ahead
         # asynchronously looks free, but an idle->busy transition can
         # queue several chunks on the device and the NEXT fused step's
@@ -426,14 +597,19 @@ class DecodeEngine:
         if tracing and req.ctx is not None:
             trace.record_span("queue.wait", req.ctx, req.t_enq,
                               req.t_admit, cause="admission")
+            extra = ({"blocks": len(req.blocks),
+                      "pool_free": self._pool.n_free}
+                     if self._paged else {})
             trace.record_span(
                 "decode.admit", req.ctx, req.t_admit, now, slot=req.slot,
                 prompt_len=len(req.prompt), chunks=req.pf_chunks,
-                budget=C, snapshot_version=req.version)
+                budget=C, snapshot_version=req.version, **extra)
         self._pf = None
         if self._finished(req, tok0):
             # slot never goes live; the inserted K/V is dead weight a
-            # later admission overwrites (tested)
+            # later admission overwrites (tested) — slot and blocks
+            # return to the free sets immediately
+            self._release_seq(req)
             self._resolve(req)
             return
         self._slot_req[req.slot] = req
@@ -441,14 +617,16 @@ class DecodeEngine:
         self._pos[req.slot] = len(req.prompt)
         self._active[req.slot] = True
 
-    def _admit(self, arrivals: List[_Request], free: Deque[int]) -> None:
+    def _admit(self, arrivals: List[_Request]) -> None:
         t_admit = time.monotonic()     # queue.wait ends / admission begins
         self._maybe_refresh()
         version = self._snap.version
         # phase 1 — dispatch every admission without blocking: arrivals
         # group by PROMPT bucket, each group pads to a power-of-two batch
-        # bucket and runs ONE fused prefill+insert (pad rows point their
-        # slot at slots[0]; the cache_insert chain overwrites them)
+        # bucket and runs ONE fused prefill+insert. Placement: contiguous
+        # pads point their slot at slots[0] (the cache_insert DUS chain
+        # overwrites them); paged pads carry all-scratch block-table rows
+        # (their scatter lands in the sentinel block nothing reads)
         by_bucket: dict = {}
         for req in arrivals:
             pb = bucket_for(len(req.prompt), self._prompt_buckets)
@@ -459,19 +637,32 @@ class DecodeEngine:
             toks = np.zeros((bb, pb), np.int32)
             lens = np.ones(bb, np.int32)
             slots = np.empty(bb, np.int32)
+            bts = (np.full((bb, self._blocks_per_seq), SCRATCH_BLOCK,
+                           np.int32) if self._paged else None)
             for i, req in enumerate(group):
                 toks[i, : len(req.prompt)] = req.prompt
                 lens[i] = len(req.prompt)
-                # popleft: the free pool arrives as a deque — list.pop(0)
-                # here was O(slots) per admission, O(slots^2) across a
-                # full admission wave on a large slot pool
-                slots[i] = free.popleft()
+                # popleft off the persistent free-slot deque (kept
+                # current at admit/complete; list.pop(0) here was
+                # O(slots) per admission, O(slots^2) across a wave)
+                slot = self._free_q.popleft()
+                slots[i] = slot
+                req.slot = slot
+                self._reserve_blocks(req, slot)
+                if self._paged:
+                    bts[i] = self._block_tables[slot]
                 self.prefill_tokens += len(req.prompt)
                 self.prefill_tok_counter.inc(len(req.prompt))
-            slots[len(group):] = slots[0]    # pad rows: overwritten by row 0
-            first, self._k_cache, self._v_cache = self._admit_fn(
-                self._pinned, self._k_cache, self._v_cache,
-                jnp.asarray(slots), jnp.asarray(toks), jnp.asarray(lens))
+            if self._paged:
+                first, self._k_cache, self._v_cache = self._admit_fn(
+                    self._pinned, self._k_cache, self._v_cache,
+                    jnp.asarray(bts), jnp.asarray(toks), jnp.asarray(lens))
+            else:
+                slots[len(group):] = slots[0]  # pads: overwritten by row 0
+                first, self._k_cache, self._v_cache = self._admit_fn(
+                    self._pinned, self._k_cache, self._v_cache,
+                    jnp.asarray(slots), jnp.asarray(toks),
+                    jnp.asarray(lens))
             staged.append((group, slots, first, pb, bb))
         # phase 2 — read the first tokens back (one sync per group, after
         # every group's dispatch is already in the device queue)
@@ -495,16 +686,20 @@ class DecodeEngine:
                     # the pinned snapshot it was admitted under
                     trace.record_span("queue.wait", req.ctx, req.t_enq,
                                       t_admit, cause="admission")
+                    extra = ({"blocks": len(req.blocks),
+                              "pool_free": self._pool.n_free}
+                             if self._paged else {})
                     trace.record_span(
                         "decode.admit", req.ctx, t_admit, now, slot=slot,
                         prompt_len=len(req.prompt), prompt_bucket=pb,
-                        batch_bucket=bb, snapshot_version=version)
+                        batch_bucket=bb, snapshot_version=version, **extra)
                 if self._finished(req, tok0):
                     # slot never goes live; the inserted K/V is dead
-                    # weight a later admission overwrites
+                    # weight a later admission overwrites — slot and
+                    # blocks return to the free sets immediately
+                    self._release_seq(req)
                     self._resolve(req)
                     continue
-                req.slot = slot
                 self._slot_req[slot] = req
                 self._tok[slot] = tok0
                 self._pos[slot] = len(req.prompt)
@@ -516,11 +711,17 @@ class DecodeEngine:
         # test_observability's overhead test)
         tracing = trace.enabled()
         t_it0 = time.monotonic() if tracing else 0.0
-        # host state (tok/pos/active) feeds the jit as plain numpy — the
-        # same aval signature warmup() uses, so the two share one trace
-        self._k_cache, self._v_cache, nxt, _ = self._step_fn(
-            self._pinned, self._k_cache, self._v_cache,
-            self._tok, self._pos, self._active)
+        # host state (tok/pos/active — and, paged, the block tables)
+        # feeds the jit as plain numpy: the same aval signature warmup()
+        # uses, so the two share one trace
+        if self._paged:
+            self._k_cache, self._v_cache, nxt, _ = self._step_fn(
+                self._pinned, self._k_cache, self._v_cache,
+                self._block_tables, self._tok, self._pos, self._active)
+        else:
+            self._k_cache, self._v_cache, nxt, _ = self._step_fn(
+                self._pinned, self._k_cache, self._v_cache,
+                self._tok, self._pos, self._active)
         nxt = np.array(nxt)           # the per-iteration host sync point
         # pos is mirrored host-side (active lanes advanced one) rather
         # than read back: one device->host transfer per iteration, not two
@@ -550,6 +751,7 @@ class DecodeEngine:
             if self._finished(req, tok):
                 self._active[s] = False
                 self._slot_req[s] = None
+                self._release_seq(req)
                 self._resolve(req)
         self._occ_sum += n_active / self.config.slots
         self._occ_n += 1
@@ -586,8 +788,19 @@ class DecodeEngine:
         if self._pf is not None:      # mid-prefill admission dies too
             live.append(self._pf)
             self._pf = None
+        if self._paged:
+            # the dying requests' reservations go back too — including
+            # arrivals reserved mid-_admit but not yet slotted. The
+            # engine is stopped, but stats()/gauges must not report
+            # phantom live blocks (the pool's leak invariant must hold)
+            for req in live + (in_flight or []):
+                if req.blocks:
+                    self._pool.free(req.blocks)
+                    req.blocks = []
+            self._block_tables[:] = SCRATCH_BLOCK
         self._active[:] = False
         self._slot_req = [None] * self.config.slots
+        self._free_q = collections.deque(range(self.config.slots))
         seen = set()
         for req in pending + live + (in_flight or []):
             if id(req) in seen or req.future.done():
@@ -630,6 +843,31 @@ class DecodeEngine:
             return (jax.device_put(jnp.zeros(shape, dtype), jax.devices()[0]),
                     jax.device_put(jnp.zeros(shape, dtype), jax.devices()[0]))
 
+        if self._paged:
+            # all-scratch block tables: warmup writes park in the
+            # sentinel block of the scratch pools — placement is data,
+            # so these ARE the serving traces for any block assignment
+            M = self._blocks_per_seq
+            bt = np.full((S, M), SCRATCH_BLOCK, np.int32)
+            if self._budget > 0:
+                kc, vc = scratch()
+                self._chunk_fn(params, kc, vc, bt, np.int32(0),
+                               np.ones(self._budget, np.int32),
+                               np.int32(0), np.int32(1))
+            else:
+                for pb in self._prompt_buckets:
+                    for bb in self._batch_buckets:
+                        kc, vc = scratch()
+                        self._admit_fn(
+                            params, kc, vc,
+                            np.full((bb, M), SCRATCH_BLOCK, np.int32),
+                            np.ones((bb, pb), np.int32),
+                            np.ones(bb, np.int32))
+            kc, vc = scratch()
+            jax.block_until_ready(self._step_fn(
+                params, kc, vc, bt, np.zeros(S, np.int32),
+                np.zeros(S, np.int32), np.zeros(S, bool)))
+            return
         if self._budget > 0:
             kc, vc = scratch()
             self._chunk_fn(params, kc, vc, np.int32(0),
@@ -655,6 +893,7 @@ class DecodeEngine:
         self.completed = 0
         self.shed = 0
         self.tokens = 0
+        self.peak_live = 0
         self.prefill_tokens = 0
         self.t_first = None
         self._occ_sum = 0.0
@@ -666,7 +905,19 @@ class DecodeEngine:
         ttft = self.ttft_hist.percentiles((50, 99))
         itl = self.itl_hist.percentiles((50, 99))
         issued = self.completed + self.shed
+        # paged-KV pool occupancy: capacity is what bounds concurrency
+        # now, so the pool's free/live split (and the peak sequence
+        # count it allowed) belongs next to slot occupancy
+        pool = ({"kv_block_size": self._block_size,
+                 "kv_pool_blocks": self._pool.capacity,
+                 "kv_blocks_free": self._pool.n_free,
+                 "kv_blocks_live": self._pool.n_live,
+                 "block_allocs": self._pool.allocs,
+                 "block_frees": self._pool.frees}
+                if self._paged else {"kv_block_size": 0})
         return {
+            **pool,
+            "peak_live_seqs": self.peak_live,
             "completed": self.completed,
             "shed": self.shed,
             "shed_rate": self.shed / issued if issued else 0.0,

@@ -110,7 +110,9 @@ class InferenceServer:
                          eos_id: Optional[int] = None, max_queue: int = 256,
                          max_staleness_s: float = 0.05,
                          prompt_buckets: Optional[tuple] = None,
-                         prefill_token_budget: Optional[int] = None
+                         prefill_token_budget: Optional[int] = None,
+                         kv_block_size: Optional[int] = None,
+                         kv_pool_blocks: Optional[int] = None
                          ) -> DecodeEngine:
         """Attach a continuous-batching decode engine under ``name``.
 
@@ -123,12 +125,20 @@ class InferenceServer:
         ``prefill_token_budget`` bounds the prefill work any single
         iteration interleaves with decode (chunked admission; None =
         the ``-prefill_token_budget`` flag, 0 = monolithic).
+        ``kv_block_size``/``kv_pool_blocks`` size the paged KV cache
+        (None = the ``-kv_block_size``/``-kv_pool_blocks`` flags;
+        block size 0 = contiguous per-slot strips) — with paging, pool
+        capacity rather than slot geometry bounds concurrency, and a
+        submit whose ``prompt + max_new`` can never fit the pool sheds
+        with :class:`OverloadedError` (docs/SERVING.md "Paged KV
+        cache").
         """
         cfg = DecodeEngineConfig(
             slots=slots, max_prompt=max_prompt, max_new=max_new,
             eos_id=eos_id, max_queue=max_queue,
             max_staleness_s=max_staleness_s, prompt_buckets=prompt_buckets,
-            prefill_token_budget=prefill_token_budget)
+            prefill_token_budget=prefill_token_budget,
+            kv_block_size=kv_block_size, kv_pool_blocks=kv_pool_blocks)
         with self._lock:
             if name in self._models:
                 Log.fatal(f"serving: model {name!r} already registered")

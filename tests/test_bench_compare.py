@@ -33,9 +33,37 @@ def test_metric_direction_rules():
     assert metric_direction("tokens_per_s_ratio") == 1
     assert metric_direction("p99_ms") == -1
     assert metric_direction("shed_rate") == -1
+    # paged-KV capacity metrics: sequences held at a fixed KV-bytes
+    # budget regress DOWN, bytes per held sequence regress UP
+    assert metric_direction("capacity_seqs") == 1
+    assert metric_direction("kv_bytes_per_seq") == -1
+    # the _info suffix overrides every pattern rule: measured-but-noisy
+    # columns ride the archive without flapping the gate
+    assert metric_direction("tokens_per_s_info") == 0
+    assert metric_direction("itl_p99_ms_info") == 0
+    assert metric_direction("shed_rate_info") == 0
+    assert metric_direction("tokens_per_s_speedup_info") == 0
     assert metric_direction("completed") == 0       # informational
     assert metric_direction("jit_traces") == 0
     assert metric_direction("step_traces") == 0
+    assert metric_direction("kv_pool_blocks") == 0
+    assert metric_direction("block_allocs") == 0
+
+
+def test_capacity_metrics_gate_both_directions():
+    """The lm_paged_kv capacity surface rides the standing gate: fewer
+    concurrent sequences (or more KV bytes per sequence) at the same
+    budget is a regression, improvements never flag."""
+    base = _line(lm_paged_kv={"paged": {"capacity_seqs": 12.0,
+                                        "kv_bytes_per_seq": 40000.0}})
+    worse = _line(lm_paged_kv={"paged": {"capacity_seqs": 6.0,
+                                         "kv_bytes_per_seq": 80000.0}})
+    names = {r["metric"] for r in compare(base, worse)[0]}
+    assert names == {"lm_paged_kv.paged.capacity_seqs",
+                     "lm_paged_kv.paged.kv_bytes_per_seq"}
+    better = _line(lm_paged_kv={"paged": {"capacity_seqs": 24.0,
+                                          "kv_bytes_per_seq": 20000.0}})
+    assert compare(base, better)[0] == []
 
 
 def test_flatten_skips_dashboard_archive():

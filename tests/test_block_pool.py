@@ -1,0 +1,114 @@
+"""Paged-KV block allocator: the books must stay honest under churn.
+
+The paged decode engine (docs/SERVING.md, "Paged KV cache") trusts
+``serving/block_pool.py`` for one thing: block ids handed out are live
+until freed, freed exactly once, and never the scratch sentinel. A leak
+or double-allocation here silently corrupts a NEIGHBORING sequence's KV
+cache (two block tables pointing at one physical block), which no
+engine-level oracle test is guaranteed to catch — so the allocator
+invariants get their own property test.
+"""
+
+import numpy as np
+import pytest
+
+
+def _pool(n=16, bs=4, name=""):
+    from multiverso_tpu.serving.block_pool import BlockPool
+
+    return BlockPool(n, bs, name=name)
+
+
+def test_alloc_free_roundtrip_and_ids():
+    from multiverso_tpu.serving.block_pool import SCRATCH_BLOCK
+
+    pool = _pool(n=8)
+    got = pool.alloc(8)
+    assert sorted(got) == list(range(1, 9))      # 0 is scratch, never issued
+    assert SCRATCH_BLOCK not in got
+    assert pool.n_free == 0 and pool.n_live == 8
+    pool.free(got)
+    assert pool.n_free == 8 and pool.n_live == 0
+    pool.check()
+
+
+def test_over_alloc_and_double_free_raise():
+    pool = _pool(n=4)
+    blocks = pool.alloc(3)
+    assert not pool.can_alloc(2)
+    with pytest.raises(RuntimeError):
+        pool.alloc(2)
+    pool.check()                                 # failed alloc took nothing
+    pool.free(blocks[:1])
+    with pytest.raises(RuntimeError):
+        pool.free(blocks[:1])                    # double-free
+    with pytest.raises(RuntimeError):
+        pool.free([0])                           # scratch was never live
+    pool.check()
+
+
+def test_sizing_helpers():
+    from multiverso_tpu.serving.block_pool import (blocks_for_bytes,
+                                                   kv_bytes_per_block)
+
+    pool = _pool(n=16, bs=4)
+    assert pool.blocks_needed(1) == 1
+    assert pool.blocks_needed(4) == 1
+    assert pool.blocks_needed(5) == 2
+    assert pool.covers(64) and not pool.covers(65)
+    per = kv_bytes_per_block(n_layers=2, d_model=32, block_size=4)
+    assert per == 2 * 2 * 4 * 32 * 4             # K+V, f32
+    # a budget of (n+1) blocks' bytes buys n usable (scratch rides along)
+    assert blocks_for_bytes(5 * per, 2, 32, 4) == 4
+    # a budget too small for scratch + 1 block must FAIL, not return the
+    # 0 that kv_pool_blocks reads as "auto-size" (a silent overshoot)
+    with pytest.raises(ValueError):
+        blocks_for_bytes(per - 1, 2, 32, 4)
+    with pytest.raises(ValueError):
+        blocks_for_bytes(2 * per - 1, 2, 32, 4)
+
+
+def test_property_randomized_churn_no_leak_no_double_alloc():
+    """Randomized admit/free churn: after every operation the free and
+    live sets partition the capacity exactly, no id is issued twice
+    while live, and every free list entry is a real block id."""
+    rng = np.random.default_rng(0)
+    pool = _pool(n=24)
+    live: dict = {}                              # seq id -> blocks
+    next_seq = 0
+    for _ in range(500):
+        if live and (rng.random() < 0.45 or not pool.can_alloc(1)):
+            seq = list(live)[int(rng.integers(0, len(live)))]
+            pool.free(live.pop(seq))
+        else:
+            n = int(rng.integers(1, 6))
+            if not pool.can_alloc(n):
+                with pytest.raises(RuntimeError):
+                    pool.alloc(n)
+                continue
+            blocks = pool.alloc(n)
+            assert len(set(blocks)) == n
+            for held in live.values():           # no double-allocation
+                assert not set(blocks) & set(held)
+            live[next_seq] = blocks
+            next_seq += 1
+        pool.check()
+        assert pool.n_live == sum(len(b) for b in live.values())
+    for blocks in live.values():
+        pool.free(blocks)
+    pool.check()
+    assert pool.n_free == pool.capacity
+    assert pool.allocs == pool.frees             # fully drained: no leak
+
+
+def test_occupancy_metrics_registered():
+    from multiverso_tpu.dashboard import Dashboard
+
+    pool = _pool(n=6, name="t_bp")
+    blocks = pool.alloc(4)
+    assert Dashboard.stats("KV_BLOCKS_FREE[t_bp]") == {"value": 2.0}
+    assert Dashboard.stats("KV_BLOCKS_LIVE[t_bp]") == {"value": 4.0}
+    pool.free(blocks[:1])
+    assert Dashboard.stats("KV_BLOCKS_LIVE[t_bp]") == {"value": 3.0}
+    assert Dashboard.stats("BLOCK_ALLOC[t_bp]") == {"value": 4}
+    assert Dashboard.stats("BLOCK_FREE[t_bp]") == {"value": 1}

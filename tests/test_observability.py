@@ -310,11 +310,13 @@ def test_traced_decode_request_end_to_end(mv_session, traced, tmp_path):
         admits = [s for s in tree if s.name == "decode.admit"]
         assert len(admits) == 1
         # admission explains itself: slot, its schedule (chunk count +
-        # budget for the default chunked admission) and the pinned
+        # budget for the default chunked admission), the paged-KV
+        # reservation (blocks held + pool free at admit) and the pinned
         # snapshot version — which must match the reply's
         a = admits[0].attrs
-        assert {"slot", "chunks", "budget",
+        assert {"slot", "chunks", "budget", "blocks", "pool_free",
                 "snapshot_version", "prompt_len"} <= set(a)
+        assert a["blocks"] >= 1
         # every chunk of the admission is its own span under the same
         # trace, and their count is what the admit span claims
         chunks = [s for s in tree if s.name == "decode.prefill_chunk"]

@@ -8,8 +8,9 @@ exit code says whether any tracked metric regressed past its threshold
 Direction is metric-aware: throughput-like metrics (``qps``,
 ``tokens_per_s``, ``speedup_*``) regress DOWN, latency/overload-like
 metrics (``*_ms``, ``shed_rate``) regress UP. Everything else
-(``completed``, ``jit_traces``, trace counts, ...) is informational
-and never gates. Thresholds are relative: a metric regresses when it
+(``completed``, ``jit_traces``, trace counts, and anything suffixed
+``_info`` — the bench-side escape hatch for measured-but-noisy
+columns) is informational and never gates. Thresholds are relative: a metric regresses when it
 is more than ``--tolerance`` (default 25%, sized for CI-container
 noise) worse than the baseline; ``--metric NAME=TOL`` overrides the
 tolerance for one metric name (applies wherever that name appears),
@@ -34,13 +35,25 @@ import json
 import sys
 from typing import Dict, List, Tuple
 
-# metric-name suffix/prefix rules deciding gating direction
-_HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio")
-_LOWER_BETTER = ("_ms", "shed_rate")
+# metric-name suffix/prefix rules deciding gating direction.
+# capacity_seqs / kv_bytes_per_seq are the paged-KV capacity metrics
+# (serving_bench's lm_paged_kv A/B): concurrent sequences held at a
+# fixed KV-bytes budget regress DOWN, bytes paid per held sequence
+# regress UP — the standing gate covers capacity, not just latency.
+_HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
+                  "capacity_seqs")
+_LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq")
 
 
 def metric_direction(name: str) -> int:
-    """+1 higher-is-better, -1 lower-is-better, 0 informational."""
+    """+1 higher-is-better, -1 lower-is-better, 0 informational.
+
+    An ``_info`` suffix ALWAYS means informational, overriding the
+    pattern rules: benches use it for measured-but-noisy columns (e.g.
+    the paged-KV A/B's saturated tok/s and noise-floor latencies) that
+    must ride the archive without flapping the standing gate."""
+    if name.endswith("_info"):
+        return 0
     for pat in _HIGHER_BETTER:
         if name == pat or name.startswith(pat) or name.endswith(pat):
             return 1

@@ -26,6 +26,14 @@ vs monolithic whole-prompt prefill — chunking must cut ITL p99 by >= 2x
 (``tokens_per_s_ratio``); ``tools/bench_compare.py`` diffs two bench
 lines and gates regressions on exactly these numbers.
 
+A third decode A/B (``lm_paged_kv``) prices the CACHE LAYOUT: paged
+(block pool + block tables) vs contiguous slot strips at an EQUAL
+KV-bytes budget on a zipf/Poisson burst trace. The paged side must
+hold >= 2x the concurrent sequences (``capacity_seqs``) or deliver
+>= 1.5x useful tok/s at a lower shed rate; ``kv_bytes_per_seq`` and
+``capacity_seqs`` ride the bench_compare gate with direction-aware
+thresholds.
+
 The JSON line also archives the FULL ``Dashboard.snapshot()`` (every
 Monitor/Histogram/Gauge/Counter), so a bench run preserves the complete
 instrument state — not just the hand-picked fields above — and
@@ -94,17 +102,19 @@ def _closed_loop(server, model: str, payload_fn, duration_s: float,
 
 
 def _decode_trace(n: int, seed: int, max_prompt: int, max_new_cap: int,
-                  mean_gap_s: float, vocab: int):
+                  mean_gap_s: float, vocab: int, min_new: int = 0):
     """Mixed-length arrival trace: Poisson arrivals (exponential gaps),
     uniform prompt lengths, zipf-distributed output lengths clipped to
-    the cap — most requests want a few tokens, a heavy tail wants many."""
+    the cap — most requests want a few tokens, a heavy tail wants many.
+    ``min_new`` floors the generation lengths (the capacity A/B wants
+    sequences that LIVE for a while, so concurrency can build)."""
     rng = np.random.default_rng(seed)
     trace, t = [], 0.0
     for _ in range(n):
         t += float(rng.exponential(mean_gap_s))
         plen = int(rng.integers(1, max_prompt + 1))
         prompt = rng.integers(1, vocab, plen).astype(np.int32)
-        n_new = int(min(max_new_cap, rng.zipf(1.6)))
+        n_new = int(min(max_new_cap, min_new + rng.zipf(1.6)))
         trace.append((t, prompt, n_new))
     return trace
 
@@ -157,7 +167,12 @@ def _play_decode_trace(server, model: str, trace, per_request_max_new: bool):
             try:
                 futs.append(server.submit(model, payload))
                 break
-            except OverloadedError:
+            except OverloadedError as exc:
+                # a KV-pool shed is PERMANENT (prompt + max_new can never
+                # fit the pool): retrying would spin forever — that's a
+                # bench-geometry bug, surface it instead
+                if getattr(exc, "what", "") == "kv block pool":
+                    raise
                 time.sleep(0.001)
     results = [f.result(timeout=300) for f in futs]
     return results, time.monotonic() - t0
@@ -286,6 +301,95 @@ def _chunked_prefill_ab(server, lm_model, quick: bool) -> dict:
     }
 
 
+def _paged_kv_ab(server, lm_model, quick: bool) -> dict:
+    """Paged-vs-contiguous KV cache at an EQUAL device-KV-bytes budget.
+
+    Both engines serve the same zipf/Poisson burst trace with the same
+    model and the same KV memory: the contiguous side gets
+    ``contig_slots`` worst-case ``[T, D]`` strips, the paged side the
+    byte-equivalent block pool (``contig_slots * T / block_size`` usable
+    blocks, +1 scratch block of overhead) spread over 4x the slots.
+    Short sequences hold only their reservation, so the paged engine
+    packs several times more CONCURRENT sequences into the identical
+    bytes — ``capacity_seqs`` (peak live sequences) and
+    ``kv_bytes_per_seq`` are the headline metrics, with useful tok/s
+    and shed rate saying what the extra concurrency buys. Throughput/
+    capacity-led by design: on the 2-CPU CI container ITL percentiles
+    sit on the ~50 ms scheduling-noise floor, so the latency columns
+    here are informational only (and this section still runs before the
+    closed-loop phase fills the box with client threads).
+    """
+    from multiverso_tpu.serving import kv_bytes_per_block
+
+    max_prompt, cap, block_size = 32, 64, 8
+    T = max_prompt + cap
+    contig_slots = 4
+    pool_blocks = contig_slots * (T // block_size)   # byte-equal budget
+    kv_bytes = pool_blocks * kv_bytes_per_block(
+        lm_model.config.n_layers, lm_model.config.d_model, block_size)
+    n = 32 if quick else 64
+    # near-simultaneous arrivals of long-lived generations: offered
+    # concurrency far exceeds the contiguous slot count, so the A/B
+    # measures what the layouts do when the KV budget is the bottleneck
+    trace = _decode_trace(n, seed=13, max_prompt=max_prompt,
+                          max_new_cap=cap, mean_gap_s=0.001,
+                          vocab=lm_model.config.vocab_size, min_new=16)
+    useful = sum(n_new for _, _, n_new in trace)
+
+    rows = {}
+    for label, kv in (("paged", dict(slots=4 * contig_slots,
+                                     kv_block_size=block_size,
+                                     kv_pool_blocks=pool_blocks)),
+                      ("contiguous", dict(slots=contig_slots,
+                                          kv_block_size=0))):
+        engine = server.register_decoder(
+            f"lm_pg_{label}", lm_model, max_prompt=max_prompt, max_new=cap,
+            max_queue=24, prompt_buckets=(max_prompt,), **kv)
+        engine.warmup()
+        _play_decode_trace(server, f"lm_pg_{label}",
+                           [(0.0, np.ones(4, np.int32), 2)] * 4, True)
+        engine.reset_stats()
+        _, elapsed = _play_decode_trace(server, f"lm_pg_{label}", trace,
+                                        True)
+        s = engine.stats()
+        cap_seqs = max(1, s["peak_live_seqs"])
+        # only the CAPACITY metrics carry gate-matching names here; the
+        # throughput/latency/shed columns are measured-but-informational
+        # (the "_info" suffix keeps them out of bench_compare's
+        # direction rules): both engines run this burst saturated on a
+        # 2-CPU box whose step wall is ~linear in slots, so those
+        # numbers swing 2x run-to-run — gating them would make the
+        # standing gate flap on scheduler noise
+        rows[label] = {
+            "capacity_seqs": s["peak_live_seqs"],
+            "kv_bytes_budget": kv_bytes,
+            "kv_bytes_per_seq": round(kv_bytes / cap_seqs, 1),
+            "tokens_per_s_info": round(useful / elapsed, 1),
+            "shed_rate_info": round(s["shed_rate"], 4),
+            "slot_occupancy": round(s["slot_occupancy"], 3),
+            "ttft_p50_ms_info": round(s["ttft_p50_ms"], 3),
+            "itl_p99_ms_info": round(s["itl_p99_ms"], 3),
+            "step_traces": s["step_traces"],
+        }
+        if s["kv_block_size"]:                   # archive block-pool stats
+            rows[label].update({k: s[k] for k in (
+                "kv_block_size", "kv_pool_blocks", "kv_blocks_free",
+                "kv_blocks_live", "block_allocs", "block_frees")})
+    pg, ct = rows["paged"], rows["contiguous"]
+    return {
+        "requests": n,
+        "useful_tokens": useful,
+        "paged": pg,
+        "contiguous": ct,
+        "capacity_ratio": (round(pg["capacity_seqs"]
+                                 / ct["capacity_seqs"], 2)
+                           if ct["capacity_seqs"] else float("inf")),
+        "tokens_per_s_speedup_info": (
+            round(pg["tokens_per_s_info"] / ct["tokens_per_s_info"], 2)
+            if ct["tokens_per_s_info"] else float("inf")),
+    }
+
+
 def _warm(workload, snap_mgr, buckets) -> None:
     """Compile every bucket outside the timed loop (and outside the
     latency histogram)."""
@@ -358,6 +462,13 @@ def run(duration_s: float = 2.0, clients: int = 32,
                                   n_layers=2, d_ff=768, max_seq=448)
     out["workloads"]["lm_chunked_prefill"] = _chunked_prefill_ab(
         server, TransformerLM(chunk_cfg), quick)
+    # paged-KV capacity A/B second: throughput/capacity-led (robust to
+    # scheduling noise) but still cleaner before the closed-loop phase
+    # saturates the box; equal KV bytes, 4x slots on the paged side
+    paged_cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                                  n_layers=2, d_ff=256, max_seq=112)
+    out["workloads"]["lm_paged_kv"] = _paged_kv_ab(
+        server, TransformerLM(paged_cfg), quick)
     for name, (workload, knobs, n_clients, payload_fn) in specs.items():
         server.register(name, workload, **knobs)
         server.register(f"{name}_b1", workload, max_batch=1,

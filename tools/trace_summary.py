@@ -168,6 +168,14 @@ def request_report(spans, device_events=None):
         for col, names in _STAGE_COLUMNS:
             row[col] = sum(s["dur"] for s in group
                            if s["name"] in names) / 1e3
+        # paged-KV admissions annotate their reservation: blocks held
+        # and the pool's free count at admit time — a fat queue_ms next
+        # to a small pool_free says the request waited for BLOCKS, not
+        # for a slot
+        admits = [s for s in group if s["name"] == "decode.admit"]
+        if admits and "blocks" in admits[0]["args"]:
+            row["blocks"] = admits[0]["args"]["blocks"]
+            row["pool_free"] = admits[0]["args"].get("pool_free")
         if device:
             w0, w1 = root["ts"], root["ts"] + root["dur"]
             row["device_ms"] = sum(
@@ -181,9 +189,12 @@ def print_request_report(rows, top: int, sort: str) -> None:
            "device": "device_ms"}.get(sort, "total_ms")
     rows = sorted(rows, key=lambda r: r.get(key, 0.0), reverse=True)
     has_dev = any("device_ms" in r for r in rows)
+    has_blocks = any("blocks" in r for r in rows)
     print(f"{len(rows)} request(s); slowest by {key}:")
     hdr = (f"{'total':>9} {'queue':>8} {'admit':>8} {'prefill':>8} "
            f"{'exec':>8} {'decode':>8} {'iters':>6}")
+    if has_blocks:
+        hdr += f" {'blocks':>7} {'pfree':>6}"
     if has_dev:
         hdr += f" {'device':>9}"
     print(hdr + "  trace_id [model]")
@@ -192,6 +203,9 @@ def print_request_report(rows, top: int, sort: str) -> None:
                 f"{r['admit_ms']:8.3f} {r.get('prefill_ms', 0.0):8.3f} "
                 f"{r['exec_ms']:8.3f} "
                 f"{r['decode_ms']:8.3f} {r['iters']:6d}")
+        if has_blocks:
+            line += (f" {str(r.get('blocks', '-')):>7} "
+                     f"{str(r.get('pool_free', '-')):>6}")
         if has_dev:
             line += f" {r.get('device_ms', 0.0):9.3f}"
         # non-request roots (snapshot.pin, table.add, bus.publish) label
