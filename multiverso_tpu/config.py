@@ -247,3 +247,52 @@ define_string("metrics_jsonl", "",
               "interval deltas) to this file while the session runs")
 define_float("metrics_interval_s", 10.0,
              "reporting period for -metrics_jsonl")
+define_bool("trace_tail", False,
+            "tail-based trace sampling: buffer spans per trace id and, at "
+            "request completion, retain the full tree only for SLO-breaching "
+            "(-trace_slo_ms), errored/shed, or 1-in-N (-trace_head_n) "
+            "requests — cheap enough to leave -trace on under load")
+define_float("trace_slo_ms", 250.0,
+             "tail sampling: retain any trace whose root span exceeded this "
+             "latency (the per-request SLO); 0 disables the latency trigger")
+define_int("trace_head_n", 64,
+           "tail sampling: additionally keep 1 in N completed traces as a "
+           "healthy-baseline head sample (0 = keep anomalies only)")
+define_bool("flight_recorder", True,
+            "decode engine: always-on bounded ring of per-iteration records "
+            "(iteration wall, slots, queue depth/age, token split, pool "
+            "occupancy, snapshot version) — the black box the watchdog "
+            "dumps and tools/engine_timeline.py renders")
+define_int("flight_recorder_capacity", 4096,
+           "flight-recorder ring capacity in iterations (oldest records "
+           "are overwritten past it)")
+define_bool("watchdog", True,
+            "decode engine: self-diagnosis thread detecting engine stall, "
+            "admission-queue age breach, and block-pool accounting drift; "
+            "a trip increments WATCHDOG_TRIPS[engine] and dumps a "
+            "diagnostic bundle to -debug_dump_dir")
+define_float("watchdog_interval_s", 0.25,
+             "watchdog poll period (trip latency is at most ~2 polls past "
+             "the configured deadline)")
+define_float("watchdog_stall_s", 10.0,
+             "watchdog: trip when the engine makes no iteration progress "
+             "for this long while sequences are live (sized well above "
+             "any first-admission jit compile)")
+define_float("watchdog_queue_age_s", 30.0,
+             "watchdog: trip when the oldest queued request has waited "
+             "this long without admission; 0 disables")
+define_string("debug_dump_dir", "",
+              "watchdog trip bundles (flight-recorder ring + engine stats "
+              "+ dashboard snapshot + all-thread stacks) land in per-trip "
+              "subdirectories here; empty = trip still counts and logs, "
+              "no bundle")
+define_float("slo_ttft_ms", 0.0,
+             "serving SLO: p99 time-to-first-token target per decoder "
+             "(rolling-window burn status in Dashboard.snapshot()); "
+             "0 = no SLO registered")
+define_float("slo_itl_ms", 0.0,
+             "serving SLO: p99 inter-token-latency target per decoder; "
+             "0 = no SLO registered")
+define_float("slo_lat_ms", 0.0,
+             "serving SLO: p99 enqueue-to-reply latency target per "
+             "micro-batched model; 0 = no SLO registered")

@@ -157,6 +157,21 @@ class Histogram:
     def percentile(self, p: float) -> float:
         return self.percentiles((p,))[p]
 
+    def window_stats(self, p: float, threshold_ms: float, window=None):
+        """``(window n, pXX, fraction of window above threshold)`` in ONE
+        sort — the SLO tracker's read (a separate percentile + breach
+        scan would pay two sorts and could straddle a wrap). Pass a
+        ``window`` (an already-sorted sample list, e.g. the one
+        ``Dashboard.snapshot()`` just paid for this histogram's own
+        summary row) to skip the copy-under-lock + re-sort entirely."""
+        import bisect
+
+        data = self._window()[1] if window is None else window
+        if not data:
+            return 0, 0.0, 0.0
+        frac = 1.0 - bisect.bisect_right(data, threshold_ms) / len(data)
+        return len(data), self._rank(data, p), frac
+
     def summary(self) -> Dict[str, float]:
         """count + nearest-rank p50/p95/p99 + mean/max over the window.
 
@@ -167,18 +182,24 @@ class Histogram:
         read under ONE lock acquisition so the summary is internally
         consistent even while ``record`` hammers concurrently.
         """
-        count, data = self._window()
+        return self._summarize(*self._window())[0]
+
+    def _summarize(self, count, data):
+        """``(summary dict, sorted window)`` from one ``_window()`` read —
+        ``Dashboard.snapshot()`` hands the window on to this histogram's
+        SLO row so the pair shares one copy+sort AND describes the same
+        samples."""
         if not data:
-            return {"count": count, "p50_ms": 0.0, "p95_ms": 0.0,
-                    "p99_ms": 0.0, "mean_ms": 0.0, "max_ms": 0.0}
-        return {
+            return ({"count": count, "p50_ms": 0.0, "p95_ms": 0.0,
+                     "p99_ms": 0.0, "mean_ms": 0.0, "max_ms": 0.0}, data)
+        return ({
             "count": count,
             "p50_ms": self._rank(data, 50),
             "p95_ms": self._rank(data, 95),
             "p99_ms": self._rank(data, 99),
             "mean_ms": sum(data) / len(data),
             "max_ms": data[-1],
-        }
+        }, data)
 
     def info_string(self) -> str:
         s = self.summary()
@@ -246,6 +267,51 @@ class Counter:
         return f"[{self.name}] total = {self.get()}"
 
 
+class SLO:
+    """Windowed latency objective over a registered :class:`Histogram`.
+
+    ``source`` names the histogram (``SERVE_TTFT[lm]``); the objective is
+    "the windowed p<percentile> stays under ``target_ms``". ``summary()``
+    reports the current percentile, the fraction of the window breaching
+    the target, and the **burn rate** — breach fraction over the error
+    budget ``1 - percentile/100`` (burn > 1 means the tail is eating its
+    budget faster than allowed; the SRE alarm convention). Rolling by
+    construction: the histogram window ages old traffic out, so burn
+    tracks the CURRENT regime, not the lifetime average.
+    """
+
+    def __init__(self, source: str, target_ms: float,
+                 percentile: float = 99.0, register: bool = True) -> None:
+        self.source = source
+        self.target_ms = float(target_ms)
+        self.percentile = float(percentile)
+        self.name = f"SLO_P{percentile:g}[{source}]"
+        if register:
+            Dashboard.add_slo(self)
+
+    def summary(self, window=None) -> Dict[str, float]:
+        hist = Dashboard.get_or_create_histogram(self.source)
+        n, value, frac = hist.window_stats(self.percentile, self.target_ms,
+                                           window=window)
+        budget = max(1.0 - self.percentile / 100.0, 1e-9)
+        return {
+            "target_ms": self.target_ms,
+            "percentile": self.percentile,
+            "window": n,
+            "value_ms": value,
+            "breach_frac": frac,
+            "burn": frac / budget,
+            "ok": 0 if (n and value > self.target_ms) else 1,
+        }
+
+    def info_string(self) -> str:
+        s = self.summary()
+        state = "OK" if s["ok"] else "BURNING"
+        return (f"[{self.name}] p{self.percentile:g} = {s['value_ms']:.3f} "
+                f"ms target = {self.target_ms:.3f} ms burn = "
+                f"{s['burn']:.2f} ({state})")
+
+
 class Dashboard:
     """Process-global monitor registry (reference ``dashboard.h:16-24``)."""
 
@@ -253,6 +319,10 @@ class Dashboard:
     _histograms: Dict[str, "Histogram"] = {}
     _gauges: Dict[str, "Gauge"] = {}
     _counters: Dict[str, "Counter"] = {}
+    _slos: Dict[str, "SLO"] = {}
+    # running reporter/watchdog threads (anything with .detach());
+    # reset() stops them so tests can't leak threads across each other
+    _reporters: List[Any] = []
     _lock = threading.Lock()
 
     @classmethod
@@ -274,6 +344,39 @@ class Dashboard:
     def add_counter(cls, counter: "Counter") -> None:
         with cls._lock:
             cls._counters[counter.name] = counter
+
+    @classmethod
+    def add_slo(cls, slo: "SLO") -> None:
+        with cls._lock:
+            cls._slos[slo.name] = slo
+
+    @classmethod
+    def set_slo(cls, source: str, target_ms: float,
+                percentile: float = 99.0) -> "SLO":
+        """Declare (or re-target) a latency objective over histogram
+        ``source``; its burn status rides every ``snapshot()``."""
+        name = f"SLO_P{percentile:g}[{source}]"
+        with cls._lock:
+            slo = cls._slos.get(name)
+        if slo is None:
+            slo = SLO(source, target_ms, percentile)
+        else:
+            slo.target_ms = float(target_ms)
+        return slo
+
+    @classmethod
+    def attach_reporter(cls, reporter: Any) -> None:
+        """Track a running reporter thread (MetricsExporter, watchdog);
+        ``reset()`` detaches and stops whatever is still attached."""
+        with cls._lock:
+            if reporter not in cls._reporters:
+                cls._reporters.append(reporter)
+
+    @classmethod
+    def detach_reporter(cls, reporter: Any) -> None:
+        with cls._lock:
+            if reporter in cls._reporters:
+                cls._reporters.remove(reporter)
 
     @classmethod
     def get_or_create_histogram(cls, name: str) -> "Histogram":
@@ -318,7 +421,8 @@ class Dashboard:
         not "not monitored" (it used to check Monitors only)."""
         with cls._lock:
             inst = (cls._monitors.get(name) or cls._histograms.get(name)
-                    or cls._gauges.get(name) or cls._counters.get(name))
+                    or cls._gauges.get(name) or cls._counters.get(name)
+                    or cls._slos.get(name))
         return inst.info_string() if inst else f"[{name}] not monitored"
 
     @classmethod
@@ -328,6 +432,7 @@ class Dashboard:
             hist = cls._histograms.get(name)
             gauge = cls._gauges.get(name)
             counter = cls._counters.get(name)
+            slo = cls._slos.get(name)
         if mon is not None:
             return {"count": mon.count, "total_ms": mon.total_ms,
                     "avg_ms": mon.average_ms()}
@@ -337,6 +442,8 @@ class Dashboard:
             return {"value": gauge.get()}
         if counter is not None:
             return {"value": counter.get()}
+        if slo is not None:
+            return slo.summary()
         return None
 
     @classmethod
@@ -353,16 +460,25 @@ class Dashboard:
             histograms = list(cls._histograms.values())
             gauges = list(cls._gauges.values())
             counters = list(cls._counters.values())
+            slos = list(cls._slos.values())
         out: Dict[str, Dict[str, Any]] = {}
         for m in monitors:
             out[m.name] = {"type": "monitor", "count": m.count,
                            "total_ms": m.total_ms, "avg_ms": m.average_ms()}
+        windows: Dict[str, list] = {}
         for h in histograms:
-            out[h.name] = {"type": "histogram", **h.summary()}
+            summary, windows[h.name] = h._summarize(*h._window())
+            out[h.name] = {"type": "histogram", **summary}
         for g in gauges:
             out[g.name] = {"type": "gauge", "value": g.get()}
         for c in counters:
             out[c.name] = {"type": "counter", "value": c.get()}
+        for s in slos:
+            # reuse the source histogram's sorted window: one copy+sort
+            # per histogram per snapshot, and the SLO row describes the
+            # SAME samples as the histogram row above it
+            out[s.name] = {"type": "slo",
+                           **s.summary(window=windows.get(s.source))}
         return out
 
     @classmethod
@@ -372,11 +488,13 @@ class Dashboard:
             histograms = list(cls._histograms.values())
             gauges = list(cls._gauges.values())
             counters = list(cls._counters.values())
+            slos = list(cls._slos.values())
         lines = ["--------------Dashboard--------------"]
         lines += [m.info_string() for m in monitors]
         lines += [h.info_string() for h in histograms]
         lines += [g.info_string() for g in gauges]
         lines += [c.info_string() for c in counters]
+        lines += [s.info_string() for s in slos]
         text = "\n".join(lines)
         if emit is None:
             from .log import Log
@@ -386,11 +504,27 @@ class Dashboard:
 
     @classmethod
     def reset(cls) -> None:
+        """Drop every instrument AND stop any attached reporter thread
+        (MetricsExporter, engine watchdogs): a test that resets the
+        dashboard must not inherit a prior test's reporter still
+        snapshotting (or a watchdog still polling a dead engine).
+        Reporters are popped under the lock but stopped OUTSIDE it —
+        their threads may be mid-``snapshot()`` and need the lock to
+        finish before they can join."""
         with cls._lock:
             cls._monitors.clear()
             cls._histograms.clear()
             cls._gauges.clear()
             cls._counters.clear()
+            cls._slos.clear()
+            reporters = list(cls._reporters)
+            cls._reporters.clear()
+        for reporter in reporters:
+            try:
+                reporter.detach()
+            except Exception as exc:    # pragma: no cover - defensive
+                from .log import Log
+                Log.error("dashboard reset: reporter detach failed: %s", exc)
 
 
 @contextmanager
@@ -642,6 +776,7 @@ class MetricsExporter:
         self._thread = threading.Thread(
             target=self._loop, name="mv-metrics", daemon=True)
         self._thread.start()
+        Dashboard.attach_reporter(self)
         return self
 
     def _loop(self) -> None:
@@ -652,11 +787,18 @@ class MetricsExporter:
                 from .log import Log
                 Log.error("metrics exporter: report failed: %s", exc)
 
+    def detach(self) -> None:
+        """``Dashboard.reset()`` hook: stop WITHOUT a final report (the
+        instruments were just cleared; archiving an empty snapshot over
+        the sink's real data would only confuse the reader)."""
+        self.stop(final_report=False)
+
     def stop(self, final_report: bool = True) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        Dashboard.detach_reporter(self)
         if final_report:
             try:
                 self.report_once()

@@ -76,7 +76,13 @@ class Session:
                 # enable() resets the span ring; the not-enabled() guard
                 # keeps a redundant init from wiping a live collector
                 if not trace.enabled():
-                    trace.enable(int(config.get_flag("trace_buffer")))
+                    tail = None
+                    if config.get_flag("trace_tail"):
+                        tail = trace.TailConfig(
+                            slo_ms=float(config.get_flag("trace_slo_ms")),
+                            head_n=int(config.get_flag("trace_head_n")))
+                    trace.enable(int(config.get_flag("trace_buffer")),
+                                 tail=tail)
             metrics_path = config.get_flag("metrics_jsonl")
             if metrics_path and self.metrics_exporter is None:
                 # started only once init validation passed: a failed

@@ -21,14 +21,21 @@ The inference half of the train/serve stack (docs/SERVING.md). Pieces:
   prefill under a per-iteration token budget
   (``prefill_token_budget``) so admissions never stall in-flight
   generations for more than one chunk of work.
+* the black box — :class:`FlightRecorder` (always-on bounded ring of
+  per-iteration engine records) and :class:`EngineWatchdog`
+  (stall/leak/queue-age self-diagnosis; trips dump a diagnostic bundle
+  to ``-debug_dump_dir`` and count in ``WATCHDOG_TRIPS``), so a wedged
+  or leaking engine produces evidence instead of silence.
 """
 
 from .batcher import (BatcherConfig, MicroBatcher, OverloadedError,
                       bucket_for, shape_buckets)
 from .block_pool import BlockPool, blocks_for_bytes, kv_bytes_per_block
 from .decode_engine import DecodeEngine, DecodeEngineConfig
+from .flight_recorder import FlightRecorder
 from .server import InferenceServer
 from .snapshot import Snapshot, SnapshotManager
+from .watchdog import EngineWatchdog, WatchdogConfig
 from .workloads import (EmbeddingNeighbors, FTRLPredict, LMGreedyDecode,
                         LogRegPredict)
 
@@ -37,5 +44,6 @@ __all__ = [
     "shape_buckets", "InferenceServer", "Snapshot", "SnapshotManager",
     "EmbeddingNeighbors", "FTRLPredict", "LMGreedyDecode", "LogRegPredict",
     "DecodeEngine", "DecodeEngineConfig", "BlockPool", "blocks_for_bytes",
-    "kv_bytes_per_block",
+    "kv_bytes_per_block", "FlightRecorder", "EngineWatchdog",
+    "WatchdogConfig",
 ]

@@ -84,10 +84,20 @@ class BatcherConfig:
     deadline_ms: float = 2.0
     max_queue: int = 256
     buckets: Optional[Tuple[int, ...]] = None   # default: shape_buckets()
+    # rolling-window p99 reply-latency SLO registered in the Dashboard
+    # (None = the -slo_lat_ms flag; 0 = no SLO)
+    slo_lat_ms: Optional[float] = None
 
     def resolved_buckets(self) -> Tuple[int, ...]:
         return tuple(self.buckets) if self.buckets else shape_buckets(
             self.max_batch)
+
+    def resolved_slo_lat_ms(self) -> float:
+        if self.slo_lat_ms is not None:
+            return float(self.slo_lat_ms)
+        from ..config import get_flag
+
+        return float(get_flag("slo_lat_ms"))
 
 
 class _Pending:
@@ -127,6 +137,11 @@ class MicroBatcher:
         self._stop = threading.Event()
         # -- stats ----------------------------------------------------------
         self.hist = Dashboard.get_or_create_histogram(f"SERVE_LAT[{name}]")
+        slo_lat = self.config.resolved_slo_lat_ms()
+        if slo_lat > 0:
+            # burn status for this model's reply latency rides every
+            # Dashboard.snapshot() (docs/OBSERVABILITY.md "SLO tracking")
+            Dashboard.set_slo(f"SERVE_LAT[{name}]", slo_lat)
         self.shed_counter = Dashboard.get_or_create_counter(
             f"SERVE_SHED[{name}]")
         self.completed = 0

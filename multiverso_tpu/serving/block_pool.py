@@ -37,7 +37,7 @@ into the equivalent block count.
 from __future__ import annotations
 
 import threading
-from typing import Iterable, List
+from typing import Iterable, List, Optional
 
 import numpy as np
 
@@ -181,14 +181,30 @@ class BlockPool:
                 "frees": self.frees,
             }
 
-    def check(self) -> None:
-        """Invariant check (tests): free + live == capacity, disjoint."""
+    def drift(self) -> Optional[str]:
+        """Invariant scan -> violation description, or None when the
+        books balance. The watchdog's poll entry point: unlike
+        :meth:`check` it never raises (and never depends on ``assert``
+        surviving ``-O``), so a corrupted pool yields a diagnosis
+        instead of an exception inside the health thread."""
         with self._lock:
             free = set(self._free)
-            assert len(free) == len(self._free), "duplicate ids in free list"
-            assert not (free & self._live), "id both free and live"
-            assert len(free) + len(self._live) == self.capacity, \
-                f"leak: {len(free)} free + {len(self._live)} live " \
-                f"!= {self.capacity}"
-            assert SCRATCH_BLOCK not in free | self._live, \
-                "scratch block entered circulation"
+            if len(free) != len(self._free):
+                return (f"duplicate ids in free list "
+                        f"({len(self._free)} entries, {len(free)} unique)")
+            both = free & self._live
+            if both:
+                return f"{len(both)} id(s) both free and live: {sorted(both)[:8]}"
+            if len(free) + len(self._live) != self.capacity:
+                return (f"leak: {len(free)} free + {len(self._live)} live "
+                        f"!= capacity {self.capacity}")
+            if SCRATCH_BLOCK in free or SCRATCH_BLOCK in self._live:
+                return "scratch block entered circulation"
+        return None
+
+    def check(self) -> None:
+        """Invariant check (tests): free + live == capacity, disjoint.
+        Raises ``AssertionError`` on the first violation."""
+        msg = self.drift()
+        if msg is not None:
+            raise AssertionError(f"BlockPool: {msg}")

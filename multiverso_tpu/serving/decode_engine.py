@@ -67,6 +67,7 @@ inter-token latency land in histograms (``SERVE_TTFT[name]``,
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
 from concurrent.futures import Future
@@ -82,7 +83,9 @@ from ..dashboard import Dashboard
 from ..log import Log
 from .batcher import OverloadedError, bucket_for, shape_buckets
 from .block_pool import SCRATCH_BLOCK, BlockPool
+from .flight_recorder import FlightRecorder
 from .snapshot import SnapshotManager, replicate_for_decode
+from .watchdog import EngineWatchdog, WatchdogConfig
 from .workloads import _jit_cache_size
 
 
@@ -107,6 +110,26 @@ class DecodeEngineConfig:
     # the contiguous-equivalent capacity slots * ceil(T / block_size))
     kv_block_size: Optional[int] = None
     kv_pool_blocks: Optional[int] = None
+    # black-box layer (None = the matching flag): always-on flight
+    # recorder ring, stall/leak watchdog, trip-bundle target, and the
+    # rolling-window latency SLOs registered in the Dashboard
+    flight_recorder: Optional[bool] = None
+    flight_recorder_capacity: Optional[int] = None
+    watchdog: Optional[bool] = None
+    watchdog_interval_s: Optional[float] = None
+    watchdog_stall_s: Optional[float] = None
+    watchdog_queue_age_s: Optional[float] = None
+    debug_dump_dir: Optional[str] = None
+    slo_ttft_ms: Optional[float] = None
+    slo_itl_ms: Optional[float] = None
+
+    def _resolved(self, field: str, flag: Optional[str] = None):
+        value = getattr(self, field)
+        if value is None:
+            from ..config import get_flag
+
+            value = get_flag(flag or field)
+        return value
 
     def resolved_prompt_buckets(self) -> Tuple[int, ...]:
         if self.prompt_buckets:
@@ -137,14 +160,27 @@ class DecodeEngineConfig:
             n = self.slots * blocks_per_seq
         return int(n)
 
+    def resolved_watchdog_config(self) -> WatchdogConfig:
+        return WatchdogConfig(
+            interval_s=float(self._resolved("watchdog_interval_s")),
+            stall_s=float(self._resolved("watchdog_stall_s")),
+            queue_age_s=float(self._resolved("watchdog_queue_age_s")),
+            dump_dir=str(self._resolved("debug_dump_dir")))
+
+
+# process-unique small request ids: the flight recorder's admitted/
+# completed columns join ring records to requests without holding refs
+_RIDS = itertools.count(1)
+
 
 class _Request:
     __slots__ = ("prompt", "max_new", "future", "t_enq", "t_last",
                  "slot", "out", "version", "ctx", "pf_off", "pf_chunks",
-                 "t_admit", "blocks")
+                 "t_admit", "blocks", "rid")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  ctx: Optional[trace.SpanContext] = None) -> None:
+        self.rid = next(_RIDS)
         self.prompt = prompt
         self.max_new = max_new
         self.future: Future = Future()
@@ -327,6 +363,12 @@ class DecodeEngine:
         # the one admission currently prefilling in chunks (its slot is
         # reserved — excluded from the free pool — but not yet live)
         self._pf: Optional[_Request] = None
+        # monolithic admission in progress: blocks are reserved at
+        # _admit entry but slots go active only after the fused prefill
+        # returns (a cold bucket compiles for SECONDS in between) — the
+        # watchdog's leaked-reservation heuristic must not read that
+        # window as a leak
+        self._admitting = False
         self._q: Deque[_Request] = collections.deque()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -349,6 +391,31 @@ class DecodeEngine:
             f"PREFILL_TOKENS[{name}]")
         self.decode_tok_counter = Dashboard.get_or_create_counter(
             f"DECODE_TOKENS[{name}]")
+        # iteration progress: the counter for dashboards/rates, the local
+        # mirror + monotonic age for stats()/the watchdog's stall check
+        self.iters_counter = Dashboard.get_or_create_counter(
+            f"ENGINE_ITERS[{name}]")
+        self.iters_total = 0
+        self._last_progress = time.monotonic()
+        # rolling-window latency SLOs (burn status in every snapshot())
+        slo_ttft = float(ec._resolved("slo_ttft_ms"))
+        if slo_ttft > 0:
+            Dashboard.set_slo(f"SERVE_TTFT[{name}]", slo_ttft)
+        slo_itl = float(ec._resolved("slo_itl_ms"))
+        if slo_itl > 0:
+            Dashboard.set_slo(f"SERVE_ITL[{name}]", slo_itl)
+        # always-on flight recorder (the loop writes one record per
+        # iteration; pure host state, so it can never add a compiled
+        # trace — the one-trace assertions below it stay at 1)
+        self.recorder: Optional[FlightRecorder] = None
+        if bool(ec._resolved("flight_recorder")):
+            self.recorder = FlightRecorder(
+                int(ec._resolved("flight_recorder_capacity")), name=name)
+        # per-iteration scratch the recorder drains (reused, not realloc'd)
+        self._it_admitted: List[int] = []
+        self._it_completed: List[int] = []
+        self._it_prefill = 0
+        self._it_decode = 0
         self.completed = 0
         self.shed = 0
         self.tokens = 0
@@ -367,6 +434,12 @@ class DecodeEngine:
         self._thread = threading.Thread(
             target=self._loop, name=f"serve-decode-{name}", daemon=True)
         self._thread.start()
+        # the watchdog watches the PUBLIC health surface (health() /
+        # pool_drift()), so it starts after the loop thread exists
+        self.watchdog: Optional[EngineWatchdog] = None
+        if bool(ec._resolved("watchdog")):
+            self.watchdog = EngineWatchdog(
+                self, ec.resolved_watchdog_config())
 
     # -- client side --------------------------------------------------------
     def validate(self, prompt: np.ndarray, max_new: Optional[int]) -> None:
@@ -414,6 +487,51 @@ class DecodeEngine:
         with self._lock:
             return len(self._q)
 
+    def health(self) -> dict:
+        """The watchdog's poll surface: progress, liveness, and queue
+        age WITHOUT the histogram sorts ``stats()`` pays — cheap enough
+        to read several times a second against a saturated engine."""
+        now = time.monotonic()
+        with self._lock:
+            depth = len(self._q)
+            age = (now - self._q[0].t_enq) if self._q else 0.0
+        return {
+            "iters_total": self.iters_total,
+            "last_iter_age_s": now - self._last_progress,
+            # a monolithic admission in flight counts as live: its
+            # requests are already popped from the queue (queue_age_s
+            # reads 0) and no slot is active yet, so without it a
+            # wedged fused prefill would be invisible to the stall check
+            "live_seqs": int(self._active.sum())
+            + (1 if self._pf is not None else 0)
+            + (1 if self._admitting else 0),
+            "active_slots": int(self._active.sum()),
+            "queue_depth": depth,
+            "queue_age_s": age,
+            "stopped": self._stop.is_set(),
+        }
+
+    def pool_drift(self) -> Optional[str]:
+        """Paged-KV accounting sanity: allocator invariant violations,
+        or live blocks held while NOTHING is alive to hold them (no
+        active slot, no admission mid-flight — chunked ``_pf`` or
+        monolithic ``_admitting``, whose cold-bucket compile can hold
+        reservations for seconds — nothing queued). Sampled racily —
+        the watchdog requires the verdict to persist across two polls
+        before tripping."""
+        if not self._paged:
+            return None
+        msg = self._pool.drift()
+        if msg is not None:
+            return msg
+        live_blocks = self._pool.n_live
+        if (live_blocks > 0 and not self._active.any()
+                and self._pf is None and not self._admitting
+                and not self._q):
+            return (f"{live_blocks} live block(s) with zero live "
+                    f"sequences (leaked reservation)")
+        return None
+
     # -- engine loop --------------------------------------------------------
     def _blocks_cover(self, req: _Request, reserved: int) -> bool:
         """Paged-KV admission gate: a request admits only when its WHOLE
@@ -460,6 +578,17 @@ class DecodeEngine:
                             reserved += self._pool.blocks_needed(
                                 len(req.prompt) + req.max_new)
                         arrivals.append(req)
+            # the progress clock restarts when the loop picks work up:
+            # last_iter_age_s then measures how long THIS pass has been
+            # stuck, not how long the engine idled beforehand (an idle
+            # engine is not a stalled one — the watchdog's distinction)
+            t_work0 = time.monotonic()
+            self._last_progress = t_work0
+            self._it_admitted.clear()
+            self._it_completed.clear()
+            self._it_prefill = self._it_decode = 0
+            step_ms = 0.0
+            worked = False
             try:
                 if chunked:
                     if arrivals:
@@ -470,19 +599,57 @@ class DecodeEngine:
                         # the stall an admission can add to every live
                         # generation's next token is one chunk of work
                         self._prefill_one_chunk()
+                        worked = True
                 else:
                     if arrivals:
-                        self._admit(arrivals)
+                        self._admitting = True
+                        try:
+                            self._admit(arrivals)
+                        finally:
+                            self._admitting = False
+                        worked = True
                 live = int(self._active.sum()) + (self._pf is not None)
                 if live > self.peak_live:
                     self.peak_live = live
                 if self._active.any():
+                    t_step0 = time.monotonic()
                     self._step()
+                    step_ms = (time.monotonic() - t_step0) * 1e3
+                    worked = True
             except Exception as exc:          # pragma: no cover - defensive
                 # arrivals are already popped from the queue but may not
                 # be slotted yet — include them so their futures fail too
                 self._fail_all(exc, arrivals)
                 return
+            if worked:
+                self._record_iteration(t_work0, step_ms)
+
+    def _record_iteration(self, t_work0: float, step_ms: float) -> None:
+        """One iteration retired: bump the progress clock/counters and
+        append the flight-recorder record. Reads of queue/pool state are
+        intentionally lock-light — these are gauge samples for the black
+        box, not accounting."""
+        now = time.monotonic()
+        self.iters_total += 1
+        self.iters_counter.inc()
+        self._last_progress = now
+        recorder = self.recorder
+        if recorder is None:
+            return
+        try:
+            oldest = self._q[0].t_enq if self._q else None
+        except IndexError:           # racing a concurrent submit/shed
+            oldest = None
+        recorder.record((
+            self.iters_total, now, (now - t_work0) * 1e3, step_ms,
+            int(self._active.sum()), 1 if self._pf is not None else 0,
+            len(self._q),
+            0.0 if oldest is None else (now - oldest) * 1e3,
+            self._it_prefill, self._it_decode,
+            self._pool.n_free if self._paged else -1,
+            self._pool.n_live if self._paged else -1,
+            self._snap.version if self._snap is not None else -1,
+            tuple(self._it_admitted), tuple(self._it_completed)))
 
     def _maybe_refresh(self) -> None:
         """Move the pinned snapshot only while NO generation is in flight
@@ -541,6 +708,7 @@ class DecodeEngine:
         req.pf_off = 0
         req.pf_chunks = 0
         req.t_admit = time.monotonic()   # queue.wait ends here
+        self._it_admitted.append(req.rid)
         self._pf = req
 
     def _prefill_one_chunk(self) -> None:
@@ -577,6 +745,7 @@ class DecodeEngine:
         req.pf_chunks += 1
         self.prefill_tokens += n
         self.prefill_tok_counter.inc(n)
+        self._it_prefill += n
         final = req.pf_off >= len(req.prompt)
         if tracing and req.ctx is not None:
             trace.record_span(
@@ -593,6 +762,7 @@ class DecodeEngine:
         self.ttft_hist.record((now - req.t_enq) * 1e3)
         self.tokens += 1
         self.decode_tok_counter.inc()
+        self._it_decode += 1
         req.out.append(tok0)
         if tracing and req.ctx is not None:
             trace.record_span("queue.wait", req.ctx, req.t_enq,
@@ -653,6 +823,8 @@ class DecodeEngine:
                     bts[i] = self._block_tables[slot]
                 self.prefill_tokens += len(req.prompt)
                 self.prefill_tok_counter.inc(len(req.prompt))
+                self._it_prefill += len(req.prompt)
+                self._it_admitted.append(req.rid)
             if self._paged:
                 first, self._k_cache, self._v_cache = self._admit_fn(
                     self._pinned, self._k_cache, self._v_cache,
@@ -678,6 +850,7 @@ class DecodeEngine:
                 self.ttft_hist.record((now - req.t_enq) * 1e3)
                 self.tokens += 1
                 self.decode_tok_counter.inc()
+                self._it_decode += 1
                 req.out.append(tok0)
                 if tracing and req.ctx is not None:
                     # the two child spans that explain a slow TTFT: how
@@ -739,6 +912,7 @@ class DecodeEngine:
             req.out.append(tok)
             self.tokens += 1
             self.decode_tok_counter.inc()
+            self._it_decode += 1
             self.itl_hist.record((now - req.t_last) * 1e3)
             req.t_last = now
             if tracing and req.ctx is not None:
@@ -766,6 +940,7 @@ class DecodeEngine:
 
     def _resolve(self, req: _Request) -> None:
         self.completed += 1
+        self._it_completed.append(req.rid)
         if req.future.set_running_or_notify_cancel():
             # staleness measured at REPLY time (the PR 1 contract): the
             # pin can't move while this request is in flight, so _snap IS
@@ -915,8 +1090,16 @@ class DecodeEngine:
                  "block_allocs": self._pool.allocs,
                  "block_frees": self._pool.frees}
                 if self._paged else {"kv_block_size": 0})
+        health = self.health()
         return {
             **pool,
+            "iters_total": health["iters_total"],
+            "last_iter_age_s": health["last_iter_age_s"],
+            "live_seqs": health["live_seqs"],
+            "watchdog_trips": (self.watchdog.trip_count
+                               if self.watchdog is not None else 0),
+            "flight_records": (self.recorder.total
+                               if self.recorder is not None else 0),
             "peak_live_seqs": self.peak_live,
             "completed": self.completed,
             "shed": self.shed,
@@ -940,8 +1123,12 @@ class DecodeEngine:
 
     # -- lifecycle ----------------------------------------------------------
     def stop(self) -> None:
-        """Drain queued + in-flight generations, then retire the loop."""
+        """Drain queued + in-flight generations, then retire the loop
+        (and its watchdog — a watchdog outliving its engine would keep
+        polling a corpse)."""
         with self._cv:
             self._stop.set()
             self._cv.notify_all()
         self._thread.join(timeout=60)
+        if self.watchdog is not None:
+            self.watchdog.stop()

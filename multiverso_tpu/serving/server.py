@@ -112,7 +112,11 @@ class InferenceServer:
                          prompt_buckets: Optional[tuple] = None,
                          prefill_token_budget: Optional[int] = None,
                          kv_block_size: Optional[int] = None,
-                         kv_pool_blocks: Optional[int] = None
+                         kv_pool_blocks: Optional[int] = None,
+                         watchdog: Optional[bool] = None,
+                         debug_dump_dir: Optional[str] = None,
+                         slo_ttft_ms: Optional[float] = None,
+                         slo_itl_ms: Optional[float] = None
                          ) -> DecodeEngine:
         """Attach a continuous-batching decode engine under ``name``.
 
@@ -132,13 +136,23 @@ class InferenceServer:
         submit whose ``prompt + max_new`` can never fit the pool sheds
         with :class:`OverloadedError` (docs/SERVING.md "Paged KV
         cache").
+
+        The black-box layer rides along by default: an always-on
+        flight recorder (``engine.recorder``) and a stall/leak/queue-age
+        watchdog (``watchdog``/``debug_dump_dir`` override the
+        ``-watchdog``/``-debug_dump_dir`` flags); ``slo_ttft_ms``/
+        ``slo_itl_ms`` register rolling-window p99 SLOs whose burn
+        status rides every ``Dashboard.snapshot()``
+        (docs/OBSERVABILITY.md "Flight recorder" / "Watchdog").
         """
         cfg = DecodeEngineConfig(
             slots=slots, max_prompt=max_prompt, max_new=max_new,
             eos_id=eos_id, max_queue=max_queue,
             max_staleness_s=max_staleness_s, prompt_buckets=prompt_buckets,
             prefill_token_budget=prefill_token_budget,
-            kv_block_size=kv_block_size, kv_pool_blocks=kv_pool_blocks)
+            kv_block_size=kv_block_size, kv_pool_blocks=kv_pool_blocks,
+            watchdog=watchdog, debug_dump_dir=debug_dump_dir,
+            slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
         with self._lock:
             if name in self._models:
                 Log.fatal(f"serving: model {name!r} already registered")

@@ -18,6 +18,11 @@ Design constraints, in order:
 * **on = cheap** — finished spans land in a bounded preallocated ring
   (:class:`TraceCollector.record`): one short lock, no I/O, no
   serialization on the request path. Export walks the ring afterwards.
+* **on can stay on** — with tail-based sampling (:class:`TailConfig`,
+  ``-trace_tail``) spans buffer per trace id and only the trees worth
+  keeping survive the request's completion: SLO breaches, errors/sheds,
+  and a 1-in-N head sample. The ring then holds explanations, not
+  traffic, and full tracing is cheap enough for benches and fleets.
 * **causality crosses threads and processes** — the thread-local ambient
   span covers same-thread nesting; a :class:`SpanContext` handoff token
   (``current_context()`` / ``Span.context``) carries (trace id, span id)
@@ -46,9 +51,9 @@ import time
 from typing import Any, Dict, List, NamedTuple, Optional
 
 __all__ = [
-    "Span", "SpanContext", "TraceCollector", "collector", "enabled",
-    "enable", "disable", "start_span", "span", "record_span",
-    "current_span", "current_context", "export_chrome",
+    "Span", "SpanContext", "TailConfig", "TraceCollector", "collector",
+    "enabled", "enable", "disable", "resume", "start_span", "span",
+    "record_span", "current_span", "current_context", "export_chrome",
     "validate_chrome_events",
 ]
 
@@ -61,6 +66,30 @@ _ids = itertools.count(1)
 
 def _new_id() -> int:
     return (_SALT << 32) | (next(_ids) & 0xFFFFFFFF)
+
+
+class TailConfig(NamedTuple):
+    """Tail-based sampling policy (Canopy/Dapper-style): spans buffer per
+    trace id until the trace's ROOT span finishes, and the whole tree is
+    retained only when the request turned out to be worth keeping —
+
+    * ``slo_ms`` — the root span breached this latency objective;
+    * any span in the tree recorded an ``error`` attr (shed, validation
+      reject, exec failure) or the root closed ``ok=False``;
+    * ``head_n`` — a 1-in-N head sample of completed traces rides along
+      regardless, so the retained set always contains *normal* requests
+      to compare the anomalies against (0 keeps anomalies only).
+
+    Everything else is discarded at the decision point, so tracing
+    becomes cheap enough to leave on under sustained traffic: the ring
+    holds only the explanatory traces, and ``max_pending`` bounds the
+    undecided buffer (the oldest undecided trace is evicted wholesale
+    past it — fragments whose root lives in another process can never
+    pin memory)."""
+
+    slo_ms: float = 250.0
+    head_n: int = 64
+    max_pending: int = 8192
 
 
 class SpanContext(NamedTuple):
@@ -190,12 +219,24 @@ class TraceCollector:
         # monotonic->epoch anchor for export (set at enable())
         self._anchor_wall = time.time()
         self._anchor_mono = time.monotonic()
+        # tail-based sampling (None = record every finished span)
+        self._tail: Optional[TailConfig] = None
+        self._pending: Dict[int, List[Span]] = {}
+        self._pending_n = 0
+        self._decisions: Dict[int, bool] = {}
+        self.tail_completed = 0          # traces whose root finished
+        self.tail_kept = 0               # ... retained into the ring
+        self.tail_discarded = 0          # ... dropped at decision time
+        self.tail_evicted = 0            # undecided traces evicted (bound)
+        self.tail_span_drops = 0         # spans dropped by either path
 
     # -- lifecycle ----------------------------------------------------------
-    def start(self, capacity: Optional[int] = None) -> None:
+    def start(self, capacity: Optional[int] = None,
+              tail: Optional[TailConfig] = None) -> None:
         """(Re)start collecting: the ring, counters and clock anchor all
         reset, so a second traced session in the same process never
-        exports the previous run's spans."""
+        exports the previous run's spans. ``tail`` switches on tail-based
+        sampling (None = record everything, the pre-existing behavior)."""
         with self._lock:
             if capacity is not None:
                 self.capacity = int(capacity)
@@ -205,6 +246,8 @@ class TraceCollector:
             self.recorded = 0
             self._anchor_wall = time.time()
             self._anchor_mono = time.monotonic()
+            self._tail = tail
+            self._clear_tail_locked()
             self.enabled = True
 
     def stop(self) -> None:
@@ -216,18 +259,92 @@ class TraceCollector:
             self._pos = self._n = 0
             self.dropped = 0
             self.recorded = 0
+            self._clear_tail_locked()
+
+    def _clear_tail_locked(self) -> None:
+        self._pending.clear()
+        self._pending_n = 0
+        self._decisions.clear()
+        self.tail_completed = 0
+        self.tail_kept = 0
+        self.tail_discarded = 0
+        self.tail_evicted = 0
+        self.tail_span_drops = 0
 
     # -- record/read --------------------------------------------------------
+    def _append_locked(self, sp: Span) -> None:
+        if self._n == self.capacity:
+            self.dropped += 1
+        self._buf[self._pos] = sp
+        self._pos = (self._pos + 1) % self.capacity
+        self._n = min(self._n + 1, self.capacity)
+        self.recorded += 1
+
     def record(self, sp: Span) -> None:
         if not self.enabled:
             return
         with self._lock:
-            if self._n == self.capacity:
-                self.dropped += 1
-            self._buf[self._pos] = sp
-            self._pos = (self._pos + 1) % self.capacity
-            self._n = min(self._n + 1, self.capacity)
-            self.recorded += 1
+            if self._tail is None:
+                self._append_locked(sp)
+            else:
+                self._tail_record_locked(sp)
+
+    def _tail_record_locked(self, sp: Span) -> None:
+        """Buffer under the span's trace id; decide at root completion.
+
+        A span landing AFTER its trace was decided (an engine-thread
+        iteration racing the submit-thread's root end) follows the
+        decision — retained traces stay whole, discarded ones don't
+        resurrect. The decision memo is bounded (oldest forgotten)."""
+        tid = sp.trace_id
+        decided = self._decisions.get(tid)
+        if decided is not None:
+            if decided:
+                self._append_locked(sp)
+            else:
+                self.tail_span_drops += 1
+            return
+        self._pending.setdefault(tid, []).append(sp)
+        self._pending_n += 1
+        if sp.parent_id is None:         # a root finished: decide its tree
+            self._tail_decide_locked(tid, sp)
+        elif self._pending_n > self._tail.max_pending:
+            # bounded memory: evict the oldest undecided trace wholesale
+            # (insertion order = arrival order of each trace's first span)
+            old_tid = next(iter(self._pending))
+            old = self._pending.pop(old_tid)
+            self._pending_n -= len(old)
+            self.tail_evicted += 1
+            self.tail_span_drops += len(old)
+
+    def _tail_decide_locked(self, tid: int, root: Span) -> None:
+        cfg = self._tail
+        buf = self._pending.pop(tid, [])
+        self._pending_n -= len(buf)
+        self.tail_completed += 1
+        keep = None
+        if (root.t1 is not None
+                and (root.t1 - root.t0) * 1e3 >= cfg.slo_ms > 0):
+            keep = "slo"
+        elif any("error" in s.attrs or s.attrs.get("ok") is False
+                 for s in buf):
+            keep = "error"
+        elif cfg.head_n > 0 and (self.tail_completed - 1) % cfg.head_n == 0:
+            keep = "head"
+        if keep is None:
+            self.tail_discarded += 1
+            self.tail_span_drops += len(buf)
+            self._decisions[tid] = False
+        else:
+            root.attrs["tail_keep"] = keep
+            self.tail_kept += 1
+            for s in buf:
+                self._append_locked(s)
+            self._decisions[tid] = True
+        # the memo only has to outlive the decision races (late children
+        # of a just-ended root); cap it so ids never accumulate
+        while len(self._decisions) > 4096:
+            self._decisions.pop(next(iter(self._decisions)))
 
     def spans(self) -> List[Span]:
         """Retained spans, oldest first."""
@@ -300,9 +417,22 @@ class TraceCollector:
 
     def stats(self) -> dict:
         with self._lock:
-            return {"enabled": self.enabled, "retained": self._n,
-                    "capacity": self.capacity, "dropped": self.dropped,
-                    "recorded": self.recorded}
+            out = {"enabled": self.enabled, "retained": self._n,
+                   "capacity": self.capacity, "dropped": self.dropped,
+                   "recorded": self.recorded}
+            if self._tail is not None:
+                out["tail"] = {
+                    "slo_ms": self._tail.slo_ms,
+                    "head_n": self._tail.head_n,
+                    "pending_traces": len(self._pending),
+                    "pending_spans": self._pending_n,
+                    "completed": self.tail_completed,
+                    "kept": self.tail_kept,
+                    "discarded": self.tail_discarded,
+                    "evicted": self.tail_evicted,
+                    "span_drops": self.tail_span_drops,
+                }
+            return out
 
 
 _COLLECTOR = TraceCollector()
@@ -317,12 +447,21 @@ def enabled() -> bool:
     return _COLLECTOR.enabled
 
 
-def enable(capacity: Optional[int] = None) -> None:
-    _COLLECTOR.start(capacity)
+def enable(capacity: Optional[int] = None,
+           tail: Optional[TailConfig] = None) -> None:
+    _COLLECTOR.start(capacity, tail)
 
 
 def disable() -> None:
     _COLLECTOR.stop()
+
+
+def resume() -> None:
+    """Re-enable collection WITHOUT resetting the ring, tail state or
+    clock anchor — the counterpart of :func:`disable` for a momentary
+    off window (e.g. the bench's tracing-off A/B leg) inside one traced
+    session. :func:`enable` would wipe everything recorded so far."""
+    _COLLECTOR.enabled = True
 
 
 # -- span creation ----------------------------------------------------------

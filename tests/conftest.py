@@ -21,6 +21,8 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import threading
+
 import pytest
 
 
@@ -28,6 +30,24 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: long benches excluded from the tier-1 run (-m 'not slow')")
+
+
+@pytest.fixture(autouse=True)
+def _no_stray_nondaemon_threads():
+    """Test-isolation guard: a test must not leave NEW non-daemon
+    threads running — a leaked reporter/exporter thread would block
+    interpreter exit and bleed state into every later test. (The
+    framework's own worker threads are all daemons; Dashboard.reset()
+    additionally detaches any attached MetricsExporter/watchdog.)"""
+    before = set(threading.enumerate())
+    yield
+    strays = [t for t in threading.enumerate()
+              if t not in before and not t.daemon and t.is_alive()]
+    for t in strays:                 # grace: let clean shutdowns finish
+        t.join(timeout=5)
+    strays = [t for t in strays if t.is_alive()]
+    assert not strays, (
+        f"test leaked non-daemon thread(s): {[t.name for t in strays]}")
 
 
 @pytest.fixture()

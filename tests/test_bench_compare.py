@@ -50,6 +50,24 @@ def test_metric_direction_rules():
     assert metric_direction("block_allocs") == 0
 
 
+def test_watchdog_trips_hard_gate():
+    """Any watchdog trip on a clean-baseline bench regresses: the
+    zero-baseline rule makes the trip count itself the worseness, so
+    a single trip (1.0) blows every sane tolerance — while the
+    observability A/B's _info tok/s columns never gate at all."""
+    assert metric_direction("watchdog_trips") == -1
+    base = _line(observability={"watchdog_trips": 0.0,
+                                "tokens_per_s_traced_info": 100.0,
+                                "trace_overhead_frac_info": 0.01})
+    bad = _line(observability={"watchdog_trips": 1.0,
+                               "tokens_per_s_traced_info": 50.0,
+                               "trace_overhead_frac_info": 0.4})
+    regressions, _ = compare(base, bad)
+    assert [r["metric"] for r in regressions] == [
+        "observability.watchdog_trips"]
+    assert compare(base, base)[0] == []           # clean stays clean
+
+
 def test_capacity_metrics_gate_both_directions():
     """The lm_paged_kv capacity surface rides the standing gate: fewer
     concurrent sequences (or more KV bytes per sequence) at the same

@@ -1,0 +1,177 @@
+"""Flight recorder (serving/flight_recorder.py) + tools/engine_timeline.py.
+
+Pure host-side units for the ring, its summaries and exports, then the
+engine integration: the always-on recorder rides the decode loop
+without adding a compiled trace, and its records join the engine's
+public progress surface (``iters_total`` / ``ENGINE_ITERS``).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu import trace
+from multiverso_tpu.serving.flight_recorder import FIELDS, FlightRecorder
+from tools.engine_timeline import load_ring, main, render, timeline_report
+
+
+def _rec(it, ts, busy=1.0, step=0.5, live=1, reserved=0, queue=0,
+         queue_age=0.0, prefill=0, decode=1, pool_free=-1, pool_live=-1,
+         version=0, admitted=(), completed=()):
+    return (it, ts, busy, step, live, reserved, queue, queue_age,
+            prefill, decode, pool_free, pool_live, version, admitted,
+            completed)
+
+
+# -- ring ---------------------------------------------------------------------
+
+def test_ring_wrap_preserves_newest_records():
+    fr = FlightRecorder(capacity=4, name="t")
+    for i in range(10):
+        fr.record(_rec(i + 1, i * 0.01))
+    recs = fr.records()
+    assert [r["it"] for r in recs] == [7, 8, 9, 10]     # newest survive
+    assert list(recs[0]) == list(FIELDS)
+    assert fr.total == 10
+    s = fr.summary()
+    assert s["wrapped"] and s["retained"] == 4 and s["iterations"] == 10
+
+
+def test_summary_utilization_and_token_split():
+    fr = FlightRecorder(capacity=64, name="t")
+    # 10 iterations 10 ms apart, 5 ms busy each -> ~50% busy, ~5 ms gaps
+    for i in range(10):
+        fr.record(_rec(i + 1, 1000.0 + i * 0.010, busy=5.0, step=4.0,
+                       prefill=(8 if i < 2 else 0), decode=2))
+    s = fr.summary()
+    assert 0.40 < s["busy_frac"] < 0.65
+    assert s["busy_frac"] + s["idle_frac"] == pytest.approx(1.0)
+    assert s["prefill_tokens"] == 16 and s["decode_tokens"] == 20
+    assert s["prefill_share"] == pytest.approx(16 / 36)
+    assert s["steps"] == 10
+    assert s["mean_step_ms"] == pytest.approx(4.0)
+    assert 4.0 < s["max_idle_gap_ms"] < 6.5
+
+
+def test_empty_ring_summary_is_zeroed():
+    s = FlightRecorder(capacity=8, name="t").summary()
+    assert s["iterations"] == 0 and s["idle_frac"] == 0.0
+    assert not s["wrapped"]
+
+
+# -- exports ------------------------------------------------------------------
+
+def test_jsonl_dump_roundtrips_through_engine_timeline(tmp_path):
+    fr = FlightRecorder(capacity=64, name="eng")
+    for i in range(20):
+        fr.record(_rec(i + 1, i * 0.010, busy=5.0, step=4.0, live=2,
+                       queue=1, queue_age=3.0,
+                       prefill=(16 if i < 5 else 0), decode=2,
+                       admitted=(i + 1,) if i < 5 else ()))
+    path = str(tmp_path / "ring.jsonl")
+    assert fr.export_jsonl(path) == 20
+    meta, records = load_ring(path)
+    assert meta["name"] == "eng" and meta["fields"] == list(FIELDS)
+    assert len(records) == 20
+    assert records[0]["admitted"] == [1]          # JSON tuples -> lists
+
+    report = timeline_report(records, buckets=4)
+    assert report["iterations"] == 20
+    assert report["prefill_tokens"] == 80 and report["decode_tokens"] == 40
+    assert report["peak_live"] == 2
+    assert len(report["buckets"]) == 4
+    # the admission wave's prefill concentrates in the opening bucket
+    assert report["buckets"][0]["prefill_toks"] == 80
+    assert report["buckets"][-1]["prefill_toks"] == 0
+    assert 0.3 < report["busy_frac"] < 0.7
+    text = render(report, meta["name"])
+    assert "eng" in text and "utilization" in text and "bubbles" in text
+
+    # the CLI walks the same path (exit 0 on a well-formed dump)
+    assert main([path, "--buckets", "4"]) == 0
+    assert main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_chrome_counter_tracks_merge_with_span_export():
+    fr = FlightRecorder(capacity=8, name="eng")
+    fr.record(_rec(1, time.monotonic(), pool_free=3, pool_live=1))
+    counters = fr.chrome_counter_events()
+    assert all(e["ph"] == "C" for e in counters)
+    assert {e["name"] for e in counters} == {
+        "fr/eng/slots", "fr/eng/queue", "fr/eng/tokens",
+        "fr/eng/kv_blocks"}
+    trace.enable(64)
+    try:
+        with trace.span("serve.request", root=True, model="m"):
+            pass
+        doc = trace.export_chrome()
+    finally:
+        trace.disable()
+        trace.collector().clear()
+    merged = fr.merge_chrome(doc)
+    # counter events ride along WITHOUT breaking the B/E structural
+    # contract (the validator skips non-B/E phases by design)
+    trace.validate_chrome_events(merged["traceEvents"],
+                                 root_name="serve.request")
+    assert sum(e["ph"] == "C" for e in merged["traceEvents"]) == 4
+    assert [e["ts"] for e in merged["traceEvents"]] == sorted(
+        e["ts"] for e in merged["traceEvents"])
+
+
+# -- engine integration -------------------------------------------------------
+
+def test_engine_records_iterations_without_new_traces(mv_session):
+    """The acceptance invariant: flight recording is pure host state —
+    the fused step still compiles EXACTLY once, iteration progress is
+    public (stats/counter), and the ring's admitted/completed ids track
+    real requests."""
+    from multiverso_tpu.dashboard import Dashboard
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq=48)
+    srv = InferenceServer("t")
+    engine = srv.register_decoder("lm", TransformerLM(cfg), slots=2,
+                                  max_prompt=8, max_new=6)
+    assert engine.recorder is not None            # always-on by default
+    futs = [srv.submit("lm", np.arange(1, 5, dtype=np.int32))
+            for _ in range(3)]
+    for f in futs:
+        assert len(f.result(timeout=60)["result"]) == 6
+
+    # the pass's flight record lands just AFTER the futures resolve:
+    # settle until the ring's token accounting catches up with stats
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        stats = engine.stats()
+        if (stats["live_seqs"] == 0
+                and sum(r["decode_toks"]
+                        for r in engine.recorder.records())
+                == stats["tokens"]):
+            break
+        time.sleep(0.01)
+    assert stats["step_traces"] == 1              # no new compiled traces
+    assert stats["prefill_traces"] == 1
+    assert stats["iters_total"] >= 5
+    assert stats["flight_records"] == engine.recorder.total > 0
+    assert stats["last_iter_age_s"] >= 0.0
+    assert Dashboard.get_or_create_counter("ENGINE_ITERS[lm]").get() == \
+        stats["iters_total"]
+
+    recs = engine.recorder.records()
+    admitted = [rid for r in recs for rid in r["admitted"]]
+    completed = [rid for r in recs for rid in r["completed"]]
+    assert len(admitted) == len(completed) == 3
+    assert set(admitted) == set(completed)
+    # paged KV is the default: pool occupancy columns are live
+    assert all(r["pool_free"] >= 0 for r in recs)
+    assert all(r["version"] >= 0 for r in recs)
+    assert sum(r["decode_toks"] for r in recs) == stats["tokens"]
+    assert sum(r["prefill_toks"] for r in recs) == 12    # 3 x 4-token
+    # ring timestamps are monotonic, busy fits inside the gap walls
+    ts = [r["ts"] for r in recs]
+    assert ts == sorted(ts)

@@ -231,6 +231,54 @@ def test_exporter_thread_writes_lines(tmp_path):
         assert "SERVE_TTFT[lm]" in rec["snapshot"]
 
 
+def test_dashboard_reset_detaches_running_exporter(tmp_path):
+    """The test-isolation contract: Dashboard.reset() must stop any
+    still-running reporter thread — a leaked exporter would keep
+    snapshotting (and writing its sink) across every later test."""
+    Dashboard.reset()
+    exporter = MetricsExporter(interval_s=0.05,
+                               sink=str(tmp_path / "m.jsonl")).start()
+    _wait(lambda: exporter.reports >= 1)
+    thread = exporter._thread
+    assert thread is not None and thread.is_alive()
+    Dashboard.reset()
+    assert exporter._thread is None
+    assert not thread.is_alive()
+    assert Dashboard._reporters == []
+    exporter.stop()                               # idempotent
+
+
+def test_slo_windowed_burn_status():
+    """Rolling-window SLO: value vs target, breach fraction, and burn
+    (breach over error budget) — all riding snapshot() as plain data."""
+    Dashboard.reset()
+    hist = Dashboard.get_or_create_histogram("SERVE_TTFT[lm]")
+    slo = Dashboard.set_slo("SERVE_TTFT[lm]", 100.0, percentile=90.0)
+    for _ in range(10):
+        hist.record(10.0)
+    s = slo.summary()
+    assert s["ok"] == 1 and s["breach_frac"] == 0.0 and s["burn"] == 0.0
+    for _ in range(10):
+        hist.record(500.0)
+    s = slo.summary()
+    assert s["ok"] == 0 and s["value_ms"] == 500.0
+    assert s["breach_frac"] == pytest.approx(0.5)
+    assert s["burn"] == pytest.approx(5.0)        # 50% breach / 10% budget
+    snap = Dashboard.snapshot()
+    row = snap["SLO_P90[SERVE_TTFT[lm]]"]
+    assert row["type"] == "slo" and row["ok"] == 0
+    assert json.loads(json.dumps(snap)) == snap   # still plain data
+    assert "BURNING" in Dashboard.watch("SLO_P90[SERVE_TTFT[lm]]")
+    # set_slo on the same (source, percentile) re-targets in place
+    assert Dashboard.set_slo("SERVE_TTFT[lm]", 1000.0,
+                             percentile=90.0) is slo
+    assert slo.summary()["ok"] == 1
+    # rolling: the breaching samples age out of the window
+    for _ in range(Histogram.WINDOW):
+        hist.record(1.0)
+    assert slo.summary()["breach_frac"] == 0.0
+
+
 # -- traced serving ----------------------------------------------------------
 
 def test_batcher_handoff_keeps_trace_ids(mv_session, traced):
@@ -367,13 +415,67 @@ def test_tracing_disabled_no_decode_hot_loop_overhead(mv_session,
     cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
                             n_layers=2, d_ff=64, max_seq=48)
     srv = InferenceServer("t")
-    srv.register_decoder("lm", TransformerLM(cfg), slots=2, max_prompt=8,
-                         max_new=8)
+    engine = srv.register_decoder("lm", TransformerLM(cfg), slots=2,
+                                  max_prompt=8, max_new=8)
     out = srv.submit("lm", np.arange(1, 6, dtype=np.int32)).result(
         timeout=60)
     assert len(out["result"]) == 8               # 7 decode iterations ran
     assert calls == {"span": 0, "record": 0}
     assert trace.collector().spans() == []
+    # the ALWAYS-ON flight recorder was live the whole time — proving
+    # the zero-Span guarantee holds with black-box recording running —
+    # and it added no compiled trace to the fused step
+    assert engine.recorder is not None and engine.recorder.total > 0
+    assert engine.step_cache_size() == 1
+
+
+def test_tail_sampled_decode_keeps_only_sampled_trees(mv_session):
+    """Serving-path tail sampling: with an unreachable SLO and no head
+    sample, a healthy engine's requests leave NOTHING in the ring (the
+    leave-it-on posture); with head_n=1 every tree survives intact."""
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq=48)
+    srv = InferenceServer("t")
+    srv.register_decoder("lm", TransformerLM(cfg), slots=2, max_prompt=8,
+                         max_new=4)
+    try:
+        trace.enable(4096, tail=trace.TailConfig(slo_ms=1e9, head_n=0))
+        for _ in range(2):
+            srv.submit("lm", np.arange(1, 5, dtype=np.int32)).result(
+                timeout=60)
+        # snapshot.pin spans are roots of their own traces, so completed
+        # counts >= the two requests — but NOTHING may survive the
+        # sampler (no breach, no error, no head sample)
+        _wait(lambda: trace.collector().tail_completed >= 2)
+        col = trace.collector()
+        assert col.spans() == []                 # every tree discarded
+        assert col.tail_kept == 0
+        assert col.tail_discarded == col.tail_completed >= 2
+
+        trace.enable(4096, tail=trace.TailConfig(slo_ms=1e9, head_n=1))
+        srv.submit("lm", np.arange(1, 5, dtype=np.int32)).result(
+            timeout=60)
+        _wait(lambda: any(s.name == "serve.request"
+                          for s in trace.collector().spans()))
+        spans = trace.collector().spans()
+        req_ids = {s.trace_id for s in spans if s.name == "serve.request"}
+        assert len(req_ids) == 1
+        tree = [s for s in spans if s.trace_id in req_ids]
+        names = {s.name for s in tree}
+        # the whole tree survived the sampler, parentage intact
+        assert {"serve.request", "queue.wait", "decode.admit",
+                "decode.iter"} <= names
+        root = [s for s in tree if s.name == "serve.request"][0]
+        assert root.attrs["tail_keep"] == "head"
+        assert all(s.parent_id == root.span_id for s in tree
+                   if s is not root)
+    finally:
+        trace.disable()
+        trace.collector().clear()
 
 
 def test_table_add_span_tagged(mv_session, traced):

@@ -312,6 +312,36 @@ def test_derived_cache_single_compute_under_concurrent_readers():
 
 
 @pytest.mark.slow
+def test_observability_ab_black_box_clean(mv_session):
+    """The serving_bench observability A/B: tracing-off vs tail-sampled
+    tracing on the same engine — the black box (flight recorder +
+    watchdog) stays on throughout, adds no compiled trace, and a clean
+    run trips NO watchdog."""
+    from multiverso_tpu import trace
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import InferenceServer
+    from tools.serving_bench import _observability_ab
+
+    srv = InferenceServer("t")
+    cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                            n_layers=2, d_ff=256, max_seq=80)
+    trace.enable(65536, tail=trace.TailConfig())
+    try:
+        row, engine = _observability_ab(srv, TransformerLM(cfg),
+                                        quick=True)
+    finally:
+        trace.disable()
+        trace.collector().clear()
+    assert row["step_traces"] == 1
+    assert row["tokens_per_s_untraced_info"] > 0
+    assert row["tokens_per_s_traced_info"] > 0
+    assert row["flight_iterations_info"] > 0
+    assert row["tail_completed_info"] > 0
+    assert engine.watchdog is not None and engine.watchdog.trip_count == 0
+
+
+@pytest.mark.slow
 def test_chunked_prefill_ab_bounds_itl(mv_session):
     """The serving_bench pulse/burst trace: chunked admission must cut
     ITL p99 versus monolithic whole-prompt admission (measured 2.4-3.6x

@@ -222,3 +222,112 @@ def test_span_error_attr_on_exception(traced):
             raise RuntimeError("x")
     sp = traced.spans()[0]
     assert sp.attrs["error"] == "RuntimeError"
+
+
+# -- tail-based sampling ------------------------------------------------------
+
+@pytest.fixture()
+def tail_traced():
+    """Tail sampling on: 50 ms SLO, no head sample, tight pending cap."""
+    trace.enable(4096, tail=trace.TailConfig(slo_ms=50.0, head_n=0,
+                                             max_pending=16))
+    yield trace.collector()
+    trace.disable()
+    trace.collector().clear()
+
+
+def _play_request(duration_ms: float, error: bool = False,
+                  children: int = 2):
+    """One synthetic request tree, recorded the way the serving path
+    records it: children land first, the root's end decides the trace.
+    ``duration_ms`` is faked by rewinding the root's start time."""
+    root = trace.start_span("serve.request", root=True, model="m")
+    root.t0 = time.monotonic() - duration_ms / 1e3
+    for i in range(children):
+        trace.record_span("decode.iter", root.context, root.t0,
+                          time.monotonic(), slot=i)
+    if error:
+        root.end(ok=False, error="Boom")
+    else:
+        root.end(ok=True)
+    return root
+
+
+def test_tail_keeps_slow_drops_fast(tail_traced):
+    fast = _play_request(1.0)
+    assert tail_traced.spans() == []              # under SLO: discarded
+    slow = _play_request(120.0)
+    spans = tail_traced.spans()
+    assert {s.trace_id for s in spans} == {slow.trace_id}
+    assert len(spans) == 3                        # the WHOLE tree survived
+    assert slow.attrs["tail_keep"] == "slo"
+    assert fast.trace_id not in {s.trace_id for s in spans}
+    stats = tail_traced.stats()["tail"]
+    assert stats["completed"] == 2
+    assert stats["kept"] == 1 and stats["discarded"] == 1
+
+
+def test_tail_keeps_errored(tail_traced):
+    bad = _play_request(1.0, error=True)
+    spans = tail_traced.spans()
+    assert {s.trace_id for s in spans} == {bad.trace_id}
+    assert bad.attrs["tail_keep"] == "error"
+
+
+def test_tail_head_sample_one_in_n():
+    trace.enable(4096, tail=trace.TailConfig(slo_ms=1e9, head_n=3))
+    try:
+        roots = [_play_request(1.0) for _ in range(7)]
+        col = trace.collector()
+        kept = {s.trace_id for s in col.spans()}
+        # 1st, 4th, 7th completed traces ride the head sample
+        assert kept == {roots[0].trace_id, roots[3].trace_id,
+                        roots[6].trace_id}
+        assert roots[0].attrs["tail_keep"] == "head"
+        assert col.tail_kept == 3 and col.tail_discarded == 4
+    finally:
+        trace.disable()
+        trace.collector().clear()
+
+
+def test_tail_pending_memory_bounded(tail_traced):
+    """Fragments whose root never completes locally (cross-process
+    children, in-flight requests) cannot pin memory: past max_pending
+    the oldest undecided trace is evicted wholesale."""
+    for i in range(40):
+        # each an orphan child of a root living "elsewhere"
+        trace.record_span(f"bus.apply", trace.SpanContext(1000 + i, 1),
+                          0.0, 1.0)
+    stats = tail_traced.stats()["tail"]
+    assert stats["pending_spans"] <= 16
+    assert stats["evicted"] >= 24
+    assert tail_traced.spans() == []
+
+
+def test_tail_late_span_follows_decision(tail_traced):
+    """A span recorded after its trace was decided (the engine thread
+    racing the root's end) lands with its kept tree — and stays dropped
+    with a dropped one."""
+    slow = _play_request(120.0)
+    trace.record_span("decode.iter", slow.context, 0.0, 1.0, slot=9)
+    assert sum(s.trace_id == slow.trace_id
+               for s in tail_traced.spans()) == 4
+    fast = _play_request(1.0)
+    trace.record_span("decode.iter", fast.context, 0.0, 1.0, slot=9)
+    assert all(s.trace_id != fast.trace_id for s in tail_traced.spans())
+
+
+def test_resume_keeps_ring_and_tail_state(tail_traced):
+    """disable() -> resume() is a momentary off window: the ring and the
+    tail counters survive (enable() would reset both)."""
+    slow = _play_request(120.0)
+    trace.disable()
+    assert not trace.enabled()
+    assert trace.start_span("x", root=True) is trace.NULL_SPAN
+    trace.resume()
+    assert trace.enabled()
+    assert {s.trace_id for s in tail_traced.spans()} == {slow.trace_id}
+    assert tail_traced.stats()["tail"]["completed"] == 1
+    slow2 = _play_request(120.0)                  # collection continues
+    assert {s.trace_id for s in tail_traced.spans()} == {
+        slow.trace_id, slow2.trace_id}

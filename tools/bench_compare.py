@@ -40,9 +40,14 @@ from typing import Dict, List, Tuple
 # (serving_bench's lm_paged_kv A/B): concurrent sequences held at a
 # fixed KV-bytes budget regress DOWN, bytes paid per held sequence
 # regress UP — the standing gate covers capacity, not just latency.
+# watchdog_trips is a HARD gate in practice: a clean bench baseline has
+# zero trips, and the zero-baseline rule below makes ANY trip on the
+# candidate side regress (worseness = the trip count itself) — a
+# watchdog firing during a healthy bench is a bug, not noise.
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
                   "capacity_seqs")
-_LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq")
+_LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
+                 "watchdog_trips")
 
 
 def metric_direction(name: str) -> int:

@@ -1,0 +1,186 @@
+"""Render utilization / bubble analysis from a flight-recorder dump.
+
+Input is the JSONL a :class:`serving.flight_recorder.FlightRecorder`
+writes (``engine.recorder.export_jsonl(path)``, a watchdog bundle's
+``ring.jsonl``, or ``tools/serving_bench.py --flight FILE``): one meta
+line, then one record per engine iteration. This tool answers the
+post-hoc capacity questions the ring exists for:
+
+* **where did the wall time go** — busy vs idle fraction over the
+  window, the largest idle gaps (bubbles) with their timestamps, and a
+  bucketed utilization strip so a ramp/stall is visible at a glance;
+* **where did the FLOPs go** — prefill-vs-decode token share, overall
+  and per time bucket (a prefill-heavy stripe is an admission wave, a
+  decode-only tail is the drain);
+* **what was the engine holding** — mean/peak live slots, queue depth
+  and max queue age per bucket, pool occupancy when paged.
+
+Usage::
+
+    python tools/engine_timeline.py RING.jsonl [--buckets 40]
+        [--top-gaps 5]
+
+Pure host-side (no jax): loadable against a dump from any run,
+including one scraped out of a dead replica's watchdog bundle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import Any, Dict, List, Tuple
+
+# the wall/busy/gap digest lives in ONE place — flight_recorder.py. That
+# module is stdlib-only, but importing it through the package would drag
+# jax in, so load the file itself (works against a bare checkout).
+_FR_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "multiverso_tpu", "serving", "flight_recorder.py")
+_spec = importlib.util.spec_from_file_location("_mv_flight_recorder",
+                                               _FR_PATH)
+_flight_recorder = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_flight_recorder)
+window_digest = _flight_recorder.window_digest
+
+
+def load_ring(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Parse a flight-recorder JSONL dump -> (meta, records oldest first)."""
+    meta: Dict[str, Any] = {}
+    records: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if i == 0 and "flight_recorder" in row:
+                meta = row["flight_recorder"]
+                continue
+            records.append(row)
+    return meta, records
+
+
+def timeline_report(records: List[Dict[str, Any]], buckets: int = 40,
+                    top_gaps: int = 5) -> Dict[str, Any]:
+    """Digest a record list into the report dict ``render`` prints.
+
+    The window opens when the first retained iteration's work began
+    (``ts - busy_ms``) and closes at the last record; every bucket
+    aggregates the iterations whose record timestamp falls inside it.
+    """
+    digest = window_digest(records)
+    report = {"iterations": len(records), **digest,
+              "gaps": digest["gaps"][:top_gaps], "buckets": []}
+    report.pop("max_idle_gap_ms")
+    if not records:
+        return report
+    t0 = records[0]["ts"] - records[0]["busy_ms"] / 1e3
+    wall = digest["wall_s"]
+
+    n_buckets = max(1, min(int(buckets), len(records)))
+    width = wall / n_buckets
+    rows: List[Dict[str, Any]] = [
+        {"t_s": round(b * width, 6), "iters": 0, "busy_ms": 0.0,
+         "prefill_toks": 0, "decode_toks": 0, "live_sum": 0, "live_max": 0,
+         "queue_max": 0, "queue_age_ms_max": 0.0}
+        for b in range(n_buckets)]
+    for r in records:
+        b = min(n_buckets - 1, int((r["ts"] - t0) / width))
+        row = rows[b]
+        row["iters"] += 1
+        row["busy_ms"] += r["busy_ms"]
+        row["prefill_toks"] += r["prefill_toks"]
+        row["decode_toks"] += r["decode_toks"]
+        row["live_sum"] += r["live"] + r["reserved"]
+        row["live_max"] = max(row["live_max"], r["live"] + r["reserved"])
+        row["queue_max"] = max(row["queue_max"], r["queue"])
+        row["queue_age_ms_max"] = max(row["queue_age_ms_max"],
+                                      r["queue_age_ms"])
+    for row in rows:
+        row["busy_frac"] = min(1.0, row["busy_ms"] / (width * 1e3))
+        row["live_mean"] = (row["live_sum"] / row["iters"]
+                            if row["iters"] else 0.0)
+        toks = row["prefill_toks"] + row["decode_toks"]
+        row["prefill_share"] = row["prefill_toks"] / toks if toks else 0.0
+        del row["live_sum"]
+    report["buckets"] = rows
+    return report
+
+
+_BARS = " .:-=+*#%@"
+
+
+def _bar(frac: float) -> str:
+    """One glyph per bucket, darker = higher."""
+    level = min(len(_BARS) - 1, int(frac * (len(_BARS) - 1) + 0.5))
+    return _BARS[level]
+
+
+def render(report: Dict[str, Any], name: str = "") -> str:
+    lines: List[str] = []
+    lines.append(
+        f"engine timeline{f' [{name}]' if name else ''}: "
+        f"{report['iterations']} iterations over {report['wall_s']:.3f}s "
+        f"— busy {report['busy_frac']:.1%}, idle {report['idle_frac']:.1%}")
+    total = report["prefill_tokens"] + report["decode_tokens"]
+    lines.append(
+        f"tokens: {report['prefill_tokens']} prefill / "
+        f"{report['decode_tokens']} decode ({report['prefill_share']:.1%} "
+        f"prefill share of {total}); {report['steps']} fused steps, "
+        f"mean {report['mean_step_ms']:.3f} ms; peak live "
+        f"{report['peak_live']}")
+    if report["gaps"]:
+        worst = ", ".join(f"{g['gap_ms']:.1f}ms@{g['t_s']:.3f}s"
+                          for g in report["gaps"])
+        lines.append(f"largest bubbles: {worst}")
+    if report["buckets"]:
+        util = "".join(_bar(b["busy_frac"]) for b in report["buckets"])
+        pf = "".join(_bar(b["prefill_share"]) for b in report["buckets"])
+        lines.append(f"utilization   |{util}|")
+        lines.append(f"prefill share |{pf}|   "
+                     f"(scale: '{_BARS[0]}'=0 .. '{_BARS[-1]}'=1, "
+                     f"{report['wall_s'] / len(report['buckets']):.3f}s "
+                     f"per column)")
+        lines.append(f"{'t_s':>8} {'iters':>6} {'busy':>6} {'live':>6} "
+                     f"{'qmax':>5} {'qage_ms':>8} {'prefill':>8} "
+                     f"{'decode':>8}")
+        for b in report["buckets"]:
+            if not b["iters"]:
+                continue
+            lines.append(
+                f"{b['t_s']:8.3f} {b['iters']:6d} {b['busy_frac']:6.1%} "
+                f"{b['live_mean']:6.2f} {b['queue_max']:5d} "
+                f"{b['queue_age_ms_max']:8.1f} {b['prefill_toks']:8d} "
+                f"{b['decode_toks']:8d}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="utilization/bubble report from a flight-recorder dump")
+    ap.add_argument("ring", help="flight-recorder JSONL (engine."
+                                 "recorder.export_jsonl / watchdog bundle "
+                                 "ring.jsonl)")
+    ap.add_argument("--buckets", type=int, default=40,
+                    help="timeline columns (default 40)")
+    ap.add_argument("--top-gaps", type=int, default=5,
+                    help="largest idle bubbles to list (default 5)")
+    args = ap.parse_args(argv)
+    try:
+        meta, records = load_ring(args.ring)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"engine_timeline: {exc}", file=sys.stderr)
+        return 2
+    if not records:
+        print("engine_timeline: dump holds no records", file=sys.stderr)
+        return 2
+    report = timeline_report(records, args.buckets, args.top_gaps)
+    print(render(report, meta.get("name", "")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,7 +29,11 @@ Usage::
 
     python tools/trace_summary.py TRACE_DIR [--top 20] [--by op|source]
     python tools/trace_summary.py --host-trace serve.json [TRACE_DIR]
-        [--top 20] [--sort total|queue|device]
+        [--top 20] [--sort total|queue|device] [--slo-ms 250]
+
+``--slo-ms`` flags (``!``) and counts requests whose total exceeds the
+objective; on tail-sampled captures (``-trace_tail``) a ``keep`` column
+says why each retained trace survived the sampler (slo/error/head).
 """
 
 from __future__ import annotations
@@ -164,6 +168,10 @@ def request_report(spans, device_events=None):
             "model": root["args"].get("model", ""),
             "total_ms": root["dur"] / 1e3,
             "iters": sum(s["name"] == "decode.iter" for s in group),
+            # present on tail-sampled captures: WHY this trace survived
+            # the sampler (slo / error / head) — a report full of "head"
+            # rows means the SLO never breached
+            "keep": root["args"].get("tail_keep", ""),
         }
         for col, names in _STAGE_COLUMNS:
             row[col] = sum(s["dur"] for s in group
@@ -184,22 +192,33 @@ def request_report(spans, device_events=None):
     return rows
 
 
-def print_request_report(rows, top: int, sort: str) -> None:
+def print_request_report(rows, top: int, sort: str,
+                         slo_ms: float = 0.0) -> None:
     key = {"total": "total_ms", "queue": "queue_ms",
            "device": "device_ms"}.get(sort, "total_ms")
     rows = sorted(rows, key=lambda r: r.get(key, 0.0), reverse=True)
     has_dev = any("device_ms" in r for r in rows)
     has_blocks = any("blocks" in r for r in rows)
-    print(f"{len(rows)} request(s); slowest by {key}:")
+    has_keep = any(r.get("keep") for r in rows)
+    breaches = (sum(r["total_ms"] > slo_ms for r in rows) if slo_ms > 0
+                else 0)
+    head = f"{len(rows)} request(s); slowest by {key}"
+    if slo_ms > 0:
+        head += (f"; {breaches} over the {slo_ms:g} ms SLO "
+                 f"(flagged '!')")
+    print(head + ":")
     hdr = (f"{'total':>9} {'queue':>8} {'admit':>8} {'prefill':>8} "
            f"{'exec':>8} {'decode':>8} {'iters':>6}")
     if has_blocks:
         hdr += f" {'blocks':>7} {'pfree':>6}"
     if has_dev:
         hdr += f" {'device':>9}"
+    if has_keep:
+        hdr += f" {'keep':>6}"
     print(hdr + "  trace_id [model]")
     for r in rows[:top]:
-        line = (f"{r['total_ms']:9.3f} {r['queue_ms']:8.3f} "
+        flag = "!" if slo_ms > 0 and r["total_ms"] > slo_ms else " "
+        line = (f"{r['total_ms']:8.3f}{flag} {r['queue_ms']:8.3f} "
                 f"{r['admit_ms']:8.3f} {r.get('prefill_ms', 0.0):8.3f} "
                 f"{r['exec_ms']:8.3f} "
                 f"{r['decode_ms']:8.3f} {r['iters']:6d}")
@@ -208,6 +227,8 @@ def print_request_report(rows, top: int, sort: str) -> None:
                      f"{str(r.get('pool_free', '-')):>6}")
         if has_dev:
             line += f" {r.get('device_ms', 0.0):9.3f}"
+        if has_keep:
+            line += f" {r.get('keep') or '-':>6}"
         # non-request roots (snapshot.pin, table.add, bus.publish) label
         # themselves by span name instead of a model
         print(line + f"  {r['trace_id']} [{r['model'] or r['name']}]")
@@ -224,6 +245,9 @@ def main(argv=None):
                          "host breakdown (+ device merge with TRACE_DIR)")
     ap.add_argument("--sort", choices=["total", "queue", "device"],
                     default="total", help="request-report sort column")
+    ap.add_argument("--slo-ms", type=float, default=0.0,
+                    help="flag requests whose total exceeds this latency "
+                         "SLO and count the breaches (0 = off)")
     args = ap.parse_args(argv)
 
     if args.host_trace is None and args.trace_dir is None:
@@ -233,7 +257,7 @@ def main(argv=None):
     if args.host_trace is not None:
         spans = load_host_spans(args.host_trace)
         rows = request_report(spans, events)
-        print_request_report(rows, args.top, args.sort)
+        print_request_report(rows, args.top, args.sort, args.slo_ms)
         if events is None:
             return 0
         print()
