@@ -1,0 +1,36 @@
+"""Repo-native static analysis + runtime concurrency witnesses.
+
+The stack has two correctness regimes that runtime asserts alone cannot
+enforce at review time:
+
+* **XLA trace discipline** — one compiled trace per engine config, block
+  tables traced as data, no host coercion of traced values. Violations
+  do not crash; they silently recompile and torpedo the p99.
+* **host thread discipline** — batcher, decode loop, watchdog, metrics
+  exporter and the async-PS bus all share locks. PRs 4-6 each hand-fixed
+  a concurrency bug (DerivedCache compute race, reporter detach under
+  the registry lock, leaked reporter threads) a checker would have
+  caught mechanically.
+
+Three tools enforce them:
+
+* :mod:`~multiverso_tpu.analysis.retrace_lint` — AST pass flagging
+  recompile/trace hazards in jit-reachable code (RT1xx rules).
+* :mod:`~multiverso_tpu.analysis.locklint` — AST pass extracting every
+  ``with <lock>`` region, building the inter-lock acquisition graph and
+  flagging cycles, callbacks and blocking calls under locks (LK2xx).
+* :mod:`~multiverso_tpu.analysis.lockwatch` — a runtime witness: an
+  instrumented Lock wrapper recording per-thread acquisition order into
+  a global DAG, tripping ``LOCK_ORDER_VIOLATIONS`` (and a watchdog
+  ``lock_order`` trip) on cycles. Autouse in the test suite; behind the
+  ``-lockwatch`` flag in serving.
+
+Driven by ``tools/lint.py`` with a justified-suppression baseline
+(``tools/lint_baseline.txt``). See docs/ANALYSIS.md for the rule
+catalog and triage guidance.
+
+This ``__init__`` stays import-light on purpose: ``lockwatch`` is
+imported by the serving hot path (dashboard/batcher/engine lock
+construction), so pulling the AST passes in here would tax every
+process start for tooling only ``tools/lint.py`` needs.
+"""

@@ -1,0 +1,168 @@
+"""Shared linter plumbing: findings, baselines, file walking.
+
+A :class:`Finding` is identified by ``rule path::qualname::slug`` —
+deliberately WITHOUT a line number, so a justified suppression survives
+unrelated edits to the same file. The baseline file
+(``tools/lint_baseline.txt``) holds one suppression per line::
+
+    LK203 multiverso_tpu/runtime.py::Session.stop::join -- shutdown is \
+the serialization point; nothing re-enters the Session lock
+
+Everything after ``--`` is the REQUIRED justification: a baseline line
+without one is itself an error (``tools/lint.py`` refuses to run with
+an unjustified suppression — the whole point is that every silenced
+finding carries its defense in-tree).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclass
+class Finding:
+    rule: str          # e.g. "LK203"
+    path: str          # repo-relative posix path
+    line: int          # 1-based line (display only, not identity)
+    qualname: str      # enclosing scope, e.g. "Session.stop" or "<module>"
+    slug: str          # short stable discriminator, e.g. "join"
+    message: str
+
+    @property
+    def identity(self) -> str:
+        return f"{self.rule} {self.path}::{self.qualname}::{self.slug}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} [{self.qualname}"
+                f"::{self.slug}] {self.message}")
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (missing justification, bad shape)."""
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """``{finding identity: justification}`` from a baseline file."""
+    entries: Dict[str, str] = {}
+    if not os.path.exists(path):
+        return entries
+    with open(path) as f:
+        for i, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "--" not in line:
+                raise BaselineError(
+                    f"{path}:{i}: baseline entry has no '-- justification' "
+                    f"part: {line!r}")
+            ident, _, why = line.partition("--")
+            ident = " ".join(ident.split())
+            why = why.strip()
+            if not why:
+                raise BaselineError(
+                    f"{path}:{i}: empty justification for {ident!r}")
+            parts = ident.split(" ")
+            if len(parts) != 2 or "::" not in parts[1]:
+                raise BaselineError(
+                    f"{path}:{i}: expected 'RULE path::qual::slug', "
+                    f"got {ident!r}")
+            entries[ident] = why
+    return entries
+
+
+def split_findings(findings: Iterable[Finding], baseline: Dict[str, str]
+                   ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """``(unsuppressed, suppressed, stale baseline identities)``."""
+    fresh: List[Finding] = []
+    silenced: List[Finding] = []
+    seen = set()
+    for f in findings:
+        if f.identity in baseline:
+            silenced.append(f)
+            seen.add(f.identity)
+        else:
+            fresh.append(f)
+    stale = [ident for ident in baseline if ident not in seen]
+    return fresh, silenced, stale
+
+
+def iter_py_files(paths: Iterable[str],
+                  exclude_parts: Tuple[str, ...] = ("__pycache__",)
+                  ) -> List[str]:
+    """Expand files/directories into a sorted .py file list."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in exclude_parts)
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return sorted(set(out))
+
+
+def rel_posix(path: str, root: Optional[str] = None) -> str:
+    root = root or os.getcwd()
+    try:
+        rel = os.path.relpath(os.path.abspath(path), root)
+    except ValueError:      # pragma: no cover - cross-drive on win
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+@dataclass
+class Module:
+    """One parsed source file plus the derived names other passes need."""
+
+    path: str              # repo-relative posix path
+    name: str              # dotted module name ("multiverso_tpu.trace")
+    tree: ast.Module
+    source: str
+    classes: Dict[str, ast.ClassDef] = field(default_factory=dict)
+    functions: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    # import name -> (module dotted name, attr or None)
+    imports: Dict[str, Tuple[str, Optional[str]]] = field(
+        default_factory=dict)
+
+
+def module_name_for(relpath: str) -> str:
+    stem = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = stem.replace("\\", "/").split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p)
+
+
+def parse_module(path: str, root: Optional[str] = None) -> Optional[Module]:
+    rel = rel_posix(path, root)
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=rel)
+    except (OSError, SyntaxError):
+        return None
+    mod = Module(path=rel, name=module_name_for(rel), tree=tree,
+                 source=source)
+    pkg_parts = mod.name.split(".")[:-1]
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            mod.classes[node.name] = node
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.functions[node.name] = node
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                mod.imports[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name, None)
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:      # resolve relative to this module's package
+                up = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                base = ".".join(up + ([base] if base else []))
+            for alias in node.names:
+                mod.imports[alias.asname or alias.name] = (base, alias.name)
+    return mod

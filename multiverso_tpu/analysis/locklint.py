@@ -1,0 +1,913 @@
+"""Lock-discipline checker: ``with <lock>`` regions -> acquisition graph.
+
+An AST pass over the package that (1) finds every lock a class or
+module creates (``threading.Lock/RLock/Condition``, the
+:mod:`~multiverso_tpu.analysis.lockwatch` factories), (2) extracts every
+``with <lock>:`` region, and (3) walks each region's body —
+*through* resolvable calls (``self.m()``, ``Class.m()``, typed-attribute
+methods and properties, module functions) — collecting what happens
+while the lock is held. Rules:
+
+* **LK201 lock-order-cycle** — the package-wide inter-lock acquisition
+  graph (edge ``A -> B`` when B is acquired while A is held, at any
+  call depth) contains a cycle: two code paths disagree about lock
+  order, which is a deadlock waiting for the right interleaving.
+  Lock identity is name-level (``module.Class.attr``), so the check
+  spans instances; name-level self-edges are skipped (they cannot
+  distinguish an instance hierarchy from an inversion).
+* **LK202 callback-under-lock** — foreign code invoked while a lock is
+  held: an ``on_*``/callback-shaped attribute, a parameter (or a
+  parameter-sourced attribute — the constructor-injected ``fn``), or a
+  Future's ``set_result``/``set_exception``/``add_done_callback``
+  (done-callbacks run inline). The callee can block, re-enter, or take
+  its own locks in an order the holder never audited — the PR 6
+  reporter-detach-under-registry-lock bug, generalized.
+* **LK203 blocking-under-lock** — a call that can park the thread while
+  it holds the lock: ``join``, Event/foreign-Condition ``wait``,
+  ``Queue.get``, ``Future.result``, ``sleep``, socket/subprocess, file
+  I/O, explicit ``acquire``, and JAX work (``jnp.*`` dispatch,
+  ``block_until_ready``, ``device_put``, jitted handles — a dispatch
+  can hide a multi-second compile). Waiting on the Condition you hold
+  is the sanctioned pattern and is exempt.
+* **LK204 lock-fanout-under-lock** — a call made under a lock that
+  transitively acquires ``FANOUT_THRESHOLD`` (3) or more *other* locks:
+  a registry-wide fan-out (``Dashboard.snapshot``/``display``)
+  serializes every instrument behind the caller's private lock.
+
+Heuristic resolution is deliberately conservative: unresolvable calls
+are checked only against the blocking/callback name patterns above, and
+unresolvable ``with`` subjects are ignored. Findings that are by-design
+(e.g. a snapshot copy dispatched under the table lock — the torn-read
+contract) belong in ``tools/lint_baseline.txt`` with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .common import Finding, Module
+
+FANOUT_THRESHOLD = 3
+
+# attribute names whose zero/timeout-arg call parks the thread
+_BLOCKING_ATTRS = {
+    "join": "join", "result": "future-result", "get": "queue-get",
+    "recv": "socket", "recv_into": "socket", "sendall": "socket",
+    "send": "socket", "connect": "socket", "accept": "socket",
+    "write": "io", "flush": "io", "fsync": "io",
+}
+_OS_BLOCKING = {"makedirs", "rename", "replace", "remove", "unlink",
+                "fsync", "system"}
+_SUBPROCESS = {"run", "call", "check_call", "check_output", "Popen"}
+_CALLBACK_ATTR_NAMES = {"callback", "_callback", "emit", "_emit", "hook",
+                        "_hook"}
+_FUTURE_CALLBACK_ATTRS = {"set_result", "set_exception",
+                          "add_done_callback"}
+
+
+def _chain(node: ast.AST) -> Optional[List[str]]:
+    """Attribute/Name chain as names, e.g. ``self._pool.alloc`` ->
+    ``['self', '_pool', 'alloc']``; None for non-name bases."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _is_ctor(call: ast.AST, owner: str, names: Set[str]) -> bool:
+    """``<owner>.<name>(...)`` e.g. threading.Lock()."""
+    if not isinstance(call, ast.Call):
+        return False
+    ch = _chain(call.func)
+    return bool(ch and len(ch) == 2 and ch[0] == owner and ch[1] in names)
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    name: str
+    node: ast.ClassDef
+    bases: List[Tuple[str, str]] = field(default_factory=list)
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    properties: Set[str] = field(default_factory=set)
+    classmethods: Set[str] = field(default_factory=set)
+    lock_attrs: Dict[str, str] = field(default_factory=dict)   # attr -> id
+    cv_alias: Dict[str, str] = field(default_factory=dict)     # cv -> lock id
+    event_attrs: Set[str] = field(default_factory=set)
+    queue_attrs: Set[str] = field(default_factory=set)
+    jit_attrs: Set[str] = field(default_factory=set)
+    callback_attrs: Set[str] = field(default_factory=set)      # param-sourced
+    attr_types: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module, self.name)
+
+
+@dataclass
+class Summary:
+    """What one function does, transitively through resolved calls."""
+
+    acquires: Set[str] = field(default_factory=set)
+    # (slug, detail) pairs; 'wait-on' entries carry the cv's lock id in
+    # detail so callers holding ONLY that lock stay exempt
+    blocking: Set[Tuple[str, str]] = field(default_factory=set)
+    waits_on: Set[str] = field(default_factory=set)
+    callbacks: Set[Tuple[str, str]] = field(default_factory=set)
+
+
+class PackageIndex:
+    """Cross-module symbol table the analyzer resolves against."""
+
+    def __init__(self, modules: Sequence[Module]) -> None:
+        self.modules: Dict[str, Module] = {m.name: m for m in modules}
+        self.classes: Dict[Tuple[str, str], ClassInfo] = {}
+        self.module_locks: Dict[Tuple[str, str], str] = {}   # (mod, var) -> id
+        self.module_var_types: Dict[Tuple[str, str],
+                                    Tuple[str, str]] = {}
+        for m in modules:
+            self._index_module(m)
+
+    # -- build --------------------------------------------------------------
+    def _lock_rhs(self, value: ast.AST) -> Optional[str]:
+        """'lock' | 'rlock' | 'condition' | 'event' | 'queue' | 'jit'
+        for recognized constructor calls, else None."""
+        if not isinstance(value, ast.Call):
+            return None
+        if _is_ctor(value, "threading", {"Lock"}):
+            return "lock"
+        if _is_ctor(value, "threading", {"RLock"}):
+            return "rlock"
+        if _is_ctor(value, "threading", {"Condition"}):
+            return "condition"
+        if _is_ctor(value, "threading", {"Event"}):
+            return "event"
+        if _is_ctor(value, "lockwatch", {"lock"}):
+            return "lock"
+        if _is_ctor(value, "lockwatch", {"rlock"}):
+            return "rlock"
+        if _is_ctor(value, "lockwatch", {"condition"}):
+            return "condition"
+        if _is_ctor(value, "queue", {"Queue", "SimpleQueue", "LifoQueue",
+                                     "PriorityQueue"}):
+            return "queue"
+        if _is_ctor(value, "jax", {"jit", "pjit"}):
+            return "jit"
+        return None
+
+    def _ann_type(self, ann: Optional[ast.AST], mod: Module
+                  ) -> Optional[Tuple[str, str]]:
+        """Resolve ``BlockPool`` / ``Optional[BlockPool]`` annotations."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Subscript):
+            return self._ann_type(ann.slice, mod)
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+            return self._ann_type(ann, mod)
+        ch = _chain(ann)
+        if not ch:
+            return None
+        return self.resolve_class(ch[-1], mod)
+
+    def resolve_class(self, name: str, mod: Module
+                      ) -> Optional[Tuple[str, str]]:
+        if (mod.name, name) in self.classes:
+            return (mod.name, name)
+        imp = mod.imports.get(name)
+        if imp:
+            target_mod, attr = imp
+            if attr and (target_mod, attr) in self.classes:
+                return (target_mod, attr)
+            if attr is None and name in self.modules:
+                return None
+        return None
+
+    def _value_type(self, value: ast.AST, mod: Module
+                    ) -> Optional[Tuple[str, str]]:
+        """Type of ``ClassName(...)`` / ``ClassName.of(...)`` RHS."""
+        if not isinstance(value, ast.Call):
+            return None
+        ch = _chain(value.func)
+        if not ch:
+            return None
+        if len(ch) == 1:
+            return self.resolve_class(ch[0], mod)
+        if len(ch) == 2:
+            # Class.of(...) style alternate constructors
+            cls = self.resolve_class(ch[0], mod)
+            if cls and ch[1] in self.classes[cls].classmethods:
+                return cls
+            # module.Class(...)
+            imp = mod.imports.get(ch[0])
+            if imp and imp[1] is None:
+                target = imp[0]
+                if (target, ch[1]) in self.classes:
+                    return (target, ch[1])
+        return None
+
+    def _index_module(self, mod: Module) -> None:
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                var = stmt.targets[0].id
+                kind = self._lock_rhs(stmt.value)
+                if kind in ("lock", "rlock", "condition"):
+                    self.module_locks[(mod.name, var)] = \
+                        f"{mod.name}.{var}"
+                else:
+                    t = self._value_type(stmt.value, mod)
+                    if t:
+                        self.module_var_types[(mod.name, var)] = t
+        for cls_name, cls_node in mod.classes.items():
+            info = ClassInfo(mod.name, cls_name, cls_node)
+            for base in cls_node.bases:
+                ch = _chain(base)
+                if ch:
+                    resolved = self.resolve_class(ch[-1], mod)
+                    if resolved:
+                        info.bases.append(resolved)
+            for stmt in cls_node.body:
+                if isinstance(stmt, ast.FunctionDef):
+                    info.methods[stmt.name] = stmt
+                    for dec in stmt.decorator_list:
+                        dch = _chain(dec)
+                        if dch == ["property"]:
+                            info.properties.add(stmt.name)
+                        elif dch == ["classmethod"]:
+                            info.classmethods.add(stmt.name)
+                elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    kind = self._lock_rhs(stmt.value)
+                    attr = stmt.targets[0].id
+                    if kind in ("lock", "rlock", "condition"):
+                        info.lock_attrs[attr] = \
+                            f"{mod.name}.{cls_name}.{attr}"
+                    elif kind == "event":
+                        info.event_attrs.add(attr)
+            self.classes[info.key] = info
+        # second pass: self.<attr> assignments inside methods need the
+        # class table complete for attr typing
+        for cls_name in mod.classes:
+            info = self.classes[(mod.name, cls_name)]
+            for meth in info.methods.values():
+                self._index_method_attrs(info, meth, mod)
+
+    def _index_method_attrs(self, info: ClassInfo, meth: ast.FunctionDef,
+                            mod: Module) -> None:
+        params = {a.arg for a in meth.args.args + meth.args.kwonlyargs
+                  if a.arg not in ("self", "cls")}
+        for node in ast.walk(meth):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            ann = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets, value, ann = [node.target], node.value, \
+                    node.annotation
+            for tgt in targets:
+                ch = _chain(tgt)
+                if not ch or len(ch) != 2 or ch[0] != "self":
+                    continue
+                attr = ch[1]
+                mid = f"{mod.name}.{info.name}.{attr}"
+                kind = self._lock_rhs(value) if value is not None else None
+                if kind in ("lock", "rlock"):
+                    info.lock_attrs[attr] = mid
+                elif kind == "condition":
+                    arg_ch = (_chain(value.args[0])
+                              if isinstance(value, ast.Call) and value.args
+                              else None)
+                    if (arg_ch and len(arg_ch) == 2 and arg_ch[0] == "self"
+                            and arg_ch[1] in info.lock_attrs):
+                        info.cv_alias[attr] = info.lock_attrs[arg_ch[1]]
+                    else:
+                        info.lock_attrs[attr] = mid
+                elif kind == "event":
+                    info.event_attrs.add(attr)
+                elif kind == "queue":
+                    info.queue_attrs.add(attr)
+                elif kind == "jit":
+                    info.jit_attrs.add(attr)
+                elif (isinstance(value, ast.Name)
+                      and value.id in params):
+                    info.callback_attrs.add(attr)
+                elif (isinstance(value, ast.Attribute)
+                      and value.attr.startswith("on_")):
+                    info.callback_attrs.add(attr)
+                t = self._ann_type(ann, mod) or (
+                    self._value_type(value, mod)
+                    if value is not None else None)
+                if t:
+                    info.attr_types[attr] = t
+
+    # -- lookups ------------------------------------------------------------
+    def class_attr(self, key: Tuple[str, str], table: str, attr: str):
+        """Walk a class and its bases for ``attr`` in ``table``."""
+        seen = set()
+        stack = [key]
+        while stack:
+            k = stack.pop()
+            if k in seen or k not in self.classes:
+                continue
+            seen.add(k)
+            info = self.classes[k]
+            val = getattr(info, table).get(attr) \
+                if isinstance(getattr(info, table), dict) \
+                else (attr if attr in getattr(info, table) else None)
+            if val is not None:
+                return val
+            stack.extend(info.bases)
+        return None
+
+    def find_method(self, key: Tuple[str, str], name: str
+                    ) -> Optional[Tuple[ClassInfo, ast.FunctionDef]]:
+        seen = set()
+        stack = [key]
+        while stack:
+            k = stack.pop()
+            if k in seen or k not in self.classes:
+                continue
+            seen.add(k)
+            info = self.classes[k]
+            if name in info.methods:
+                return info, info.methods[name]
+            stack.extend(info.bases)
+        return None
+
+
+class _FunctionAnalyzer(ast.NodeVisitor):
+    """Walks one function body with a held-lock stack, populating the
+    function's :class:`Summary` and emitting findings for work done
+    while locks are held."""
+
+    def __init__(self, linter: "LockLint", mod: Module,
+                 cls: Optional[ClassInfo], func: ast.FunctionDef,
+                 qualname: str) -> None:
+        self.linter = linter
+        self.mod = mod
+        self.cls = cls
+        self.func = func
+        self.qualname = qualname
+        self.summary = Summary()
+        self.held: List[str] = []
+        self.local_types: Dict[str, Tuple[str, str]] = {}
+        self.local_callbacks: Set[str] = set()
+        self.params = {a.arg for a in func.args.args + func.args.kwonlyargs
+                       + ([func.args.vararg] if func.args.vararg else [])
+                       + ([func.args.kwarg] if func.args.kwarg else [])
+                       if a is not None and a.arg not in ("self", "cls")}
+        self.local_funcs: Dict[str, ast.FunctionDef] = {}
+        self._emitted: Set[Tuple[str, str]] = set()
+
+    # -- helpers ------------------------------------------------------------
+    def _finding(self, rule: str, slug: str, line: int, msg: str) -> None:
+        if (rule, slug) in self._emitted:
+            return
+        self._emitted.add((rule, slug))
+        self.linter.findings.append(Finding(
+            rule=rule, path=self.mod.path, line=line,
+            qualname=self.qualname, slug=slug, message=msg))
+
+    def _resolve_lock(self, node: ast.AST) -> Optional[str]:
+        ch = _chain(node)
+        if not ch:
+            return None
+        if len(ch) == 1:
+            return self.linter.index.module_locks.get((self.mod.name, ch[0]))
+        if len(ch) == 2:
+            base, attr = ch
+            if base in ("self", "cls") and self.cls is not None:
+                key = self.cls.key
+            else:
+                key = self.linter.index.resolve_class(base, self.mod)
+                if key is None:
+                    return None
+            cv = self.linter.index.class_attr(key, "cv_alias", attr)
+            if cv:
+                return cv
+            return self.linter.index.class_attr(key, "lock_attrs", attr)
+        return None
+
+    def _receiver_type(self, node: ast.AST) -> Optional[Tuple[str, str]]:
+        ch = _chain(node)
+        if not ch:
+            return None
+        if len(ch) == 1:
+            name = ch[0]
+            if name in self.local_types:
+                return self.local_types[name]
+            t = self.linter.index.module_var_types.get(
+                (self.mod.name, name))
+            if t:
+                return t
+            return None
+        if len(ch) == 2 and ch[0] in ("self", "cls") \
+                and self.cls is not None:
+            return self.linter.index.class_attr(
+                self.cls.key, "attr_types", ch[1])
+        return None
+
+    def _apply_summary(self, summary: Summary, line: int,
+                       via: str) -> None:
+        """Fold a callee summary into this function (and, when locks are
+        held here, into findings/edges)."""
+        self.summary.acquires |= summary.acquires
+        self.summary.blocking |= summary.blocking
+        self.summary.callbacks |= summary.callbacks
+        self.summary.waits_on |= summary.waits_on
+        if not self.held:
+            return
+        heldset = set(self.held)
+        for lid in summary.acquires:
+            for h in self.held:
+                if h != lid:
+                    self.linter.add_edge(h, lid, self.mod.path, line)
+        for slug, detail in sorted(summary.blocking):
+            self._finding("LK203", slug, line,
+                          f"blocking call ({detail}) via {via}() while "
+                          f"holding {self.held[-1]}")
+        for cvlock in sorted(summary.waits_on):
+            if heldset - {cvlock}:
+                self._finding(
+                    "LK203", "wait", line,
+                    f"condition wait on {cvlock} via {via}() while also "
+                    f"holding {sorted(heldset - {cvlock})}")
+        for slug, detail in sorted(summary.callbacks):
+            self._finding("LK202", slug, line,
+                          f"callback invocation ({detail}) via {via}() "
+                          f"while holding {self.held[-1]}")
+        others = {lid for lid in summary.acquires if lid not in heldset}
+        if len(others) >= FANOUT_THRESHOLD:
+            self._finding(
+                "LK204", "fanout", line,
+                f"call to {via}() acquires {len(others)} other locks "
+                f"({sorted(others)[:4]}...) while holding "
+                f"{self.held[-1]} — a registry fan-out serialized behind "
+                f"a private lock")
+
+    def _blocking(self, slug: str, detail: str, line: int) -> None:
+        self.summary.blocking.add((slug, detail))
+        if self.held:
+            self._finding("LK203", slug, line,
+                          f"blocking call ({detail}) while holding "
+                          f"{self.held[-1]}")
+
+    def _callback(self, slug: str, detail: str, line: int) -> None:
+        self.summary.callbacks.add((slug, detail))
+        if self.held:
+            self._finding("LK202", slug, line,
+                          f"callback invocation ({detail}) while holding "
+                          f"{self.held[-1]}")
+
+    # -- visitors -----------------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node is self.func:
+            self.generic_visit(node)
+        else:
+            self.local_funcs[node.name] = node    # body analyzed on call
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return                                    # deferred code
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            lid = self._resolve_lock(item.context_expr)
+            if lid is not None:
+                if self.held:
+                    self.summary.acquires.add(lid)
+                    for h in self.held:
+                        if h != lid:
+                            self.linter.add_edge(h, lid, self.mod.path,
+                                                 node.lineno)
+                else:
+                    self.summary.acquires.add(lid)
+                self.held.append(lid)
+                pushed += 1
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            t = None
+            if isinstance(node.value, (ast.Attribute, ast.Name)):
+                t = self._receiver_type(node.value)
+                ch = _chain(node.value)
+                if (ch and len(ch) == 2 and ch[0] in ("self", "cls")
+                        and self.cls is not None):
+                    if (ch[1].startswith("on_")
+                            or self.linter.index.class_attr(
+                                self.cls.key, "callback_attrs", ch[1])):
+                        self.local_callbacks.add(name)
+            elif isinstance(node.value, ast.Call):
+                t = self.linter.index._value_type(node.value, self.mod)
+            if t:
+                self.local_types[name] = t
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # property loads acquire locks too (BlockPool.n_free)
+        if isinstance(node.ctx, ast.Load):
+            t = self._receiver_type(node.value)
+            if t is not None:
+                info = self.linter.index.classes.get(t)
+                if info and node.attr in info.properties:
+                    found = self.linter.index.find_method(t, node.attr)
+                    if found:
+                        summ = self.linter.summarize(
+                            found[0], found[1],
+                            f"{found[0].name}.{node.attr}")
+                        self._apply_summary(summ, node.lineno,
+                                            f"{t[1]}.{node.attr}")
+        self.generic_visit(node)
+
+    def _resolve_call(self, node: ast.Call
+                      ) -> Optional[Tuple[ClassInfo, ast.FunctionDef, str]]:
+        ch = _chain(node.func)
+        idx = self.linter.index
+        if not ch:
+            return None
+        if len(ch) == 1:
+            name = ch[0]
+            if name in self.local_funcs:
+                return (self.cls, self.local_funcs[name],
+                        f"{self.qualname}.{name}")
+            if name in self.mod.functions:
+                return (None, self.mod.functions[name], name)
+            imp = self.mod.imports.get(name)
+            if imp and imp[1] is not None:
+                target = idx.modules.get(imp[0])
+                if target and imp[1] in target.functions:
+                    return (None, target.functions[imp[1]],
+                            f"{imp[0]}.{imp[1]}")
+            cls_key = idx.resolve_class(name, self.mod)
+            if cls_key:
+                found = idx.find_method(cls_key, "__init__")
+                if found:
+                    return (found[0], found[1], f"{cls_key[1]}.__init__")
+            return None
+        if len(ch) == 2:
+            base, meth = ch
+            if base in ("self", "cls") and self.cls is not None:
+                found = idx.find_method(self.cls.key, meth)
+                if found:
+                    return (found[0], found[1],
+                            f"{self.cls.name}.{meth}")
+                return None
+            cls_key = idx.resolve_class(base, self.mod)
+            if cls_key:
+                found = idx.find_method(cls_key, meth)
+                if found:
+                    return (found[0], found[1], f"{cls_key[1]}.{meth}")
+                return None
+            imp = self.mod.imports.get(base)
+            if imp and imp[1] is None:
+                target = idx.modules.get(imp[0])
+                if target and meth in target.functions:
+                    return (None, target.functions[meth],
+                            f"{imp[0]}.{meth}")
+            t = self._receiver_type(ast.Name(id=base, ctx=ast.Load()))
+            if t:
+                found = idx.find_method(t, meth)
+                if found:
+                    return (found[0], found[1], f"{t[1]}.{meth}")
+            return None
+        if len(ch) == 3 and ch[0] in ("self", "cls") \
+                and self.cls is not None:
+            t = self.linter.index.class_attr(
+                self.cls.key, "attr_types", ch[1])
+            if t:
+                found = idx.find_method(t, ch[2])
+                if found:
+                    return (found[0], found[1], f"{t[1]}.{ch[2]}")
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        line = node.lineno
+        ch = _chain(node.func)
+        handled = False
+        if ch:
+            handled = self._check_call_chain(node, ch, line)
+        if not handled:
+            resolved = self._resolve_call(node)
+            if resolved is not None:
+                cls, fn, qual = resolved
+                summ = self.linter.summarize(cls, fn, qual)
+                self._apply_summary(summ, line, qual)
+            elif ch:
+                self._heuristic_call(node, ch, line)
+        for arg in node.args:
+            self.visit(arg)
+        for kw in node.keywords:
+            self.visit(kw.value)
+
+    def _check_call_chain(self, node: ast.Call, ch: List[str],
+                          line: int) -> bool:
+        """Pattern checks that preempt resolution. Returns True when the
+        call is fully handled."""
+        idx = self.linter.index
+        last = ch[-1]
+        # waiting on a condition variable
+        if last in ("wait", "wait_for") and len(ch) >= 2:
+            recv = ch[:-1]
+            lid = self._resolve_lock(
+                ast.parse(".".join(recv), mode="eval").body
+                if all(p.isidentifier() for p in recv) else ast.Name(
+                    id="?", ctx=ast.Load()))
+            if lid is not None:
+                # cv.wait: releases its own lock; blocking only if OTHER
+                # locks are held across the sleep
+                self.summary.waits_on.add(lid)
+                others = set(self.held) - {lid}
+                if others:
+                    self._finding(
+                        "LK203", "wait", line,
+                        f"condition wait on {lid} while also holding "
+                        f"{sorted(others)}")
+                return True
+            # event / foreign wait — flagged alike (any .wait() while a
+            # lock is held blocks the holder); a recognized Event attr
+            # gets named in the detail so the report reads as intent
+            is_event = (len(ch) == 2 and ch[0] in ("self", "cls")
+                        and self.cls is not None
+                        and idx.class_attr(self.cls.key, "event_attrs",
+                                           ch[1]))
+            detail = ".".join(ch) + (" (threading.Event)" if is_event else "")
+            self._blocking("wait", detail, line)
+            return True
+        if last == "sleep" and len(ch) == 2 and ch[0] == "time":
+            self._blocking("sleep", "time.sleep", line)
+            return True
+        if last == "acquire" and len(ch) >= 2:
+            recv_lock = self._resolve_lock(node.func.value)
+            if recv_lock is not None:
+                self.summary.acquires.add(recv_lock)
+                for h in self.held:
+                    if h != recv_lock:
+                        self.linter.add_edge(h, recv_lock, self.mod.path,
+                                             line)
+                if self.held:
+                    self._finding(
+                        "LK203", "acquire", line,
+                        f"explicit acquire of {recv_lock} while holding "
+                        f"{self.held[-1]}")
+                return True
+        if ch[0] == "os" and last in _OS_BLOCKING:
+            self._blocking("io", ".".join(ch), line)
+            return True
+        if ch[0] == "subprocess" and last in _SUBPROCESS:
+            self._blocking("subprocess", ".".join(ch), line)
+            return True
+        if ch == ["open"]:
+            self._blocking("io", "open", line)
+            return True
+        if ch[0] == "jax" and last in ("block_until_ready",):
+            self._blocking("jax-sync", "jax.block_until_ready", line)
+            return True
+        if ch[0] == "jax" and last in ("device_put", "device_get"):
+            self._blocking("jax-dispatch", ".".join(ch), line)
+            return True
+        if ch[0] == "jax" and len(ch) == 3 and ch[1] == "tree" \
+                and last == "map":
+            self._blocking("jax-dispatch", "jax.tree.map", line)
+            return True
+        if ch[0] in ("jnp", "lax"):
+            self._blocking("jax-dispatch", ".".join(ch), line)
+            return True
+        # jitted-handle dispatch: self._step(...) where _step = jax.jit(..)
+        if len(ch) == 2 and ch[0] in ("self", "cls") \
+                and self.cls is not None \
+                and idx.class_attr(self.cls.key, "jit_attrs", ch[1]):
+            self._blocking("jax-dispatch",
+                           f"jitted handle self.{ch[1]}", line)
+            return True
+        # callbacks
+        if last in _FUTURE_CALLBACK_ATTRS and len(ch) >= 2:
+            self._callback("future-callbacks", ".".join(ch), line)
+            return True
+        if last.startswith("on_") or last in _CALLBACK_ATTR_NAMES:
+            self._callback("callback", ".".join(ch), line)
+            return True
+        if len(ch) == 1 and (ch[0] in self.params
+                             or ch[0] in self.local_callbacks):
+            self._callback("param-call", f"parameter {ch[0]}()", line)
+            return True
+        if len(ch) == 2 and ch[0] in ("self", "cls") \
+                and self.cls is not None \
+                and idx.class_attr(self.cls.key, "callback_attrs", ch[1]):
+            self._callback("param-call",
+                           f"constructor-injected self.{ch[1]}()", line)
+            return True
+        return False
+
+    def _heuristic_call(self, node: ast.Call, ch: List[str],
+                        line: int) -> None:
+        """Unresolvable callee: name-pattern blocking checks only."""
+        last = ch[-1]
+        slug = _BLOCKING_ATTRS.get(last)
+        if slug is None:
+            return
+        if last == "join" and (node.args or len(ch) < 2):
+            return                     # str.join / os.path.join
+        if last == "result" and node.args:
+            return
+        if last == "get":
+            # `.get` is hopelessly overloaded (dict.get, Gauge/Counter
+            # .get, Queue.get): flag only a receiver that is a KNOWN
+            # queue attribute of this class or whose name says queue
+            # (`self._queue.get()`, `work_q.get()`) — anything else is
+            # overwhelmingly a non-blocking read
+            is_queue = (len(ch) >= 2 and ch[0] in ("self", "cls")
+                        and self.cls is not None
+                        and self.linter.index.class_attr(
+                            self.cls.key, "queue_attrs", ch[-2]))
+            recv = ch[-2].lower() if len(ch) >= 2 else ""
+            queueish = ("queue" in recv or recv == "q"
+                        or recv.endswith("_q"))
+            if not is_queue and not queueish:
+                return
+        if last in ("write", "flush", "fsync") and len(ch) < 2:
+            return
+        self._blocking(slug, ".".join(ch), line)
+
+    def run(self) -> Summary:
+        for stmt in self.func.body:
+            self.visit(stmt)
+        return self.summary
+
+
+class LockLint:
+    """Package-wide lock-discipline analysis."""
+
+    def __init__(self, modules: Sequence[Module]) -> None:
+        self.index = PackageIndex(modules)
+        self.modules = list(modules)
+        self.findings: List[Finding] = []
+        self._summaries: Dict[int, Summary] = {}
+        self._in_progress: Set[int] = set()
+        # edge -> (path, line) of first sighting
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add_edge(self, held: str, acquired: str, path: str,
+                 line: int) -> None:
+        if held == acquired:
+            return
+        self.edges.setdefault((held, acquired), (path, line))
+
+    def summarize(self, cls: Optional[ClassInfo], fn: ast.FunctionDef,
+                  qual: str) -> Summary:
+        key = id(fn)
+        if key in self._summaries:
+            return self._summaries[key]
+        if key in self._in_progress:           # recursion: fixpoint-lite
+            return Summary()
+        self._in_progress.add(key)
+        mod = None
+        if cls is not None:
+            mod = self.index.modules.get(cls.module)
+        if mod is None:
+            mod = self._module_of(fn)
+        if mod is None:                        # pragma: no cover
+            self._in_progress.discard(key)
+            return Summary()
+        analyzer = _FunctionAnalyzer(self, mod, cls, fn, qual)
+        summary = analyzer.run()
+        self._in_progress.discard(key)
+        self._summaries[key] = summary
+        return summary
+
+    def _module_of(self, fn: ast.FunctionDef) -> Optional[Module]:
+        for m in self.modules:
+            for node in ast.walk(m.tree):
+                if node is fn:
+                    return m
+        return None
+
+    # -- entry --------------------------------------------------------------
+    def run(self) -> List[Finding]:
+        for mod in self.modules:
+            for fname, fnode in mod.functions.items():
+                self._analyze_entry(mod, None, fnode, fname)
+            for cname, cnode in mod.classes.items():
+                info = self.index.classes[(mod.name, cname)]
+                for mname, mnode in info.methods.items():
+                    self._analyze_entry(mod, info, mnode,
+                                        f"{cname}.{mname}")
+        self._cycle_findings()
+        return self.findings
+
+    def _analyze_entry(self, mod: Module, cls: Optional[ClassInfo],
+                       fn: ast.FunctionDef, qual: str) -> None:
+        key = id(fn)
+        if key in self._summaries:
+            return
+        self._in_progress.add(key)
+        analyzer = _FunctionAnalyzer(self, mod, cls, fn, qual)
+        summary = analyzer.run()
+        self._in_progress.discard(key)
+        self._summaries[key] = summary
+
+    def _cycle_findings(self) -> None:
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+        sccs = _tarjan(adj)
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            nodes = sorted(scc)
+            example = [(a, b) for (a, b) in sorted(self.edges)
+                       if a in scc and b in scc]
+            path, line = self.edges[example[0]]
+            short = "+".join(n.rsplit(".", 2)[-2] + "." + n.rsplit(".", 1)[-1]
+                             for n in nodes)
+            self.findings.append(Finding(
+                rule="LK201", path=path, line=line,
+                qualname="<lock-graph>", slug=short,
+                message=(f"lock-order cycle among {nodes}: edges "
+                         f"{example[:6]} — two paths disagree about "
+                         f"acquisition order (latent deadlock)")))
+
+    def graph_report(self) -> str:
+        lines = ["inter-lock acquisition graph (held -> acquired):"]
+        for (a, b), (path, line) in sorted(self.edges.items()):
+            lines.append(f"  {a} -> {b}   (first: {path}:{line})")
+        return "\n".join(lines)
+
+
+def _tarjan(adj: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Strongly connected components (iterative Tarjan)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[Set[str]] = []
+    counter = [0]
+    nodes = set(adj) | {b for vs in adj.values() for b in vs}
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    for n in sorted(nodes):
+        if n not in index:
+            strongconnect(n)
+    return sccs
+
+
+def lint_modules(modules: Sequence[Module]) -> Tuple[List[Finding],
+                                                     LockLint]:
+    linter = LockLint(modules)
+    findings = linter.run()
+    return findings, linter

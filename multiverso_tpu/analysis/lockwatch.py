@@ -1,0 +1,426 @@
+"""Runtime lock-order witness: acquisition-order DAG + cycle trips.
+
+``locklint`` proves what the *source* can do; this module watches what
+the *process* actually does. Locks created through the :func:`lock` /
+:func:`rlock` / :func:`condition` factories are thin wrappers over the
+``threading`` primitives that, while the witness is enabled, record
+every acquisition into a per-thread held stack and every (held ->
+acquired) pair into one process-global order graph. The first edge that
+closes a cycle — thread 1 takes A then B, thread 2 takes B then A,
+*ever*, even minutes apart — is a latent deadlock, and it trips:
+
+* the ``LOCK_ORDER_VIOLATIONS`` Dashboard counter increments,
+* the violation (edge, cycle path, holder stack, thread) is recorded
+  for the conftest guard and :class:`~..serving.watchdog.EngineWatchdog`
+  (which turns new violations into a ``lock_order`` trip), and
+* an error line is logged with the full cycle.
+
+Identity is the CANONICAL NAME given at construction (e.g.
+``serving.decode_engine.DecodeEngine._lock``), not the object: two
+engines share one node, so an ordering proven safe for one instance is
+demanded of all of them. Edges between two locks of the *same* name
+(instance A's lock then instance B's) are not recorded — a name-level
+self-edge cannot distinguish a deliberate instance hierarchy from an
+inversion, and the repo has no same-class nesting today.
+
+Cost posture: disabled (the default outside tests), an acquisition pays
+one module-global boolean read. Enabled, it pays a thread-local list
+append/pop, and the global graph lock ONLY when a never-before-seen
+edge appears (bounded by the number of distinct lock *pairs*, not
+acquisitions) — measured within container noise on the serving bench
+(docs/ANALYSIS.md). Enable with the ``-lockwatch`` flag in serving, or
+``enable()`` directly; the test suite enables it autouse and asserts
+the DAG is acyclic and fully released after every test.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+__all__ = [
+    "lock", "rlock", "condition", "enable", "disable", "enabled",
+    "violations", "violation_count", "edges", "held_snapshot",
+    "assert_released", "check_acyclic", "forget", "clear", "WatchedLock",
+    "Violation",
+]
+
+_enabled = False
+
+# the witness's own bookkeeping lock: a PLAIN threading.Lock on purpose
+# (watching the watcher would recurse), guarding the edge graph, the
+# violation list and the per-thread held-stack registry
+_graph_lock = threading.Lock()
+_adj: Dict[str, set] = {}              # name -> set of names acquired after
+_edge_set: set = set()                 # {(held, acquired)} fast membership
+_violations: List["Violation"] = []
+# tid -> [per-acquisition entries]; each thread mutates only its own
+# list (GIL-safe), the registry itself is mutated under _graph_lock
+_held: Dict[int, List["_Held"]] = {}
+
+_tls = threading.local()
+
+
+class Violation(NamedTuple):
+    """One lock-order cycle, recorded at the acquisition that closed it."""
+
+    thread: str
+    edge: Tuple[str, str]     # the (held, acquired) pair that closed it
+    cycle: Tuple[str, ...]    # acquired -> ... -> held -> acquired
+    held: Tuple[str, ...]     # the acquiring thread's full holder stack
+
+    def describe(self) -> str:
+        return (f"lock-order cycle on thread {self.thread!r}: acquiring "
+                f"{self.edge[1]!r} while holding {self.edge[0]!r} closes "
+                f"{' -> '.join(self.cycle)}")
+
+
+class _Held:
+    __slots__ = ("obj_id", "name", "depth")
+
+    def __init__(self, obj_id: int, name: str) -> None:
+        self.obj_id = obj_id
+        self.name = name
+        self.depth = 1
+
+
+def _my_stack() -> List[_Held]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+        with _graph_lock:
+            _held[threading.get_ident()] = stack
+    return stack
+
+
+def _cycle_path(src: str, dst: str) -> Optional[Tuple[str, ...]]:
+    """Path src -> ... -> dst along recorded edges (callers hold
+    ``_graph_lock``); adding dst -> src would then close a cycle."""
+    seen = {src}
+    path = [src]
+
+    def dfs(node: str) -> bool:
+        for nxt in sorted(_adj.get(node, ())):
+            if nxt == dst:
+                path.append(dst)
+                return True
+            if nxt not in seen:
+                seen.add(nxt)
+                path.append(nxt)
+                if dfs(nxt):
+                    return True
+                path.pop()
+        return False
+
+    return tuple(path) if dfs(src) else None
+
+
+def _record_violation(v: Violation) -> None:
+    # lazy, defensive: the Dashboard import is deferred (dashboard.py
+    # imports THIS module for its lock factories) and a failure to
+    # count must never break the acquiring thread
+    try:
+        from ..dashboard import Dashboard
+
+        Dashboard.get_or_create_counter("LOCK_ORDER_VIOLATIONS").inc()
+    except Exception:       # pragma: no cover - import-order edge cases
+        pass
+    try:
+        from ..log import Log
+
+        Log.error("lockwatch: %s", v.describe())
+    except Exception:       # pragma: no cover
+        pass
+
+
+def _on_acquired(wl: "WatchedLock") -> None:
+    """Post-acquisition hook (the lock IS held when this runs)."""
+    stack = _my_stack()
+    for entry in stack:
+        if entry.obj_id == id(wl):      # reentrant re-acquire (RLock)
+            entry.depth += 1
+            return
+    entry = _Held(id(wl), wl.name)
+    new_violations: List[Violation] = []
+    if stack:
+        holder_names = tuple(e.name for e in stack)
+        for held in stack:
+            if held.name == wl.name:    # name-level self-edge: skip
+                continue
+            edge = (held.name, wl.name)
+            if edge in _edge_set:       # optimistic read; GIL-safe
+                continue
+            with _graph_lock:
+                if edge in _edge_set:
+                    continue
+                cycle = _cycle_path(wl.name, held.name)
+                _edge_set.add(edge)
+                _adj.setdefault(held.name, set()).add(wl.name)
+                if cycle is not None:
+                    v = Violation(threading.current_thread().name, edge,
+                                  cycle + (wl.name,), holder_names)
+                    _violations.append(v)
+                    new_violations.append(v)
+    stack.append(entry)
+    # counter/log OUTSIDE the graph lock: the Dashboard counter has its
+    # own (plain) lock and must not nest under the witness's
+    for v in new_violations:
+        _record_violation(v)
+
+
+def _on_released(wl: "WatchedLock") -> None:
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i].obj_id == id(wl):
+            stack[i].depth -= 1
+            if stack[i].depth == 0:
+                del stack[i]
+            return
+
+
+class WatchedLock:
+    """Lock/RLock wrapper recording acquisition order while enabled.
+
+    Duck-compatible with ``threading.Lock`` (``acquire``/``release``/
+    context manager/``locked``) and usable as the underlying lock of a
+    ``threading.Condition`` — the Condition's wait/notify machinery goes
+    through ``acquire``/``release``, so a ``cv.wait()`` correctly drops
+    the lock from the holder stack for its sleep and re-records it on
+    wake.
+    """
+
+    __slots__ = ("_inner", "name")
+
+    def __init__(self, inner, name: str) -> None:
+        self._inner = inner
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and _enabled:
+            _on_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        # pop UNCONDITIONALLY: gating this on _enabled leaves a stale
+        # held-stack entry when the witness is disabled between a
+        # lock's acquire and its release — the phantom hold then feeds
+        # a bogus (stale -> X) edge into every later acquisition on
+        # this thread, and assert_released() reports a lock held
+        # forever. The pop is a cheap scan and a no-op when the
+        # acquire was never recorded.
+        _on_released(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _release_save(self):
+        """``threading.Condition`` wait-path hook. Forwarding matters
+        for RLock-backed watched locks: Condition's default fallback is
+        a SINGLE release(), so a reentrant holder (depth >= 2) would go
+        to sleep still holding the underlying RLock — the notifier could
+        never acquire it, a permanent deadlock. The witness entry is
+        dropped whole (all recursion levels) and its depth rides the
+        saved state so the wake restores it exactly."""
+        stack = getattr(_tls, "stack", None)
+        depth = 0
+        if stack:
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i].obj_id == id(self):
+                    depth = stack[i].depth
+                    del stack[i]
+                    break
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            return (inner._release_save(), depth)
+        inner.release()
+        return (None, depth)
+
+    def _acquire_restore(self, state):
+        inner_state, depth = state
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(inner_state)
+        else:
+            inner.acquire()
+        if _enabled:
+            _on_acquired(self)
+            if depth > 1:
+                for entry in _my_stack():
+                    if entry.obj_id == id(self):
+                        entry.depth = depth
+                        break
+
+    def _is_owned(self) -> bool:
+        """``threading.Condition`` ownership probe. Delegating (instead
+        of the Condition's try-acquire fallback) matters for RLock-backed
+        watched locks: a reentrant try-acquire would SUCCEED for the
+        owning thread and misreport not-owned."""
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"WatchedLock({self.name!r}, {self._inner!r})"
+
+
+def lock(name: str) -> WatchedLock:
+    """A watched ``threading.Lock`` under canonical ``name``."""
+    return WatchedLock(threading.Lock(), name)
+
+
+def rlock(name: str) -> WatchedLock:
+    """A watched ``threading.RLock`` (reentrant re-acquisition bumps a
+    depth count instead of recording a new edge)."""
+    return WatchedLock(threading.RLock(), name)
+
+
+def condition(lk: Optional[WatchedLock] = None,
+              name: str = "") -> threading.Condition:
+    """A ``threading.Condition`` over a watched lock. Pass the
+    :class:`WatchedLock` it should share (the engine/batcher pattern:
+    one lock, one condition) or a name to mint a fresh one."""
+    if lk is None:
+        lk = lock(name or "condition")
+    return threading.Condition(lk)
+
+
+# -- lifecycle / introspection ------------------------------------------------
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def violations() -> List[Violation]:
+    with _graph_lock:
+        return list(_violations)
+
+
+def violation_count() -> int:
+    return len(_violations)       # list len read is GIL-atomic
+
+
+def edges() -> set:
+    with _graph_lock:
+        return set(_edge_set)
+
+
+def held_snapshot() -> Dict[str, List[str]]:
+    """Currently-held watched locks per thread (threads holding none are
+    omitted) — the conftest fully-released guard's read."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    with _graph_lock:
+        items = [(tid, list(stack)) for tid, stack in _held.items()]
+    for tid, stack in items:
+        if stack:
+            out[names.get(tid, f"tid-{tid}")] = [e.name for e in stack]
+    return out
+
+
+def assert_released(timeout_s: float = 5.0) -> None:
+    """Assert no thread holds a watched lock, retrying for ``timeout_s``
+    (running daemon threads hold locks transiently; only a hold that
+    PERSISTS across the window is a leak/wedge)."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        held = held_snapshot()
+        if not held:
+            return
+        if time.monotonic() >= deadline:
+            raise AssertionError(
+                f"watched locks still held after {timeout_s:g}s: {held}")
+        time.sleep(0.02)
+
+
+def check_acyclic() -> List[Tuple[str, ...]]:
+    """Cycles currently present in the recorded order graph (empty =
+    DAG). :data:`violations` catches cycles at the edge that closed
+    them; this re-derives the property from the graph itself — the
+    end-of-test invariant the conftest guard asserts."""
+    with _graph_lock:
+        adj = {k: sorted(v) for k, v in _adj.items()}
+    cycles: List[Tuple[str, ...]] = []
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    for root in sorted(adj):
+        if color.get(root, WHITE) != WHITE:
+            continue
+        stack: List[Tuple[str, int]] = [(root, 0)]
+        path = [root]
+        color[root] = GREY
+        while stack:
+            node, i = stack[-1]
+            nxts = adj.get(node, [])
+            if i < len(nxts):
+                stack[-1] = (node, i + 1)
+                nxt = nxts[i]
+                c = color.get(nxt, WHITE)
+                if c == GREY:
+                    cycles.append(tuple(path[path.index(nxt):]) + (nxt,))
+                elif c == WHITE:
+                    color[nxt] = GREY
+                    stack.append((nxt, 0))
+                    path.append(nxt)
+            else:
+                color[node] = BLACK
+                stack.pop()
+                path.pop()
+    return cycles
+
+
+def forget(name_prefix: str) -> None:
+    """Drop edges and violations touching locks whose name starts with
+    ``name_prefix`` — tests that deliberately seed an inversion clean up
+    after themselves without wiping the real tree's recorded order."""
+    with _graph_lock:
+        _violations[:] = [v for v in _violations
+                          if not (v.edge[0].startswith(name_prefix)
+                                  or v.edge[1].startswith(name_prefix))]
+        _edge_set.difference_update(
+            {e for e in _edge_set if e[0].startswith(name_prefix)
+             or e[1].startswith(name_prefix)})
+        for src in list(_adj):
+            if src.startswith(name_prefix):
+                del _adj[src]
+            else:
+                _adj[src] = {d for d in _adj[src]
+                             if not d.startswith(name_prefix)}
+
+
+def clear() -> None:
+    """Reset the whole witness (graph, violations, dead-thread stacks).
+    Edges re-accumulate from live traffic; per-thread held stacks of
+    RUNNING threads are left alone (they reflect real state)."""
+    with _graph_lock:
+        _adj.clear()
+        _edge_set.clear()
+        _violations.clear()
+        live = {t.ident for t in threading.enumerate()}
+        for tid in [t for t in _held if t not in live]:
+            del _held[tid]

@@ -1,0 +1,838 @@
+"""Trace-hazard linter: recompile/retrace hazards in jit-reachable code.
+
+The repo's hardest-won perf invariant is ONE compiled trace per engine
+config (ROADMAP "one-trace invariant"): block tables ride the jitted
+step as data, shapes never depend on the request mix, and a single
+accidental host round-trip or per-iteration ``jax.jit`` erases the
+continuous-batching win silently — no crash, just a p99 that rots. This
+AST pass flags the hazard patterns statically:
+
+* **RT101 jit-in-loop** — ``jax.jit``/``pjit`` CONSTRUCTED inside a
+  ``for``/``while`` body or comprehension. Each construction is a fresh
+  callable with a cold cache: the loop recompiles every iteration.
+* **RT102 traced-host-coercion** — inside a jit-traced function,
+  ``int()``/``float()``/``bool()`` of a traced value, ``.item()``/
+  ``.tolist()``, or ``np.*`` applied to traced arguments. Under trace
+  these either raise ``ConcretizationTypeError`` or silently force a
+  host sync + constant-fold that retraces per value. ``x.shape``/
+  ``.dtype``/``.ndim``/``len(x)`` are static under trace and exempt.
+* **RT103 traced-python-branch** — ``if``/``while``/``assert``/ternary
+  on a traced value (or a Python ``for`` iterating one): control flow
+  must go through ``jnp.where``/``lax.cond``; a Python branch bakes the
+  taken side into the trace and retraces (or raises) on the other.
+* **RT104 mutable-static** — a jitted closure capturing a name bound to
+  a mutable literal (list/dict/set/``np.array``) in an enclosing scope,
+  or a call site passing a list/dict/set literal in a
+  ``static_argnums``/``static_argnames`` position. Statics key the
+  compile cache by hash/equality; mutables either throw
+  (unhashable) or — worse — mutate without retriggering a trace.
+* **RT105 donated-reuse** — a value read again after being passed in a
+  ``donate_argnums`` position of a jitted handle without reassignment.
+  The donated buffer may already be aliased into the output; reading it
+  is use-after-free on accelerators (and a silent defensive copy +
+  retrace on CPU — the 2.4->22 ms/step regression PR 2 measured).
+* **RT106 jit-in-iteration-path** — the one-trace invariant, enforced
+  structurally: in any class with a ``_loop`` method (the engine
+  shape), no ``jax.jit``/``pjit`` construction may be reachable from
+  ``_loop`` via self-calls. Jits belong to construction
+  (``__init__``) and ``warmup`` only.
+
+Jit-traced functions are found per module (decorated ``@jax.jit`` /
+``@partial(jax.jit, ...)``, wrapped ``jax.jit(f)``, jitted lambdas) and
+taint propagates intra-module: a helper called from a traced function
+with traced arguments is analyzed with those parameters traced too —
+which is how ``models/transformer.py``'s kernel helpers get covered
+without any annotations.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .common import Finding, Module
+
+_SHAPE_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+_COERCERS = {"int", "float", "bool", "complex"}
+_NP_NAMES = {"np", "numpy", "onp"}
+
+
+def _chain(node: ast.AST) -> Optional[List[str]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def _own_nodes(stmt: ast.stmt) -> List[ast.AST]:
+    """AST nodes belonging directly to ``stmt`` — its own expressions,
+    NOT the statements nested in its body/orelse/handlers. Those nested
+    statements appear in the flattened statement list themselves; walking
+    into them here would scan every ``with lock: x = f(x)`` body twice
+    (once via the With, once via the Assign) and mis-order the
+    read-vs-donate phases."""
+    out: List[ast.AST] = []
+    work: List[ast.AST] = [stmt]
+    while work:
+        node = work.pop()
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, ast.stmt):
+                work.append(child)
+    return out
+
+
+def _is_jit_func(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jax.pjit`` / bare ``jit``/``pjit`` reference."""
+    ch = _chain(node)
+    if not ch:
+        return False
+    if ch in (["jax", "jit"], ["jax", "pjit"], ["pjit", "pjit"]):
+        return True
+    return len(ch) == 1 and ch[0] in ("jit", "pjit")
+
+
+def _jit_construction(call: ast.Call) -> bool:
+    if _is_jit_func(call.func):
+        return True
+    # functools.partial(jax.jit, ...)
+    ch = _chain(call.func)
+    if ch and ch[-1] == "partial" and call.args \
+            and _is_jit_func(call.args[0]):
+        return True
+    return False
+
+
+def _literal_int_tuple(node: Optional[ast.AST]) -> Optional[Tuple[int, ...]]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _literal_str_tuple(node: Optional[ast.AST]) -> Optional[Tuple[str, ...]]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _mutable_literal(node: Optional[ast.AST]) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        ch = _chain(node.func)
+        if ch and len(ch) == 1 and ch[0] in ("list", "dict", "set",
+                                             "bytearray"):
+            return True
+        if ch and len(ch) == 2 and ch[0] in _NP_NAMES \
+                and ch[1] in ("array", "zeros", "ones", "empty", "full",
+                              "arange"):
+            return True
+    return False
+
+
+@dataclass
+class _JitSite:
+    """One jax.jit/pjit construction."""
+
+    call: ast.Call
+    qualname: str
+    target: Optional[ast.AST]            # FunctionDef | Lambda | None
+    target_name: Optional[str]
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    donate_argnums: Optional[Tuple[int, ...]] = None
+    handle: Optional[Tuple[str, ...]] = None   # assignment target chain
+    in_loop: bool = False
+
+
+@dataclass
+class _Scope:
+    node: ast.AST                         # FunctionDef | Lambda | Module
+    qualname: str
+    parent: Optional["_Scope"]
+    defs: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    mutable_names: Dict[str, int] = field(default_factory=dict)
+    assigned: Set[str] = field(default_factory=set)
+
+
+class _ScopeCollector(ast.NodeVisitor):
+    """First pass: scope tree, function defs, jit sites, loop nesting."""
+
+    def __init__(self, mod: Module) -> None:
+        self.mod = mod
+        self.root = _Scope(mod.tree, "<module>", None)
+        self.scopes: Dict[int, _Scope] = {id(mod.tree): self.root}
+        self.jit_sites: List[_JitSite] = []
+        self._stack: List[_Scope] = [self.root]
+        self._loop_depth = 0
+
+    def _qual(self, name: str) -> str:
+        cur = self._stack[-1].qualname
+        return name if cur == "<module>" else f"{cur}.{name}"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        scope = _Scope(node, self._qual(node.name), self._stack[-1])
+        self.scopes[id(node)] = scope
+        self._stack.append(scope)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._stack[-1].defs[node.name] = node
+        scope = _Scope(node, self._qual(node.name), self._stack[-1])
+        self.scopes[id(node)] = scope
+        self._stack.append(scope)
+        outer_loop, self._loop_depth = self._loop_depth, 0
+        self.generic_visit(node)
+        self._loop_depth = outer_loop
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        scope = _Scope(node, self._qual("<lambda>"), self._stack[-1])
+        self.scopes[id(node)] = scope
+        self._stack.append(scope)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_loop(self, node: ast.AST) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _visit_loop
+    visit_ListComp = visit_SetComp = visit_DictComp = \
+        visit_GeneratorExp = _visit_loop
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        scope = self._stack[-1]
+        for tgt in node.targets:
+            for name_node in ast.walk(tgt):
+                if isinstance(name_node, ast.Name):
+                    scope.assigned.add(name_node.id)
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name) \
+                and _mutable_literal(node.value):
+            scope.mutable_names[node.targets[0].id] = node.lineno
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _jit_construction(node):
+            site = self._make_site(node)
+            site.in_loop = self._loop_depth > 0
+            self.jit_sites.append(site)
+        self.generic_visit(node)
+
+    def _make_site(self, call: ast.Call) -> _JitSite:
+        target = None
+        target_name = None
+        args = call.args
+        if _chain(call.func) and _chain(call.func)[-1] == "partial":
+            args = call.args[1:]
+        if args:
+            arg0 = args[0]
+            if isinstance(arg0, ast.Lambda):
+                target = arg0
+            elif isinstance(arg0, ast.Name):
+                target_name = arg0.id
+                target = self._lookup_def(arg0.id)
+        statics: Tuple[int, ...] = ()
+        static_names: Tuple[str, ...] = ()
+        donate = None
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                statics = _literal_int_tuple(kw.value) or ()
+            elif kw.arg == "static_argnames":
+                static_names = _literal_str_tuple(kw.value) or ()
+            elif kw.arg == "donate_argnums":
+                donate = _literal_int_tuple(kw.value)
+        return _JitSite(call=call, qualname=self._stack[-1].qualname,
+                        target=target, target_name=target_name,
+                        static_argnums=statics,
+                        static_argnames=static_names,
+                        donate_argnums=donate)
+
+    def _lookup_def(self, name: str) -> Optional[ast.FunctionDef]:
+        scope: Optional[_Scope] = self._stack[-1]
+        while scope is not None:
+            if name in scope.defs:
+                return scope.defs[name]
+            scope = scope.parent
+        return None
+
+
+class _TaintChecker(ast.NodeVisitor):
+    """Flags host-coercion / python-branch hazards inside one traced
+    function, given its traced parameter names. Records intra-module
+    call propagation requests."""
+
+    def __init__(self, linter: "RetraceLint", func: ast.AST,
+                 qualname: str, tainted: Set[str]) -> None:
+        self.linter = linter
+        self.func = func
+        self.qualname = qualname
+        self.tainted = set(tainted)
+        self.calls: List[Tuple[str, List[bool], int]] = []
+
+    # -- taint of an expression ---------------------------------------------
+    def _expr_tainted(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                # x.shape / len(x) are static under trace: only a use
+                # OUTSIDE such metadata contexts makes the expr dynamic
+                if self._under_shape_attr(node, sub):
+                    continue
+                return True
+        return False
+
+    @staticmethod
+    def _under_shape_attr(root: ast.AST, target: ast.Name) -> bool:
+        """True when ``target`` only appears as ``target.shape``-style
+        static metadata (or inside ``len(...)``) within ``root``."""
+        class Finder(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.dynamic = False
+
+            def visit_Attribute(self, node: ast.Attribute) -> None:
+                if node.attr in _SHAPE_ATTRS:
+                    return          # subtree is static metadata
+                self.generic_visit(node)
+
+            def visit_Call(self, node: ast.Call) -> None:
+                ch = _chain(node.func)
+                if ch == ["len"]:
+                    return          # len() of traced is static
+                self.generic_visit(node)
+
+            def visit_Compare(self, node: ast.Compare) -> None:
+                # `x is None` / `x is not None` is an IDENTITY check —
+                # static under trace (a tracer is never None), and the
+                # standard JAX optional-argument dispatch idiom
+                if all(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in node.ops) \
+                        and all(isinstance(c, ast.Constant)
+                                and c.value is None
+                                for c in node.comparators):
+                    return
+                self.generic_visit(node)
+
+            def visit_Name(self, node: ast.Name) -> None:
+                if node is target:
+                    self.dynamic = True
+
+        f = Finder()
+        f.visit(root)
+        return not f.dynamic
+
+    def _flag(self, rule: str, slug: str, node: ast.AST,
+              msg: str) -> None:
+        self.linter.add_finding(rule, slug, getattr(node, "lineno", 1),
+                                self.qualname, msg)
+
+    # -- statements ---------------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        if self._expr_tainted(node.value):
+            for tgt in node.targets:
+                for nn in ast.walk(tgt):
+                    if isinstance(nn, ast.Name):
+                        self.tainted.add(nn.id)
+        self.generic_visit_targets(node)
+
+    def generic_visit_targets(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+                self.visit(tgt)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        if self._expr_tainted(node.value) and isinstance(node.target,
+                                                         ast.Name):
+            self.tainted.add(node.target.id)
+
+    def visit_If(self, node: ast.If) -> None:
+        if self._expr_tainted(node.test):
+            self._flag("RT103", "branch", node,
+                       "Python `if` on a traced value — use jnp.where/"
+                       "lax.cond (a traced branch bakes one side into "
+                       "the compiled trace)")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if self._expr_tainted(node.test):
+            self._flag("RT103", "branch", node,
+                       "Python `while` on a traced value — use "
+                       "lax.while_loop")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        if self._expr_tainted(node.test):
+            self._flag("RT103", "branch", node,
+                       "ternary on a traced value — use jnp.where")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if self._expr_tainted(node.test):
+            self._flag("RT103", "assert", node,
+                       "assert on a traced value forces a host sync "
+                       "under trace")
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        # A tuple-unpacking target (`for rows, occ in sets`) or an
+        # enumerate/zip/range iterator means a *Python container* of
+        # traced values — static-length unrolling, the sanctioned JAX
+        # idiom — not iteration over a traced array's leading axis.
+        container = isinstance(node.target, (ast.Tuple, ast.List))
+        if isinstance(node.iter, ast.Call):
+            fch = _chain(node.iter.func)
+            if fch and fch[-1] in ("enumerate", "zip", "range"):
+                container = True
+        if not container and self._expr_tainted(node.iter):
+            self._flag("RT103", "iterate", node,
+                       "Python `for` over a traced value unrolls (and "
+                       "retraces per length) — use lax.scan/fori_loop")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        ch = _chain(node.func)
+        if ch:
+            if len(ch) == 1 and ch[0] in _COERCERS \
+                    and any(self._expr_tainted(a) for a in node.args):
+                self._flag("RT102", "coerce", node,
+                           f"{ch[0]}() of a traced value — a host "
+                           "concretization under trace")
+            elif ch[-1] in ("item", "tolist") and len(ch) >= 2 \
+                    and isinstance(node.func, ast.Attribute) \
+                    and self._expr_tainted(node.func.value):
+                self._flag("RT102", "item", node,
+                           f".{ch[-1]}() on a traced value — device "
+                           "sync + concretization under trace")
+            elif ch[0] in _NP_NAMES and len(ch) >= 2 \
+                    and any(self._expr_tainted(a) for a in node.args):
+                self._flag("RT102", "numpy", node,
+                           f"{'.'.join(ch)}() applied to a traced value "
+                           "— numpy concretizes (use jnp)")
+            elif len(ch) == 1:
+                # intra-module propagation request
+                taint_mask = [self._expr_tainted(a) for a in node.args]
+                if any(taint_mask):
+                    self.calls.append((ch[0], taint_mask, node.lineno))
+        self.generic_visit(node)
+
+    def run(self) -> None:
+        body = self.func.body if isinstance(
+            self.func, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+            else [ast.Expr(value=self.func.body)]
+        for stmt in body:
+            self.visit(stmt)
+
+
+class RetraceLint:
+    """Per-module trace-hazard analysis."""
+
+    def __init__(self, mod: Module) -> None:
+        self.mod = mod
+        self.findings: List[Finding] = []
+        self._emitted: Set[Tuple[str, str, str]] = set()
+        collector = _ScopeCollector(mod)
+        collector.visit(mod.tree)
+        self.collector = collector
+
+    def add_finding(self, rule: str, slug: str, line: int, qual: str,
+                    msg: str) -> None:
+        key = (rule, qual, slug)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        self.findings.append(Finding(rule=rule, path=self.mod.path,
+                                     line=line, qualname=qual, slug=slug,
+                                     message=msg))
+
+    # -- entry --------------------------------------------------------------
+    def run(self) -> List[Finding]:
+        self._rt101_jit_in_loop()
+        jit_targets = self._traced_targets()
+        self._rt102_103_taint(jit_targets)
+        self._rt104_mutable_static()
+        self._rt105_donated_reuse()
+        self._rt106_loop_reachable_jit()
+        return self.findings
+
+    # -- RT101 --------------------------------------------------------------
+    def _rt101_jit_in_loop(self) -> None:
+        for site in self.collector.jit_sites:
+            if site.in_loop:
+                self.add_finding(
+                    "RT101", "jit-in-loop", site.call.lineno, site.qualname,
+                    "jax.jit constructed inside a loop — every iteration "
+                    "builds a fresh callable with a cold compile cache; "
+                    "hoist the construction out of the loop")
+
+    # -- RT102/RT103 --------------------------------------------------------
+    def _decorated_targets(self) -> List[Tuple[ast.FunctionDef,
+                                               Tuple[int, ...],
+                                               Tuple[str, ...], str]]:
+        out = []
+        for scope in self.collector.scopes.values():
+            node = scope.node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                statics: Tuple[int, ...] = ()
+                static_names: Tuple[str, ...] = ()
+                is_jit = _is_jit_func(dec)
+                if isinstance(dec, ast.Call) and _jit_construction(dec):
+                    is_jit = True
+                    for kw in dec.keywords:
+                        if kw.arg == "static_argnums":
+                            statics = _literal_int_tuple(kw.value) or ()
+                        elif kw.arg == "static_argnames":
+                            static_names = _literal_str_tuple(
+                                kw.value) or ()
+                if is_jit:
+                    out.append((node, statics, static_names,
+                                scope.qualname))
+        return out
+
+    def _traced_params(self, func: ast.AST, statics: Tuple[int, ...],
+                       static_names: Tuple[str, ...]) -> Set[str]:
+        args = func.args
+        names = [a.arg for a in args.args]
+        traced = set()
+        for i, name in enumerate(names):
+            if i in statics or name in static_names:
+                continue
+            if name in ("self", "cls"):
+                continue
+            traced.add(name)
+        traced.update(a.arg for a in args.kwonlyargs
+                      if a.arg not in static_names)
+        return traced
+
+    def _traced_targets(self) -> List[Tuple[ast.AST, str, Set[str]]]:
+        """(function node, qualname, traced param names) for every
+        jit-traced function in the module."""
+        out: List[Tuple[ast.AST, str, Set[str]]] = []
+        seen: Set[int] = set()
+        for site in self.collector.jit_sites:
+            if site.target is None or id(site.target) in seen:
+                continue
+            seen.add(id(site.target))
+            scope = self.collector.scopes.get(id(site.target))
+            qual = scope.qualname if scope else site.qualname
+            out.append((site.target, qual, self._traced_params(
+                site.target, site.static_argnums, site.static_argnames)))
+        for node, statics, static_names, qual in self._decorated_targets():
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            out.append((node, qual, self._traced_params(
+                node, statics, static_names)))
+        return out
+
+    def _rt102_103_taint(self, targets: List[Tuple[ast.AST, str,
+                                                   Set[str]]]) -> None:
+        # worklist: (func node, qual, traced names); propagate through
+        # same-module calls whose arguments are tainted
+        taints: Dict[int, Set[str]] = {}
+        queue: List[Tuple[ast.AST, str, Set[str]]] = list(targets)
+        guard = 0
+        while queue and guard < 500:
+            guard += 1
+            func, qual, traced = queue.pop()
+            prev = taints.get(id(func), set())
+            merged = prev | traced
+            if merged == prev and guard > len(targets):
+                continue
+            taints[id(func)] = merged
+            checker = _TaintChecker(self, func, qual, merged)
+            checker.run()
+            for callee_name, mask, _line in checker.calls:
+                callee = self._lookup_any_def(callee_name)
+                if callee is None:
+                    continue
+                params = [a.arg for a in callee.args.args]
+                callee_traced = {params[i] for i, t in enumerate(mask)
+                                 if t and i < len(params)}
+                if not callee_traced:
+                    continue
+                scope = self.collector.scopes.get(id(callee))
+                cqual = scope.qualname if scope else callee_name
+                if not callee_traced <= taints.get(id(callee), set()):
+                    queue.append((callee, cqual, callee_traced))
+
+    def _lookup_any_def(self, name: str) -> Optional[ast.FunctionDef]:
+        fn = self.mod.functions.get(name)
+        if fn is not None:
+            return fn
+        for scope in self.collector.scopes.values():
+            if name in scope.defs:
+                return scope.defs[name]
+        return None
+
+    # -- RT104 --------------------------------------------------------------
+    def _rt104_mutable_static(self) -> None:
+        for site in self.collector.jit_sites:
+            target = site.target
+            if target is not None:
+                scope = self.collector.scopes.get(id(target))
+                free = self._free_names(target)
+                enclosing = scope.parent if scope else None
+                while enclosing is not None:
+                    hits = free & set(enclosing.mutable_names)
+                    for name in sorted(hits):
+                        self.add_finding(
+                            "RT104", "mutable-capture",
+                            site.call.lineno, site.qualname,
+                            f"jitted function closes over {name!r}, "
+                            f"bound to a mutable literal at line "
+                            f"{enclosing.mutable_names[name]} — a "
+                            "mutation never retriggers tracing "
+                            "(stale constant baked into the trace)")
+                    free -= hits
+                    enclosing = enclosing.parent
+        self._rt104_static_callsites()
+
+    def _rt104_static_callsites(self) -> None:
+        """Calls of a jitted handle passing a list/dict/set literal in a
+        ``static_argnums`` position (unhashable compile-cache key)."""
+        handles: Dict[Tuple[str, ...], Tuple[int, ...]] = {}
+        jit_calls = {id(s.call) for s in self.collector.jit_sites}
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call) \
+                    and id(node.value) in jit_calls:
+                statics = ()
+                for kw in node.value.keywords:
+                    if kw.arg == "static_argnums":
+                        statics = _literal_int_tuple(kw.value) or ()
+                tch = _chain(node.targets[0])
+                if tch and statics:
+                    handles[tuple(tch)] = statics
+        if not handles:
+            return
+        for scope in self.collector.scopes.values():
+            node = scope.node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fch = _chain(sub.func)
+                key = tuple(fch) if fch else None
+                if key not in handles:
+                    continue
+                for pos in handles[key]:
+                    if pos < len(sub.args) and isinstance(
+                            sub.args[pos], (ast.List, ast.Dict, ast.Set)):
+                        self.add_finding(
+                            "RT104", "unhashable-static", sub.lineno,
+                            scope.qualname,
+                            f"call of jitted {'.'.join(key)} passes a "
+                            f"mutable literal at static position {pos} "
+                            "— statics key the compile cache by "
+                            "hash/equality; pass a tuple or hashable "
+                            "config")
+
+    def _free_names(self, func: ast.AST) -> Set[str]:
+        bound = {a.arg for a in func.args.args + func.args.kwonlyargs}
+        if func.args.vararg:
+            bound.add(func.args.vararg.arg)
+        if func.args.kwarg:
+            bound.add(func.args.kwarg.arg)
+        loaded: Set[str] = set()
+        body = func.body if isinstance(func.body, list) else [func.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name):
+                    if isinstance(node.ctx, ast.Store):
+                        bound.add(node.id)
+                    elif isinstance(node.ctx, ast.Load):
+                        loaded.add(node.id)
+        return loaded - bound
+
+    # -- RT105 --------------------------------------------------------------
+    def _rt105_donated_reuse(self) -> None:
+        # handle chain -> (donated positions, jit-call node id), for jit
+        # sites assigned to a name/attr with a literal donate_argnums.
+        # The call id lets the per-function scan notice the handle name
+        # being REBOUND to something else (a non-donating jit in another
+        # branch) and stop treating its calls as donations.
+        handles: Dict[Tuple[str, ...], Tuple[Tuple[int, ...], int]] = {}
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call) \
+                    and _jit_construction(node.value):
+                donate = None
+                for kw in node.value.keywords:
+                    if kw.arg == "donate_argnums":
+                        donate = _literal_int_tuple(kw.value)
+                if not donate:
+                    continue
+                tch = _chain(node.targets[0])
+                if tch:
+                    handles[tuple(tch)] = (donate, id(node.value))
+        if not handles:
+            return
+        for scope in self.collector.scopes.values():
+            node = scope.node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            self._scan_donated_in(node, scope.qualname, handles)
+
+    def _scan_donated_in(self, func: ast.FunctionDef, qual: str,
+                         handles: Dict[Tuple[str, ...],
+                                       Tuple[Tuple[int, ...], int]]
+                         ) -> None:
+        consumed: Dict[Tuple[str, ...], int] = {}   # chain -> donate line
+        dead: Set[Tuple[str, ...]] = set()          # handles rebound here
+        # statement-ordered scan over the flattened body (nested blocks
+        # in source order; nested defs excluded). _own_nodes keeps each
+        # statement's expressions from being scanned again under its
+        # enclosing compound statement (with/if/try).
+        for stmt in self._ordered_stmts(func):
+            # phase 1: reads of already-donated chains in THIS statement
+            for node in _own_nodes(stmt):
+                if not consumed:
+                    break
+                if isinstance(node, (ast.Name, ast.Attribute)) \
+                        and isinstance(getattr(node, "ctx", None),
+                                       ast.Load):
+                    ch = _chain(node)
+                    if ch and tuple(ch) in consumed:
+                        self.add_finding(
+                            "RT105", "donated-reuse", node.lineno, qual,
+                            f"{'.'.join(ch)} read after being donated at "
+                            f"line {consumed[tuple(ch)]} — the buffer may "
+                            "already be aliased into the jit output "
+                            "(use-after-donate)")
+                        del consumed[tuple(ch)]
+            # phase 2: new donations from calls in this statement (a
+            # same-statement assignment back to the chain revokes it)
+            assigned: Set[Tuple[str, ...]] = set()
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    elts = tgt.elts if isinstance(
+                        tgt, (ast.Tuple, ast.List)) else [tgt]
+                    for e in elts:
+                        ech = _chain(e)
+                        if ech:
+                            assigned.add(tuple(ech))
+                # the handle name rebound to anything other than its
+                # registered donating jit: its later calls don't donate
+                for ch_t in assigned:
+                    entry = handles.get(ch_t)
+                    if entry is None:
+                        continue
+                    if id(stmt.value) != entry[1]:
+                        dead.add(ch_t)
+                    else:
+                        dead.discard(ch_t)   # the registering assign
+            for node in _own_nodes(stmt):
+                if isinstance(node, ast.Call):
+                    fch = _chain(node.func)
+                    key = tuple(fch) if fch else None
+                    if key in handles and key not in dead:
+                        for pos in handles[key][0]:
+                            if pos < len(node.args):
+                                ach = _chain(node.args[pos])
+                                if ach and tuple(ach) not in assigned:
+                                    consumed[tuple(ach)] = node.lineno
+            for ch_t in assigned:
+                consumed.pop(ch_t, None)
+
+    @staticmethod
+    def _ordered_stmts(func: ast.FunctionDef) -> List[ast.stmt]:
+        """Statements of ``func`` in source order, flattened through
+        nested blocks but NOT into nested function defs."""
+        out: List[ast.stmt] = []
+
+        def rec(body: List[ast.stmt]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                out.append(stmt)
+                for name in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, name, None)
+                    if sub:
+                        rec(sub)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    rec(handler.body)
+
+        rec(func.body)
+        return out
+
+    # -- RT106 --------------------------------------------------------------
+    def _rt106_loop_reachable_jit(self) -> None:
+        for cls_name, cls_node in self.mod.classes.items():
+            methods = {n.name: n for n in cls_node.body
+                       if isinstance(n, ast.FunctionDef)}
+            if "_loop" not in methods:
+                continue
+            reachable: Set[str] = set()
+            queue = ["_loop"]
+            while queue:
+                mname = queue.pop()
+                if mname in reachable or mname not in methods:
+                    continue
+                reachable.add(mname)
+                for node in ast.walk(methods[mname]):
+                    if isinstance(node, ast.Call):
+                        ch = _chain(node.func)
+                        if ch and len(ch) == 2 and ch[0] == "self":
+                            queue.append(ch[1])
+            reachable.discard("warmup")
+            for mname in sorted(reachable):
+                for node in ast.walk(methods[mname]):
+                    if isinstance(node, ast.Call) \
+                            and _jit_construction(node):
+                        self.add_finding(
+                            "RT106", "jit-in-iteration-path", node.lineno,
+                            f"{cls_name}.{mname}",
+                            "jax.jit constructed in a method reachable "
+                            "from the engine iteration path (_loop) — "
+                            "the one-trace invariant allows jit "
+                            "construction only in __init__/warmup")
+
+
+def lint_module(mod: Module) -> List[Finding]:
+    return RetraceLint(mod).run()
+
+
+def lint_modules(modules: Sequence[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in modules:
+        out.extend(lint_module(mod))
+    return out

@@ -16,6 +16,7 @@ parsing CLI text.
 from __future__ import annotations
 
 import threading
+from .analysis import lockwatch
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
@@ -54,7 +55,7 @@ class FlagRegister:
 
     def __init__(self) -> None:
         self._flags: Dict[str, _Flag] = {}
-        self._lock = threading.RLock()
+        self._lock = lockwatch.rlock("config.FlagRegister._lock")
 
     # -- declaration ------------------------------------------------------
     def define(self, name: str, type_: type, default: Any, description: str = "") -> None:
@@ -62,12 +63,24 @@ class FlagRegister:
             raise TypeError(f"unsupported flag type {type_!r}")
         with self._lock:
             if name in self._flags:
+                # re-definition: keep the current value WITHOUT re-running
+                # the coercer — the default may no longer coerce, and the
+                # original contract never touched it on this path
+                if self._flags[name].type is not type_:
+                    raise FlagError(f"flag {name!r} redefined with different type")
+                return
+        # coerce OUTSIDE the registry lock: type_ is caller-supplied code
+        # (locklint LK202 callback-under-lock), and a default whose
+        # coercion raises must not do so while holding the lock
+        value = type_(default)
+        with self._lock:
+            if name in self._flags:
                 # Re-definition with identical type keeps the current value
                 # (module reloads in tests); type conflict is an error.
                 if self._flags[name].type is not type_:
                     raise FlagError(f"flag {name!r} redefined with different type")
                 return
-            self._flags[name] = _Flag(name, type_, type_(default), description)
+            self._flags[name] = _Flag(name, type_, value, description)
 
     # -- access -----------------------------------------------------------
     def get(self, name: str) -> Any:
@@ -296,3 +309,9 @@ define_float("slo_itl_ms", 0.0,
 define_float("slo_lat_ms", 0.0,
              "serving SLO: p99 enqueue-to-reply latency target per "
              "micro-batched model; 0 = no SLO registered")
+define_bool("lockwatch", False,
+            "runtime lock-order witness: record per-thread acquisition "
+            "order of every framework lock into a global DAG; a cycle "
+            "(latent deadlock) increments LOCK_ORDER_VIOLATIONS and "
+            "trips engine watchdogs with kind 'lock_order' "
+            "(docs/ANALYSIS.md; always on in the test suite)")

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+from .analysis import lockwatch
 import time
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Optional
@@ -48,7 +49,7 @@ class Monitor:
         self.count = 0
         self.total_ms = 0.0
         self._local = threading.local()
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("dashboard.Monitor._lock")
         if register:
             Dashboard.add_monitor(self)
 
@@ -103,7 +104,7 @@ class Histogram:
         self._buf = [0.0] * int(window)
         self._n = 0                         # filled slots (<= window)
         self._pos = 0                       # next write slot
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("dashboard.Histogram._lock")
         if register:
             Dashboard.add_histogram(self)
 
@@ -221,7 +222,7 @@ class Gauge:
     def __init__(self, name: str, register: bool = True) -> None:
         self.name = name
         self._value = 0.0
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("dashboard.Gauge._lock")
         if register:
             Dashboard.add_gauge(self)
 
@@ -249,7 +250,7 @@ class Counter:
     def __init__(self, name: str, register: bool = True) -> None:
         self.name = name
         self._value = 0
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("dashboard.Counter._lock")
         if register:
             Dashboard.add_counter(self)
 
@@ -323,7 +324,7 @@ class Dashboard:
     # running reporter/watchdog threads (anything with .detach());
     # reset() stops them so tests can't leak threads across each other
     _reporters: List[Any] = []
-    _lock = threading.Lock()
+    _lock = lockwatch.lock("dashboard.Dashboard._lock")
 
     @classmethod
     def add_monitor(cls, mon: Monitor) -> None:
@@ -704,7 +705,16 @@ class MetricsExporter:
         self._emit = emit
         self._last: Optional[Dict[str, Dict[str, Any]]] = None
         self._last_ts: Optional[float] = None
-        self._lock = threading.Lock()
+        # interval math runs on the monotonic clock — a wall-clock step
+        # (NTP) must not skew per-second delta rates; _last_ts is the
+        # archived wall timestamp
+        self._last_mono: Optional[float] = None
+        # serializes snapshot+commit PAIRS across concurrent
+        # report_once calls (see its docstring); distinct from _lock so
+        # prometheus()/stop() never wait behind a registry sweep
+        self._report_lock = lockwatch.lock(
+            "dashboard.MetricsExporter._report_lock")
+        self._lock = lockwatch.lock("dashboard.MetricsExporter._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.reports = 0
@@ -737,19 +747,34 @@ class MetricsExporter:
     def report_once(self) -> dict:
         """Take one snapshot, compute interval deltas, write one line.
 
-        The lock covers only the last-snapshot state, NOT the sink
-        write: a stalled sink (full disk, hung NFS) must not block a
-        concurrent ``prometheus()`` scrape or ``stop()``, and an
+        ``_lock`` covers only the last-snapshot state, NOT the registry
+        fan-out or the sink write: ``Dashboard.snapshot()`` acquires the
+        registry lock plus every instrument's (locklint LK204 — holding
+        ``_lock`` across it would serialize concurrent ``prometheus()``
+        scrapes and ``stop()`` behind the whole sweep), and a stalled
+        sink (full disk, hung NFS) must not block them either; an
         ``emit`` callback may safely call back into the exporter.
+
+        ``_report_lock`` spans the snapshot+commit pair so concurrent
+        calls (the reporter loop racing ``stop()``'s final report after
+        a hung-sink join timeout) commit in snapshot order — without
+        it, an older snapshot could commit as newest and the following
+        report would double-count the interval its deltas re-span. It
+        is touched by NOTHING else, so the LK204 concern above does not
+        apply to it: scrapes and stop() never wait behind the sweep.
         """
-        with self._lock:
+        with self._report_lock:
             snap = Dashboard.snapshot()
             now = time.time()
-            dt = (now - self._last_ts) if self._last_ts is not None else None
-            record = {"ts": now, "interval_s": dt, "snapshot": snap,
-                      "deltas": self._deltas(snap, dt)}
-            self._last, self._last_ts = snap, now
-            self.reports += 1
+            mono = time.monotonic()
+            with self._lock:
+                dt = ((mono - self._last_mono)
+                      if self._last_mono is not None else None)
+                record = {"ts": now, "interval_s": dt, "snapshot": snap,
+                          "deltas": self._deltas(snap, dt)}
+                self._last, self._last_ts = snap, now
+                self._last_mono = mono
+                self.reports += 1
         line = json.dumps(record)
         if self._sink_path is not None:
             with open(self._sink_path, "a") as f:

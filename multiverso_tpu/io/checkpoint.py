@@ -22,6 +22,7 @@ import os
 import re
 import shutil
 import threading
+from ..analysis import lockwatch
 import time
 from typing import List, Optional, Tuple
 
@@ -260,7 +261,7 @@ class Autosaver:
         self._keep = max(keep, 1)
         self._session = session
         self._last_time = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("io.Autosaver._lock")
 
     def step(self, step: int) -> bool:
         """Maybe checkpoint at ``step``; returns True if a save happened."""

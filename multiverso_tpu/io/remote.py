@@ -23,11 +23,12 @@ from __future__ import annotations
 
 import io
 import threading
+from ..analysis import lockwatch
 from typing import BinaryIO
 
 from ..log import Log
 
-_lock = threading.Lock()
+_lock = lockwatch.lock("io.remote._lock")
 _memory_context = None   # shared so memory:// writes persist per-process
 
 

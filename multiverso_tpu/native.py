@@ -19,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+from .analysis import lockwatch
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -26,7 +27,7 @@ import numpy as np
 from .log import Log
 
 _LIB_ENV = "MV_NATIVE_LIB"
-_lock = threading.Lock()
+_lock = lockwatch.lock("native._lock")
 _lib: Optional[ctypes.CDLL] = None
 # Must match MV_EXT_ABI_VERSION in cpp/c_api.h (rev 2: f64 SvmData values).
 _EXT_ABI_VERSION = 2

@@ -76,6 +76,7 @@ from __future__ import annotations
 import io
 import struct
 import threading
+from ..analysis import lockwatch
 import time
 from typing import Any, Deque, List, Optional, Sequence, Tuple
 
@@ -102,7 +103,7 @@ _PART_HEADER = struct.Struct("<BII")   # kind=PART, part_index, n_parts
 # (stop() drains collectively, so no record outlives its Session).
 _published = 0
 _consumed: dict = {}
-_state_lock = threading.Lock()
+_state_lock = lockwatch.lock("parallel.async_ps._state_lock")
 # the counters above are rank-keyed and process-wide, which is only sound
 # for ONE live bus per process (documented lifecycle); a second concurrent
 # Session would silently share them — refuse loudly instead
@@ -156,8 +157,8 @@ class AsyncDeltaBus:
         self._size = sess.size
         self._interval = poll_interval
         self._filters: dict = {}   # np.dtype -> SparseFilter (typed wire)
-        self._pub_lock = threading.Lock()
-        self._drain_lock = threading.Lock()
+        self._pub_lock = lockwatch.lock("parallel.AsyncDeltaBus._pub_lock")
+        self._drain_lock = lockwatch.lock("parallel.AsyncDeltaBus._drain_lock")
         self._stop = threading.Event()
         self._max_record = max(
             int(config.get_flag("async_max_record_kb")), 64) << 10

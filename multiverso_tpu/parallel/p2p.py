@@ -53,6 +53,7 @@ import collections
 import socket
 import struct
 import threading
+from ..analysis import lockwatch
 import time
 from typing import Deque, Dict, List, Optional, Tuple
 
@@ -94,7 +95,7 @@ class P2PTransport:
         # Invoked WITHOUT _out_cv held — the bus's mark_dead re-enters
         # p2p.mark_dead, which takes the (non-reentrant) lock.
         self._on_dead = on_dead
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("parallel.P2PTransport._lock")
         self._stop = threading.Event()
         # publisher side: retained un-GC'd records (seq -> payload) + the
         # next seq to be published; per-subscriber senders are cursors

@@ -13,6 +13,7 @@ and lifecycle (init / barrier / shutdown with a dashboard dump,
 from __future__ import annotations
 
 import threading
+from .analysis import lockwatch
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -30,7 +31,7 @@ class Session:
     """Singleton runtime state (``Zoo::Get()`` analogue)."""
 
     _instance: Optional["Session"] = None
-    _lock = threading.RLock()
+    _lock = lockwatch.rlock("runtime.Session._lock")
 
     def __init__(self) -> None:
         self.topo: Optional[topology.Topology] = None
@@ -41,6 +42,11 @@ class Session:
         self.async_bus: Optional[Any] = None  # cross-process async PS plane
         self.failure_detector: Optional[Any] = None  # -failure_timeout_s
         self.metrics_exporter: Optional[Any] = None  # -metrics_jsonl
+        # stop() handshake: the claiming caller's completion event +
+        # thread id, so a concurrent stop() can wait for the teardown
+        # to finish without wedging the Session lock behind it
+        self._teardown_done: Optional[threading.Event] = None
+        self._teardown_thread: Optional[int] = None
 
     # -- singleton --------------------------------------------------------
     @classmethod
@@ -52,7 +58,24 @@ class Session:
 
     # -- lifecycle --------------------------------------------------------
     def start(self, argv: Optional[Sequence[str]] = None) -> List[str]:
-        """``MV_Init`` (``src/multiverso.cpp:10`` → ``Zoo::Start``)."""
+        """``MV_Init`` (``src/multiverso.cpp:10`` → ``Zoo::Start``).
+
+        A previous stop()'s teardown may still be draining OUTSIDE the
+        Session lock (see :meth:`stop`); initializing over it would
+        race the old teardown's barriers and distributed shutdown
+        against the new session's coordination service — wait for its
+        completion event first (same-thread re-entry skips the wait:
+        it would deadlock on our own event).
+        """
+        while True:
+            with self._lock:
+                done = self._teardown_done
+                if (done is None or done.is_set()
+                        or self._teardown_thread == threading.get_ident()):
+                    return self._start_locked(argv)
+            done.wait()
+
+    def _start_locked(self, argv: Optional[Sequence[str]]) -> List[str]:
         with self._lock:
             rest = config.parse_cmd_flags(list(argv) if argv else None)
             Log.reset_log_level_by_name(config.get_flag("log_level"))
@@ -70,6 +93,8 @@ class Session:
                     f"every process owns the same number of worker lanes; "
                     f"pass -mesh_shape to fix the layout")
             self.started = True
+            if config.get_flag("lockwatch"):
+                lockwatch.enable()
             if config.get_flag("trace"):
                 from . import trace
 
@@ -119,80 +144,111 @@ class Session:
             return rest
 
     def stop(self, finalize: bool = True) -> None:
-        """``MV_ShutDown`` → ``Zoo::Stop`` (``src/zoo.cpp:96-101``)."""
+        """``MV_ShutDown`` → ``Zoo::Stop`` (``src/zoo.cpp:96-101``).
+
+        CLAIMS the session state under the lock, then tears it down
+        OUTSIDE: the teardown joins server/batcher threads, blocks on
+        cross-process barriers, and invokes the dashboard's log callback
+        — seconds of work during which a concurrent ``Session.get()`` or
+        table registration must not wedge behind the Session lock
+        (locklint LK202/LK203; tests/test_runtime.py covers it).
+
+        stop() still MEANS stopped to its caller: a second concurrent
+        stop() finds ``started`` already False and blocks on the first
+        caller's completion event instead of returning mid-teardown
+        (the old held-lock behavior, minus the lock). Same-thread
+        re-entry (a drain callback calling stop()) returns immediately
+        — waiting on our own event would self-deadlock.
+        """
+        claimed = False
         with self._lock:
             if not self.started:
-                return
-            # serving drains first: in-flight replies read tables, so the
-            # inference plane must quiesce before any table is torn down
-            for srv in self.servers:
-                try:
-                    srv.stop()
-                except Exception as exc:
-                    Log.error("serving shutdown failed: %s", exc)
-            self.servers.clear()
-            if self.failure_detector is not None:
-                self.failure_detector.stop()
-                self.failure_detector = None
-            live = None
-            if (self.async_bus is not None
-                    and self.async_bus._survivor_mode):
-                # survivor mode: ALWAYS rendezvous via the KV live-set
-                # barrier, not just when the LOCAL dead set is non-empty —
-                # a survivor whose watchdog hasn't fired yet would
-                # otherwise take the all-process device barrier while its
-                # peer takes the live-set one, and both would hang.
-                # _live_ranks() unions the KV declarations so all
-                # survivors agree on the participant list.
-                live = self.async_bus._live_ranks()
-            topology.barrier("mv_shutdown", live)
-            survivor = (self.async_bus is not None
-                        and self.async_bus._survivor_mode)
-            if self.async_bus is not None:
-                # collective: every in-flight delta lands everywhere before
-                # any table is torn down (the reference's FinishTrain drain,
-                # src/zoo.cpp:96-101)
-                dead = set(self.async_bus._dead)
-                self.async_bus.stop()
-                self.async_bus = None
-            if survivor and self.size > 1:
-                # recoverable tasks skip JAX's synchronized shutdown
-                # barrier (the coordination service says so explicitly),
-                # so an unsynchronized exit lets the coordinator die
-                # mid-peer-disconnect (CANCELLED -> fatal error poll).
-                # Rendezvous the live set once more, give peers' own
-                # disconnects a grace window on rank 0, and disconnect
-                # HERE so the atexit teardown finds nothing left to race.
-                live = [r for r in range(self.size) if r not in dead]
-                try:
-                    topology.barrier("mv_exit", live)
-                except Exception as exc:
-                    Log.info("exit rendezvous incomplete (%s); "
-                             "proceeding with shutdown", exc)
-                import time as _time
+                done = self._teardown_done
+                wait = (done is not None and not done.is_set()
+                        and self._teardown_thread != threading.get_ident())
+            else:
+                claimed, wait = True, False
+                done = self._teardown_done = threading.Event()
+                self._teardown_thread = threading.get_ident()
+                self.started = False
+                topo, self.topo = self.topo, None
+                servers, self.servers = self.servers, []
+                tables, self.tables = self.tables, []
+                detector, self.failure_detector = self.failure_detector, None
+                bus, self.async_bus = self.async_bus, None
+                exporter, self.metrics_exporter = self.metrics_exporter, None
+        if not claimed:
+            if wait:
+                done.wait()
+            return
+        try:
+            self._teardown(topo, servers, tables, detector, bus, exporter)
+        finally:
+            done.set()
 
-                import jax as _jax
+    def _teardown(self, topo, servers, tables, detector, bus,
+                  exporter) -> None:
+        # serving drains first: in-flight replies read tables, so the
+        # inference plane must quiesce before any table is torn down
+        for srv in servers:
+            try:
+                srv.stop()
+            except Exception as exc:
+                Log.error("serving shutdown failed: %s", exc)
+        if detector is not None:
+            detector.stop()
+        live = None
+        if bus is not None and bus._survivor_mode:
+            # survivor mode: ALWAYS rendezvous via the KV live-set
+            # barrier, not just when the LOCAL dead set is non-empty —
+            # a survivor whose watchdog hasn't fired yet would
+            # otherwise take the all-process device barrier while its
+            # peer takes the live-set one, and both would hang.
+            # _live_ranks() unions the KV declarations so all
+            # survivors agree on the participant list.
+            live = bus._live_ranks()
+        topology.barrier("mv_shutdown", live)
+        survivor = bus is not None and bus._survivor_mode
+        if bus is not None:
+            # collective: every in-flight delta lands everywhere before
+            # any table is torn down (the reference's FinishTrain drain,
+            # src/zoo.cpp:96-101)
+            dead = set(bus._dead)
+            bus.stop()
+        if survivor and topo.size > 1:
+            # recoverable tasks skip JAX's synchronized shutdown
+            # barrier (the coordination service says so explicitly),
+            # so an unsynchronized exit lets the coordinator die
+            # mid-peer-disconnect (CANCELLED -> fatal error poll).
+            # Rendezvous the live set once more, give peers' own
+            # disconnects a grace window on rank 0, and disconnect
+            # HERE so the atexit teardown finds nothing left to race.
+            live = [r for r in range(topo.size) if r not in dead]
+            try:
+                topology.barrier("mv_exit", live)
+            except Exception as exc:
+                Log.info("exit rendezvous incomplete (%s); "
+                         "proceeding with shutdown", exc)
+            import time as _time
 
-                if self.rank == 0:
-                    _time.sleep(1.0)
-                try:
-                    _jax.distributed.shutdown()
-                except Exception as exc:
-                    Log.info("distributed shutdown raced a peer exit "
-                             "(benign in survivor mode): %s", exc)
-            for table in self.tables:
-                flush = getattr(table, "flush", None)
-                if flush is not None:
-                    flush()
-            self.tables.clear()
-            if self.metrics_exporter is not None:
-                # final report: the shutdown snapshot lands in the JSONL
-                # archive even when the session dies mid-interval
-                self.metrics_exporter.stop(final_report=True)
-                self.metrics_exporter = None
-            Dashboard.display()
-            self.started = False
-            self.topo = None
+            import jax as _jax
+
+            if topo.rank == 0:
+                _time.sleep(1.0)
+            try:
+                _jax.distributed.shutdown()
+            except Exception as exc:
+                Log.info("distributed shutdown raced a peer exit "
+                         "(benign in survivor mode): %s", exc)
+        for table in tables:
+            flush = getattr(table, "flush", None)
+            if flush is not None:
+                flush()
+        if exporter is not None:
+            # final report: the shutdown snapshot lands in the JSONL
+            # archive even when the session dies mid-interval
+            exporter.stop(final_report=True)
+        Dashboard.display()
 
     def barrier(self) -> None:
         """``MV_Barrier``. In async mode with >1 process this also quiesces

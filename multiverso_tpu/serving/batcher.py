@@ -28,6 +28,7 @@ anyway. Per-reply latency lands in a Dashboard histogram
 from __future__ import annotations
 
 import threading
+from ..analysis import lockwatch
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass
@@ -132,7 +133,7 @@ class MicroBatcher:
                       f"exceeds the largest bucket {self._buckets[-1]}")
         self._run_batch = run_batch
         self._q: Deque[_Pending] = collections.deque()
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("serving.MicroBatcher._lock")
         self._cv = threading.Condition(self._lock)
         self._stop = threading.Event()
         # -- stats ----------------------------------------------------------

@@ -37,6 +37,7 @@ into the equivalent block count.
 from __future__ import annotations
 
 import threading
+from ..analysis import lockwatch
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -94,7 +95,7 @@ class BlockPool:
         self.block_size = int(block_size)
         self._free: List[int] = list(range(n_blocks, 0, -1))  # pop() -> 1 first
         self._live: set = set()
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("serving.BlockPool._lock")
         self.allocs = 0                # blocks handed out (monotonic)
         self.frees = 0                 # blocks returned (monotonic)
         label = name or "pool"

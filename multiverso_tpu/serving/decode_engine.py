@@ -69,6 +69,7 @@ from __future__ import annotations
 import collections
 import itertools
 import threading
+from ..analysis import lockwatch
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass
@@ -370,7 +371,7 @@ class DecodeEngine:
         # window as a leak
         self._admitting = False
         self._q: Deque[_Request] = collections.deque()
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("serving.DecodeEngine._lock")
         self._cv = threading.Condition(self._lock)
         self._stop = threading.Event()
         # -- stats ----------------------------------------------------------

@@ -51,6 +51,20 @@ import json
 import os
 import threading
 import time
+
+try:
+    from ..analysis import lockwatch
+except ImportError:
+    # Loaded standalone by file path (tools/engine_timeline.py keeps its
+    # digest math jax-free by exec'ing this module outside the package).
+    # A second lockwatch copy would fork the witness registry, so fall
+    # back to plain locks — the witness only matters in-package.
+    class _PlainLocks:
+        @staticmethod
+        def lock(name):
+            return threading.Lock()
+
+    lockwatch = _PlainLocks()  # type: ignore[assignment]
 from typing import Any, Dict, List, Optional
 
 FIELDS = ("it", "ts", "busy_ms", "step_ms", "live", "reserved", "queue",
@@ -117,7 +131,7 @@ class FlightRecorder:
         self._pos = 0
         self._n = 0
         self.total = 0                     # records ever written
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("serving.FlightRecorder._lock")
         # monotonic->epoch anchor (export timebase, merges with spans)
         self._anchor_wall = time.time()
         self._anchor_mono = time.monotonic()

@@ -17,6 +17,7 @@ inference plane.
 from __future__ import annotations
 
 import threading
+from ..analysis import lockwatch
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
@@ -73,7 +74,7 @@ class InferenceServer:
     def __init__(self, name: str = "serving") -> None:
         self.name = name
         self._models: Dict[str, _ModelEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("serving.InferenceServer._lock")
         self._stopped = False
         from ..runtime import Session
 
@@ -98,6 +99,9 @@ class InferenceServer:
                             max_queue=max_queue, buckets=buckets)
         manager = SnapshotManager.of(workload.source, name=name)
         with self._lock:
+            if self._stopped:
+                Log.fatal(f"serving: register({name!r}) on a stopped "
+                          f"server")
             if name in self._models:
                 Log.fatal(f"serving: model {name!r} already registered")
             self._models[name] = _ModelEntry(
@@ -154,10 +158,30 @@ class InferenceServer:
             watchdog=watchdog, debug_dump_dir=debug_dump_dir,
             slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
         with self._lock:
+            if self._stopped:
+                Log.fatal(f"serving: register_decoder({name!r}) on a "
+                          f"stopped server")
             if name in self._models:
                 Log.fatal(f"serving: model {name!r} already registered")
-            entry = _DecoderEntry(name, DecodeEngine(name, lm, cfg))
-            self._models[name] = entry
+        # engine construction dispatches the params replica copy and the
+        # warmup compiles — seconds of work that must happen OUTSIDE the
+        # registry lock, or every submit() to every OTHER model wedges
+        # behind it (locklint LK203; tests/test_serving.py covers it)
+        entry = _DecoderEntry(name, DecodeEngine(name, lm, cfg))
+        with self._lock:
+            # re-check BOTH races lost during construction: a duplicate
+            # registration, and a stop() whose entries snapshot predates
+            # this entry (the engine's loop thread would outlive the
+            # server, reading tables Session teardown is flushing)
+            raced = name in self._models
+            stopped = self._stopped
+            if not raced and not stopped:
+                self._models[name] = entry
+        if raced or stopped:
+            entry.engine.stop()           # join happens OUTSIDE the lock
+            Log.fatal(f"serving: model {name!r} already registered" if raced
+                      else f"serving: server stopped during decoder "
+                           f"{name!r} registration")
         Log.info("serving: decoder %r up (%d slots, max_prompt %d, "
                  "max_new %d)", name, slots, max_prompt, max_new)
         return entry.engine

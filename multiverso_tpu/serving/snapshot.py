@@ -23,6 +23,7 @@ the reply hot path.
 from __future__ import annotations
 
 import threading
+from ..analysis import lockwatch
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Tuple
@@ -57,7 +58,7 @@ class DerivedCache:
         # doubled derived-artifact cost (replica copy, normalized
         # matrix) exactly at the publish spike. Serializing get() is
         # the point: one thread computes, the rest wait and reuse.
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("serving.DerivedCache._lock")
 
     def get(self, snap: Snapshot) -> Any:
         with self._lock:
@@ -98,7 +99,7 @@ class SnapshotManager:
         self._read = read
         self._version_fn = version_fn
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("serving.SnapshotManager._lock")
         self._snap: Optional[Snapshot] = None
         self.publishes = 0      # copies actually taken (copy-on-publish)
 

@@ -21,6 +21,13 @@ behaving like an engine:
   racily against the running loop, so a drift verdict must hold for two
   consecutive polls before it trips (a mid-admission snapshot is not a
   leak).
+* **lock-order violation** — the runtime lock-order witness
+  (:mod:`~multiverso_tpu.analysis.lockwatch`, ``-lockwatch``) recorded
+  a new acquisition-order cycle anywhere in the process: two threads
+  disagree about lock order, a deadlock waiting for the right
+  interleaving. Unlike the health checks this is level-independent —
+  every NEW violation since the last poll trips once (the condition
+  never "clears": a cycle certificate is permanent evidence).
 
 On trip: a diagnostic bundle — flight-recorder ring, ``engine.stats()``,
 ``Dashboard.snapshot()``, and every thread's stack via
@@ -48,6 +55,7 @@ import traceback
 from dataclasses import dataclass
 from typing import Any, Callable, Deque, List, Optional, Tuple
 
+from ..analysis import lockwatch
 from ..dashboard import Dashboard
 from ..log import Log
 
@@ -101,6 +109,9 @@ class EngineWatchdog:
         self.checks = 0
         self._armed = {"stall": True, "queue_age": True, "pool_drift": True}
         self._drift_streak = 0
+        # violations that predate this watchdog are another component's
+        # story — only NEW cycles observed on our polls trip
+        self._lock_order_seen = lockwatch.violation_count()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if start:
@@ -181,6 +192,26 @@ class EngineWatchdog:
         self._drift_streak = self._drift_streak + 1 if drift is not None else 0
         self._gate("pool_drift", self._drift_streak >= 2,
                    f"block-pool drift: {drift}", fired)
+
+        # lock-order witness: every NEW cycle since the last poll is a
+        # permanent deadlock certificate, so this bypasses the edge-
+        # trigger re-arm machinery — each batch of new violations is its
+        # own episode. ONE consistent list copy: cursor math against a
+        # separately-read count raced concurrent forget()/clear() (a
+        # test's sanctioned cleanup) into empty or already-reported
+        # trip batches
+        vs = lockwatch.violations()
+        if len(vs) < self._lock_order_seen:
+            # forget()/clear() rebased the list; follow it down so the
+            # next real violation isn't swallowed
+            self._lock_order_seen = len(vs)
+        new = vs[self._lock_order_seen:]
+        self._lock_order_seen = len(vs)
+        if new:
+            reason = (f"lock-order violation(s): {len(new)} new cycle(s) "
+                      f"— first: {new[0].describe()}")
+            self._trip("lock_order", reason)
+            fired.append(reason)
         return fired
 
     def _gate(self, kind: str, condition: bool, reason: str,

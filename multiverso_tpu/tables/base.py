@@ -30,6 +30,7 @@ use ``parallel.sync_step`` where the sum is an ICI ``psum``.
 from __future__ import annotations
 
 import threading
+from ..analysis import lockwatch
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
@@ -99,7 +100,7 @@ class TableBase:
         # AddOption must stay below it (checked host-side — XLA would
         # silently clamp/drop an OOB index inside jit).
         self.num_worker_slots = int(num_sim_workers or sess.num_workers)
-        self._lock = threading.RLock()
+        self._lock = lockwatch.rlock("tables.TableBase._lock")
         # Monotonic mutation counter: every state install (dense apply,
         # keyed apply, set_array, checkpoint load) bumps it under _lock.
         # The serving layer's copy-on-publish snapshots key off it — a

@@ -14,6 +14,7 @@ The reference's Store/Load stubs (``kv_table.h:100-118``) are implemented.
 from __future__ import annotations
 
 import threading
+from ..analysis import lockwatch
 from typing import Any, Dict, Iterable, List, Optional
 
 import numpy as np
@@ -38,7 +39,7 @@ class KVTable:
         self._store: Dict[Any, Any] = {}
         self._cache: Dict[Any, Any] = {}
         self._pending: Dict[Any, Any] = {}  # adds not yet merged cross-process
-        self._lock = threading.RLock()
+        self._lock = lockwatch.rlock("tables.KVTable._lock")
 
     # -- worker API (kv_table.h:24-70) ------------------------------------
     def add(self, keys: Iterable, values: Iterable) -> None:

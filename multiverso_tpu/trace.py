@@ -47,6 +47,7 @@ import itertools
 import json
 import os
 import threading
+from .analysis import lockwatch
 import time
 from typing import Any, Dict, List, NamedTuple, Optional
 
@@ -215,7 +216,7 @@ class TraceCollector:
         self._n = 0
         self.dropped = 0
         self.recorded = 0
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("trace.TraceCollector._lock")
         # monotonic->epoch anchor for export (set at enable())
         self._anchor_wall = time.time()
         self._anchor_mono = time.monotonic()

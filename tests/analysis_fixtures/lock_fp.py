@@ -1,0 +1,115 @@
+"""Sanctioned lock usage: locklint must NOT fire on any of these.
+
+Parsed by tests/test_locklint.py, never executed. Each method documents
+the real-tree pattern it protects; a linter change that flags one of
+these is a linter regression, not a fixture bug.
+"""
+
+import os
+import queue
+import threading
+import time
+
+
+class FpPureStateUnderLock:
+    """The overwhelmingly common case: a lock guarding pure in-memory
+    state. Dict/list reads and writes, arithmetic, string formatting —
+    none of it blocks, calls back, or takes other locks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+        self._hits = 0
+
+    def read(self, key):
+        with self._lock:
+            return self._state.get(key)       # dict.get is not Queue.get
+
+    def write(self, key, value):
+        with self._lock:
+            self._state[key] = value
+            self._hits += 1
+
+    def summary(self):
+        with self._lock:
+            keys = sorted(self._state)
+            return ", ".join(str(k) for k in keys)   # str.join, not thread
+
+
+class FpConsistentOrder:
+    """Nesting two locks is fine when every path agrees on the order —
+    only a DISAGREEMENT (the BA path) is a cycle."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def path_one(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def path_two(self):
+        with self._a:
+            with self._b:
+                return 2
+
+
+class FpConditionOwnLock:
+    """The batcher/engine pattern: waiting on the Condition you hold is
+    THE sanctioned blocking call — wait() releases the lock for the
+    sleep. Only holding OTHER locks across it is a hazard."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._items = []
+
+    def pop(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait()
+            return self._items.pop()
+
+    def push(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()
+
+
+class FpWorkOutsideLock:
+    """The claim-then-act shutdown pattern (Session.stop after the PR 7
+    fix): state is CLAIMED under the lock, the blocking/callback work
+    happens after release."""
+
+    def __init__(self, on_stop):
+        self._lock = threading.Lock()
+        self._threads = []
+        self._q = queue.Queue()
+        self.on_stop = on_stop
+
+    def stop(self):
+        with self._lock:
+            threads, self._threads = self._threads, []
+        for t in threads:
+            t.join()                      # outside the lock: fine
+        self.on_stop()                    # callback outside the lock: fine
+
+    def drain_unlocked(self):
+        return self._q.get()              # no lock held: fine
+
+    def sleep_unlocked(self):
+        time.sleep(0.01)                  # no lock held: fine
+
+
+class FpPathJoin:
+    """os.path.join / "".join are name-collisions with Thread.join, not
+    blocking calls."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._root = "/tmp"
+
+    def path_for(self, name):
+        with self._lock:
+            return os.path.join(self._root, name)

@@ -1,0 +1,134 @@
+"""Seeded lock-discipline hazards: every locklint rule must fire here.
+
+Parsed by tests/test_locklint.py, never executed. One method per
+(rule, variant) so the per-function finding dedup can't merge them.
+"""
+
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+class Lk201Cycle:
+    """Two methods disagree about A/B order -> LK201 lock-order-cycle."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab_path(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def ba_path(self):
+        with self._b:
+            with self._a:
+                return 2
+
+
+class Lk202Callbacks:
+    def __init__(self, on_event, fn):
+        self._lock = threading.Lock()
+        self.on_event = on_event
+        self._fn = fn                     # constructor-injected callable
+        self._fut = None
+
+    def attr_callback_under_lock(self):
+        with self._lock:
+            self.on_event("fired")        # LK202: on_* under the lock
+
+    def param_callback_under_lock(self, cb):
+        with self._lock:
+            cb()                          # LK202: parameter call
+
+    def injected_callback_under_lock(self):
+        with self._lock:
+            self._fn()                    # LK202: injected self._fn
+
+    def future_under_lock(self, fut):
+        with self._lock:
+            fut.set_result(1)             # LK202: done-callbacks run inline
+
+
+class Lk203Blocking:
+    def __init__(self, fn):
+        self._lock = threading.Lock()
+        self._other = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._q = queue.Queue()
+        self._evt = threading.Event()
+        self._thread = threading.Thread(target=fn)
+        self._step = jax.jit(fn)
+
+    def join_under_lock(self):
+        with self._lock:
+            self._thread.join()           # LK203: join parks the holder
+
+    def queue_get_under_lock(self):
+        with self._lock:
+            return self._q.get()          # LK203: blocking Queue.get
+
+    def event_wait_under_lock(self):
+        with self._lock:
+            self._evt.wait()              # LK203: event wait
+
+    def sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)               # LK203: sleep
+
+    def cv_wait_holding_other(self):
+        with self._other:
+            with self._cv:
+                self._cv.wait()           # LK203: wait releases _lock but
+                                          # keeps _other held for the sleep
+
+    def jax_dispatch_under_lock(self, x):
+        with self._lock:
+            return jnp.sum(x)             # LK203: dispatch can hide a compile
+
+    def jit_handle_under_lock(self, x):
+        with self._lock:
+            return self._step(x)          # LK203: jitted-handle dispatch
+
+    def io_under_lock(self, path):
+        with self._lock:
+            with open(path) as f:         # LK203: file I/O
+                return f.read()
+
+    def acquire_under_lock(self):
+        with self._lock:
+            self._other.acquire()         # LK203: explicit nested acquire
+            self._other.release()
+
+    def _helper(self):
+        time.sleep(0.5)
+
+    def transitive_block_under_lock(self):
+        with self._lock:
+            self._helper()                # LK203 via resolved call
+
+
+class Lk204Fanout:
+    """A registry-wide sweep serialized behind a private lock."""
+
+    def __init__(self):
+        self._mine = threading.Lock()
+        self._l1 = threading.Lock()
+        self._l2 = threading.Lock()
+        self._l3 = threading.Lock()
+
+    def sweep(self):
+        with self._l1:
+            pass
+        with self._l2:
+            pass
+        with self._l3:
+            pass
+
+    def fanout_under_lock(self):
+        with self._mine:
+            self.sweep()                  # LK204: acquires 3 other locks

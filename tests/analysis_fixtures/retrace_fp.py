@@ -1,0 +1,122 @@
+"""Sanctioned JAX idioms: retrace_lint must NOT fire on any of these.
+
+Parsed by tests/test_retrace_lint.py, never executed. Each function
+documents the real-tree pattern it protects; a linter change that flags
+one of these is a linter regression, not a fixture bug.
+"""
+
+import numpy as np
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+_step = jax.jit(lambda x: x * 2.0)
+
+
+def fp_jit_hoisted(xs):
+    """RT101: the handle is constructed ONCE, calls in the loop are fine
+    (the engine pattern: jit in __init__, dispatch per iteration)."""
+    out = []
+    for x in xs:
+        out.append(_step(x))
+    return out
+
+
+@jax.jit
+def fp_shape_metadata(x):
+    """RT102/RT103: .shape/.dtype/.ndim/len() are static under trace —
+    branching and arithmetic on them never retraces (the kernels' padded
+    -bucket dispatch)."""
+    if x.shape[0] > 4:
+        pad = x.shape[0] - 4
+    else:
+        pad = 0
+    n = len(x)
+    return x * float(n + pad + x.ndim)
+
+
+@jax.jit
+def fp_is_none_dispatch(x, mask=None):
+    """RT103: `x is None` is identity, static under trace — the standard
+    optional-argument dispatch idiom (flash-attention's mask arg)."""
+    if mask is None:
+        return x
+    return jnp.where(mask, x, 0.0)
+
+
+@jax.jit
+def fp_where_select(x):
+    """RT103: value-level selects go through jnp.where — no Python
+    branch on the traced value."""
+    return jnp.where(x > 0, x, -x)
+
+
+@jax.jit
+def fp_unrolled_container(layers, x):
+    """RT103: a Python `for` over a *Python container* of traced leaves
+    (enumerate/zip/tuple-unpack) is static-length unrolling — the
+    transformer's per-layer loop — not iteration over a traced array."""
+    for i, (w, b) in enumerate(zip(layers[0], layers[1])):
+        x = x @ w + b * float(i + 1)
+    return x
+
+
+@partial(jax.jit, static_argnums=(0,))
+def fp_hashable_static(n, x):
+    """RT104: an int/tuple static is hashable — keying the compile cache
+    by it is the whole point of static_argnums."""
+    return x.reshape((n, -1))
+
+
+_tuple_handle = jax.jit(lambda cfg, x: x * cfg[0], static_argnums=(0,))
+
+
+def fp_tuple_at_static_position(x):
+    """RT104: passing a TUPLE at a static position is the sanctioned
+    fix for the list-literal hazard."""
+    return _tuple_handle((1, 2), x)
+
+
+_donating = jax.jit(lambda x: x * 2.0, donate_argnums=(0,))
+
+
+def fp_donate_and_reassign(x):
+    """RT105: the train-step idiom — the donated name is REASSIGNED from
+    the jit output before any later read."""
+    x = _donating(x)
+    return x + 1.0
+
+
+def fp_donate_last_use(x):
+    """RT105: donating the final use of a buffer is exactly what
+    donate_argnums is for."""
+    y = _donating(x)
+    return y * 3.0
+
+
+def fp_numpy_on_host_values(n):
+    """RT102: np.* over plain host values (not traced args) is ordinary
+    host math — the admission bookkeeping pattern."""
+    table = np.zeros(n, np.int32)
+    return np.sum(table)
+
+
+class FpEngine:
+    """RT106: jits constructed in __init__/warmup, only DISPATCHED from
+    the iteration path — the one-trace invariant upheld."""
+
+    def __init__(self, fn):
+        self._step = jax.jit(fn)
+
+    def warmup(self):
+        rebuilt = jax.jit(lambda x: x)   # warmup may (re)build traces
+        return rebuilt(0.0), self._step(0.0)
+
+    def _loop(self):
+        while True:
+            self._iterate()
+
+    def _iterate(self):
+        return self._step(1.0)

@@ -1,0 +1,112 @@
+"""Seeded trace hazards: every retrace_lint rule must fire here.
+
+Parsed by tests/test_retrace_lint.py, never executed. One function per
+(rule, variant) so the per-qualname finding dedup can't merge them.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def rt101_jit_in_loop(fns):
+    out = []
+    for f in fns:
+        step = jax.jit(f)              # RT101: fresh callable per iteration
+        out.append(step(1.0))
+    return out
+
+
+def rt101_jit_in_comprehension(fns):
+    return [jax.jit(f)(1.0) for f in fns]   # RT101 in a comprehension
+
+
+@jax.jit
+def rt102_int_coerce(x):
+    return int(x)                      # RT102: host concretization
+
+
+@jax.jit
+def rt102_item(x):
+    return x.item() + 1                # RT102: device sync under trace
+
+
+@jax.jit
+def rt102_numpy(x):
+    return np.sum(x)                   # RT102: numpy concretizes
+
+
+@jax.jit
+def rt103_if(x):
+    if x > 0:                          # RT103: python branch on traced
+        return x
+    return -x
+
+
+@jax.jit
+def rt103_while(x):
+    while x < 10:                      # RT103: python while on traced
+        x = x * 2
+    return x
+
+
+@jax.jit
+def rt103_assert(x):
+    assert x > 0                       # RT103: assert forces a host sync
+    return x
+
+
+@jax.jit
+def rt103_for(x):
+    total = jnp.zeros(())
+    for row in x:                      # RT103: unrolls per traced length
+        total = total + row
+    return total
+
+
+def rt103_taint_propagates(x):
+    """Helper called from a traced fn with traced args is analyzed too."""
+
+    def helper(y):
+        if y > 0:                      # RT103 via intra-module propagation
+            return y
+        return -y
+
+    return jax.jit(lambda z: helper(z))(x)
+
+
+def rt104_mutable_capture():
+    scale = [1.0, 2.0]                 # mutable literal in enclosing scope
+    return jax.jit(lambda x: x * scale[0])   # RT104: stale-constant bake
+
+
+_static_handle = jax.jit(lambda cfg, x: x, static_argnums=(0,))
+
+
+def rt104_unhashable_static(x):
+    return _static_handle([1, 2], x)   # RT104: list in a static position
+
+
+_donating = jax.jit(lambda x: x * 2.0, donate_argnums=(0,))
+
+
+def rt105_donated_reuse(x):
+    y = _donating(x)
+    z = x + 1.0                        # RT105: read after donation
+    return y + z
+
+
+class Rt106Engine:
+    """The engine shape: no jit construction reachable from _loop."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def _loop(self):
+        while True:
+            self._iterate()
+
+    def _iterate(self):
+        step = jax.jit(self._fn)       # RT106: jit on the iteration path
+        return step(1.0)

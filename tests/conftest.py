@@ -33,6 +33,32 @@ def pytest_configure(config):
 
 
 @pytest.fixture(autouse=True)
+def _lockwatch_guard():
+    """Runtime lock-order witness, always on in the suite: every
+    framework lock acquisition records into the global order DAG, and a
+    test must end with (1) no NEW order violations, (2) the recorded
+    graph still acyclic, and (3) every watched lock released — the
+    runtime half of the discipline tools/lint.py checks statically.
+    A test that deliberately seeds an inversion cleans up with
+    ``lockwatch.forget(prefix)`` before returning."""
+    from multiverso_tpu.analysis import lockwatch
+
+    lockwatch.enable()
+    before = lockwatch.violation_count()
+    yield
+    after = lockwatch.violations()
+    new = after[before:] if len(after) > before else []
+    assert not new, (
+        "test introduced lock-order violation(s): "
+        + "; ".join(v.describe() for v in new))
+    cycles = lockwatch.check_acyclic()
+    assert not cycles, f"lock order graph has cycle(s): {cycles}"
+    # daemon threads may hold a watched lock transiently mid-poll; only
+    # a hold persisting across the grace window is a leak/wedge
+    lockwatch.assert_released(timeout_s=5.0)
+
+
+@pytest.fixture(autouse=True)
 def _no_stray_nondaemon_threads():
     """Test-isolation guard: a test must not leave NEW non-daemon
     threads running — a leaked reporter/exporter thread would block

@@ -64,3 +64,54 @@ def test_core_flags_registered():
     for name in ["ps_role", "ma", "sync", "updater_type", "omp_threads",
                  "backup_worker_ratio", "mesh_shape", "sync_frequency"]:
         assert config.registry().known(name)
+
+
+def test_define_coerces_default_outside_registry_lock():
+    """Regression (locklint LK202, found by this PR's lint pass): the
+    declared type is caller-supplied code; define() used to call it
+    while holding the registry lock, so a coercion that blocks (or
+    raises) wedged every concurrent flag read behind it."""
+    import threading
+
+    entered, release = threading.Event(), threading.Event()
+
+    class _Slow:
+        def __init__(self, default):
+            entered.set()
+            release.wait(10)
+
+    reg = config.FlagRegister()
+    config._COERCERS[_Slow] = _Slow
+    try:
+        t = threading.Thread(target=lambda: reg.define("t_slow", _Slow, 0))
+        t.start()
+        assert entered.wait(5), "define never reached the default coercion"
+        got = reg._lock.acquire(timeout=2)
+        assert got, "define held the registry lock across default coercion"
+        reg._lock.release()
+        release.set()
+        t.join(10)
+        assert not t.is_alive()
+        assert reg.known("t_slow")
+    finally:
+        del config._COERCERS[_Slow]
+    # a raising coercion must leave the registry untouched and usable
+    with pytest.raises(ValueError):
+        reg.define("t_bad", int, "not-an-int")
+    assert not reg.known("t_bad")
+    reg.define("t_ok", int, 4)
+    assert reg.get("t_ok") == 4
+
+
+def test_redefinition_never_reruns_the_coercer():
+    """Companion to the outside-the-lock coercion move: a re-definition
+    with identical type keeps the current value WITHOUT touching the
+    (possibly no-longer-coercible) default — the original early-return
+    contract. A module re-executed with a stale default must not raise."""
+    reg = config.FlagRegister()
+    reg.define("t_re", int, 7)
+    reg.set("t_re", 9)
+    reg.define("t_re", int, "not-an-int")   # must NOT coerce, NOT raise
+    assert reg.get("t_re") == 9
+    with pytest.raises(config.FlagError):
+        reg.define("t_re", float, 1.0)      # type mismatch still surfaces

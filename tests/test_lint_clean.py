@@ -1,0 +1,58 @@
+"""The CI gate: tools/lint.py --check must exit 0 on the real tree.
+
+Any unsuppressed finding, stale baseline entry, or unjustified
+suppression in ``tools/lint_baseline.txt`` fails this test — which runs
+in tier-1, so a hazard (or a fix that forgot to drop its baseline line)
+can't land quietly. New by-design findings go into the baseline WITH a
+justification; real hazards get fixed. See docs/ANALYSIS.md.
+"""
+
+import io
+import os
+
+import tools.lint as lint_cli
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_tree_is_lint_clean():
+    out = io.StringIO()
+    rc = lint_cli.run(list(lint_cli.DEFAULT_PATHS),
+                      baseline_path=lint_cli.DEFAULT_BASELINE,
+                      check=True, out=out)
+    assert rc == 0, (
+        "tools/lint.py --check failed on the tree — fix the finding or "
+        "add a JUSTIFIED baseline entry:\n" + out.getvalue())
+
+
+def test_baseline_entries_all_justified():
+    """Redundant with the gate (load_baseline raises on a missing
+    justification) but keeps the failure message exact when someone
+    hand-edits the file."""
+    from multiverso_tpu.analysis.common import load_baseline
+
+    entries = load_baseline(lint_cli.DEFAULT_BASELINE)
+    assert entries, "baseline unexpectedly empty — was it moved?"
+    for ident, why in entries.items():
+        assert why.strip(), f"unjustified suppression: {ident}"
+
+
+def test_nonexistent_path_fails_loudly():
+    """Regression: a typo'd path used to expand to zero files and report
+    '0 modules: 0 finding(s)' with exit 0 — a developer reading that as
+    'my file is clean'. It must error instead."""
+    out = io.StringIO()
+    rc = lint_cli.run(["serving/no_such_file.py"],
+                      baseline_path=lint_cli.DEFAULT_BASELINE,
+                      check=True, out=out)
+    assert rc == 2
+    assert "matched no Python files" in out.getvalue()
+
+
+def test_fixture_corpus_not_swept_into_the_gate():
+    """The seeded-hazard corpus lives under tests/ precisely so the
+    package gate never sees it; a refactor that moves it under a linted
+    root would force 30+ bogus baseline entries."""
+    for p in lint_cli.DEFAULT_PATHS:
+        assert not os.path.exists(os.path.join(
+            REPO_ROOT, p, "analysis_fixtures"))

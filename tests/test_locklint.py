@@ -1,0 +1,149 @@
+"""locklint: every rule fires on the seeded corpus, none on the
+sanctioned-usage file, plus the baseline machinery (required
+justifications, stale detection, line-number-free identity).
+"""
+
+import os
+
+import pytest
+
+from multiverso_tpu.analysis import locklint
+from multiverso_tpu.analysis.common import (BaselineError, Finding,
+                                            load_baseline, parse_module,
+                                            split_findings)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_fixture(name):
+    mod = parse_module(os.path.join(FIXTURES, name), root=REPO_ROOT)
+    assert mod is not None, f"fixture {name} failed to parse"
+    findings, linter = locklint.lint_modules([mod])
+    return findings, linter
+
+
+# -- true positives: the seeded corpus ----------------------------------------
+
+EXPECTED_TP = {
+    ("LK201", "<lock-graph>", "Lk201Cycle._a+Lk201Cycle._b"),
+    # cv_wait_holding_other (_other -> _lock) + acquire_under_lock
+    # (_lock -> _other) disagree too: a second, cross-method cycle
+    ("LK201", "<lock-graph>", "Lk203Blocking._lock+Lk203Blocking._other"),
+    ("LK202", "Lk202Callbacks.attr_callback_under_lock", "callback"),
+    ("LK202", "Lk202Callbacks.param_callback_under_lock", "param-call"),
+    ("LK202", "Lk202Callbacks.injected_callback_under_lock", "param-call"),
+    ("LK202", "Lk202Callbacks.future_under_lock", "future-callbacks"),
+    ("LK203", "Lk203Blocking.join_under_lock", "join"),
+    ("LK203", "Lk203Blocking.queue_get_under_lock", "queue-get"),
+    ("LK203", "Lk203Blocking.event_wait_under_lock", "wait"),
+    ("LK203", "Lk203Blocking.sleep_under_lock", "sleep"),
+    ("LK203", "Lk203Blocking.cv_wait_holding_other", "wait"),
+    ("LK203", "Lk203Blocking.jax_dispatch_under_lock", "jax-dispatch"),
+    ("LK203", "Lk203Blocking.jit_handle_under_lock", "jax-dispatch"),
+    ("LK203", "Lk203Blocking.io_under_lock", "io"),
+    ("LK203", "Lk203Blocking.acquire_under_lock", "acquire"),
+    ("LK203", "Lk203Blocking.transitive_block_under_lock", "sleep"),
+    ("LK204", "Lk204Fanout.fanout_under_lock", "fanout"),
+}
+
+
+def test_every_seeded_hazard_detected():
+    findings, _ = _lint_fixture("lock_tp.py")
+    found = {(f.rule, f.qualname, f.slug) for f in findings}
+    missing = EXPECTED_TP - found
+    assert not missing, f"seeded hazards not detected: {sorted(missing)}"
+
+
+def test_no_rule_without_true_positive_coverage():
+    findings, _ = _lint_fixture("lock_tp.py")
+    assert {f.rule for f in findings} >= {"LK201", "LK202", "LK203",
+                                          "LK204"}
+
+
+def test_no_unexpected_findings_in_tp_fixture():
+    findings, _ = _lint_fixture("lock_tp.py")
+    found = {(f.rule, f.qualname, f.slug) for f in findings}
+    assert found == EXPECTED_TP, (
+        f"unexpected extras: {sorted(found - EXPECTED_TP)}")
+
+
+def test_acquisition_graph_records_edges():
+    _, linter = _lint_fixture("lock_tp.py")
+    edges = set(linter.edges)
+    a = "tests.analysis_fixtures.lock_tp.Lk201Cycle._a"
+    b = "tests.analysis_fixtures.lock_tp.Lk201Cycle._b"
+    assert (a, b) in edges and (b, a) in edges
+
+
+# -- false positives: sanctioned usage must stay clean ------------------------
+
+def test_sanctioned_usage_lints_clean():
+    findings, _ = _lint_fixture("lock_fp.py")
+    assert not findings, "false positives on sanctioned lock usage:\n" + \
+        "\n".join(f.render() for f in findings)
+
+
+def test_consistent_nesting_is_not_a_cycle():
+    _, linter = _lint_fixture("lock_fp.py")
+    a = "tests.analysis_fixtures.lock_fp.FpConsistentOrder._a"
+    b = "tests.analysis_fixtures.lock_fp.FpConsistentOrder._b"
+    assert (a, b) in linter.edges       # the nesting IS recorded
+    assert (b, a) not in linter.edges   # but never inverted
+
+
+# -- baseline machinery -------------------------------------------------------
+
+def _finding(rule="LK203", qual="C.m", slug="join", line=10):
+    return Finding(rule=rule, path="pkg/mod.py", line=line, qualname=qual,
+                   slug=slug, message="msg")
+
+
+def test_baseline_requires_justification(tmp_path):
+    p = tmp_path / "base.txt"
+    p.write_text("LK203 pkg/mod.py::C.m::join\n")
+    with pytest.raises(BaselineError, match="justification"):
+        load_baseline(str(p))
+    p.write_text("LK203 pkg/mod.py::C.m::join --   \n")
+    with pytest.raises(BaselineError, match="justification"):
+        load_baseline(str(p))
+
+
+def test_baseline_rejects_malformed_identity(tmp_path):
+    p = tmp_path / "base.txt"
+    p.write_text("LK203 no-double-colon -- why\n")
+    with pytest.raises(BaselineError, match="RULE path"):
+        load_baseline(str(p))
+
+
+def test_baseline_suppresses_and_reports_stale(tmp_path):
+    p = tmp_path / "base.txt"
+    p.write_text(
+        "# comment\n"
+        "\n"
+        "LK203 pkg/mod.py::C.m::join -- shutdown is the serializer\n"
+        "LK202 pkg/mod.py::C.gone::callback -- fixed long ago\n")
+    baseline = load_baseline(str(p))
+    fresh, silenced, stale = split_findings([_finding()], baseline)
+    assert not fresh
+    assert [f.identity for f in silenced] == ["LK203 pkg/mod.py::C.m::join"]
+    assert stale == ["LK202 pkg/mod.py::C.gone::callback"]
+
+
+def test_identity_survives_line_shifts():
+    """Suppressions key on rule+path+qualname+slug, NOT the line — an
+    unrelated edit above the finding must not invalidate the baseline."""
+    assert _finding(line=10).identity == _finding(line=999).identity
+
+
+def test_lint_cli_check_fails_on_seeded_corpus():
+    """tools/lint.py --check exits 1 when pointed at the TP corpus with
+    no baseline."""
+    import tools.lint as lint_cli
+
+    rc = lint_cli.run([os.path.join(FIXTURES, "lock_tp.py")],
+                      baseline_path="", check=True)
+    assert rc == 1
+    rc = lint_cli.run([os.path.join(FIXTURES, "lock_fp.py")],
+                      baseline_path="", check=True)
+    assert rc == 0

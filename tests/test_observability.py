@@ -231,6 +231,77 @@ def test_exporter_thread_writes_lines(tmp_path):
         assert "SERVE_TTFT[lm]" in rec["snapshot"]
 
 
+def test_exporter_snapshots_outside_its_own_lock():
+    """Regression (locklint LK204, found by this PR's lint pass):
+    report_once used to call Dashboard.snapshot() — the registry lock
+    plus every instrument's — while holding the exporter's private lock,
+    serializing concurrent prometheus() scrapes and stop() behind the
+    whole sweep. The runtime witness proves the fix structurally: after
+    reports, no (exporter-lock -> registry-lock) order edge may exist."""
+    from multiverso_tpu.analysis import lockwatch
+
+    _populate_dashboard()
+    exporter = MetricsExporter(interval_s=60.0)
+    exporter.report_once()
+    exporter.report_once()
+    assert ("dashboard.MetricsExporter._lock",
+            "dashboard.Dashboard._lock") not in lockwatch.edges()
+
+
+def test_exporter_reports_commit_in_snapshot_order(monkeypatch):
+    """Regression for the LK204 fix's new race: with the snapshot taken
+    outside the exporter's state lock, two concurrent report_once calls
+    (the reporter loop racing stop()'s final report) could commit out of
+    snapshot order — the older snapshot landing as newest double-counts
+    the interval its deltas re-span. _report_lock serializes the
+    snapshot+commit pair WITHOUT re-serializing prometheus() scrapes
+    behind the registry sweep; intervals run on the monotonic clock so
+    a wall-clock step (NTP) can't skew the rates either."""
+    import time as _time
+
+    _populate_dashboard()
+    exporter = MetricsExporter(interval_s=60.0)
+    exporter.report_once()
+    # a backwards WALL clock step must not produce a negative interval
+    real_time = _time.time
+    monkeypatch.setattr(time, "time", lambda: real_time() - 30.0)
+    rec = exporter.report_once()
+    monkeypatch.undo()
+    assert rec["interval_s"] >= 0
+    # wedge one report mid-sweep: a concurrent report must WAIT (commit
+    # order == snapshot order), while a scrape must NOT
+    entered, release = threading.Event(), threading.Event()
+    real_snapshot = Dashboard.snapshot
+
+    def slow_snapshot():
+        snap = real_snapshot()
+        entered.set()
+        release.wait(10)
+        return snap
+
+    monkeypatch.setattr(Dashboard, "snapshot", staticmethod(slow_snapshot))
+    t = threading.Thread(target=exporter.report_once)
+    t.start()
+    second_done = threading.Event()
+    t2 = threading.Thread(
+        target=lambda: (exporter.report_once(), second_done.set()))
+    try:
+        assert entered.wait(5)
+        t2.start()
+        assert not second_done.wait(0.3), \
+            "concurrent report_once overtook a mid-snapshot one"
+        exporter.prometheus()           # scrape stays unblocked
+        release.set()
+        assert second_done.wait(5)
+    finally:
+        release.set()
+        t.join(10)
+        t2.join(10)
+    assert exporter.reports == 4
+    rec = exporter.report_once()
+    assert rec["interval_s"] is not None and rec["interval_s"] >= 0
+
+
 def test_dashboard_reset_detaches_running_exporter(tmp_path):
     """The test-isolation contract: Dashboard.reset() must stop any
     still-running reporter thread — a leaked exporter would keep

@@ -1,0 +1,176 @@
+"""retrace_lint: every rule fires on its seeded fixture, none on the
+sanctioned-idiom file, plus targeted regressions for linter bugs fixed
+while triaging the real tree (compound-statement double-visit, handle
+rebinding, `x is None` dispatch).
+"""
+
+import os
+import textwrap
+
+from multiverso_tpu.analysis import retrace_lint
+from multiverso_tpu.analysis.common import parse_module
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_fixture(name):
+    mod = parse_module(os.path.join(FIXTURES, name), root=REPO_ROOT)
+    assert mod is not None, f"fixture {name} failed to parse"
+    return retrace_lint.lint_module(mod)
+
+
+def _lint_snippet(src):
+    import ast
+
+    from multiverso_tpu.analysis.common import Module
+
+    tree = ast.parse(textwrap.dedent(src))
+    mod = Module(path="snippet.py", name="snippet", tree=tree,
+                 source=src)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            mod.classes[node.name] = node
+        elif isinstance(node, ast.FunctionDef):
+            mod.functions[node.name] = node
+    return retrace_lint.lint_module(mod)
+
+
+# -- true positives: the seeded corpus ----------------------------------------
+
+EXPECTED_TP = {
+    ("RT101", "rt101_jit_in_loop"),
+    ("RT101", "rt101_jit_in_comprehension"),
+    ("RT102", "rt102_int_coerce"),
+    ("RT102", "rt102_item"),
+    ("RT102", "rt102_numpy"),
+    ("RT103", "rt103_if"),
+    ("RT103", "rt103_while"),
+    ("RT103", "rt103_assert"),
+    ("RT103", "rt103_for"),
+    ("RT103", "rt103_taint_propagates.helper"),   # intra-module taint
+    ("RT104", "rt104_mutable_capture"),
+    ("RT104", "rt104_unhashable_static"),
+    ("RT105", "rt105_donated_reuse"),
+    ("RT106", "Rt106Engine._iterate"),
+}
+
+
+def test_every_seeded_hazard_detected():
+    found = {(f.rule, f.qualname) for f in _lint_fixture("retrace_tp.py")}
+    missing = EXPECTED_TP - found
+    assert not missing, f"seeded hazards not detected: {sorted(missing)}"
+
+
+def test_no_rule_without_true_positive_coverage():
+    """A rule with zero corpus coverage is a rule that can silently stop
+    working — the acceptance criterion, enforced."""
+    rules = {f.rule for f in _lint_fixture("retrace_tp.py")}
+    assert rules >= {"RT101", "RT102", "RT103", "RT104", "RT105", "RT106"}
+
+
+def test_no_unexpected_findings_in_tp_fixture():
+    """The TP corpus is exact: anything beyond the seeded set is a
+    false positive hiding inside the fixture file."""
+    found = {(f.rule, f.qualname) for f in _lint_fixture("retrace_tp.py")}
+    assert found == EXPECTED_TP, (
+        f"unexpected extras: {sorted(found - EXPECTED_TP)}")
+
+
+# -- false positives: the sanctioned idioms must stay clean -------------------
+
+def test_sanctioned_idioms_lint_clean():
+    findings = _lint_fixture("retrace_fp.py")
+    assert not findings, "false positives on sanctioned idioms:\n" + \
+        "\n".join(f.render() for f in findings)
+
+
+# -- regressions for linter bugs fixed against the real tree ------------------
+
+def test_donation_inside_with_block_not_double_visited():
+    """The compound-statement double-visit bug: a donate call nested in
+    a `with` block was scanned twice (once via the With, once via the
+    Assign), flagging the donation itself as a read."""
+    findings = _lint_snippet("""
+        import jax
+        _step = jax.jit(lambda x: x, donate_argnums=(0,))
+
+        def train(x, lock):
+            with lock:
+                x = _step(x)
+            return x
+    """)
+    assert not [f for f in findings if f.rule == "RT105"]
+
+
+def test_rebound_handle_calls_do_not_donate():
+    """A handle name rebound to a non-donating jit (the w2v probe's
+    branch-selected kernels) must stop counting as a donation site."""
+    findings = _lint_snippet("""
+        import jax
+        fn = jax.jit(lambda x: x, donate_argnums=(0,))
+
+        def probe(x, fast):
+            global fn
+            if fast:
+                fn = jax.jit(lambda x: x * 2)
+            y = fn(x)
+            return x + y
+    """)
+    assert not [f for f in findings if f.rule == "RT105"]
+
+
+def test_is_none_dispatch_not_a_traced_branch():
+    findings = _lint_snippet("""
+        import jax
+
+        @jax.jit
+        def f(x, mask=None):
+            if mask is None:
+                return x
+            return x * mask
+    """)
+    assert not [f for f in findings if f.rule == "RT103"]
+
+
+def test_shape_branching_not_flagged():
+    findings = _lint_snippet("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x.shape[0] > 4:
+                return x[:4]
+            return x
+    """)
+    assert not [f for f in findings if f.rule == "RT103"]
+
+
+def test_static_argnums_param_exempt_from_taint():
+    findings = _lint_snippet("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(0,))
+        def f(n, x):
+            if n > 4:          # n is static: a host int, branch is fine
+                return x * n
+            return x
+    """)
+    assert not [f for f in findings if f.rule == "RT103"]
+
+
+def test_donated_reuse_across_statements_still_caught():
+    """The ordered-statement scan still sees a read in a LATER nested
+    block (the hazard the double-visit fix must not lose)."""
+    findings = _lint_snippet("""
+        import jax
+        _step = jax.jit(lambda x: x, donate_argnums=(0,))
+
+        def train(x, flag):
+            y = _step(x)
+            if flag:
+                z = x + 1      # read-after-donate inside a nested block
+            return y
+    """)
+    assert [f for f in findings if f.rule == "RT105"]

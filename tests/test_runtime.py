@@ -72,3 +72,120 @@ def test_role_flag_parsing():
     finally:
         mv.set_flag("ps_role", "default")
         Session._instance = None
+
+
+def test_stop_tears_down_outside_the_session_lock(mv_session):
+    """Regression (locklint LK202/LK203, found by this PR's lint pass):
+    Session.stop used to run the WHOLE teardown — server drains,
+    cross-process barriers, the dashboard dump — under the Session lock,
+    wedging every concurrent Session.get()/table registration behind a
+    multi-second shutdown. It now claims the state under the lock and
+    tears down outside: mid-drain, the lock must be free."""
+    import threading
+
+    from multiverso_tpu.runtime import Session
+
+    sess = mv_session.session()
+    entered, release = threading.Event(), threading.Event()
+
+    class _SlowServer:
+        def stop(self):
+            entered.set()
+            release.wait(10)
+
+    sess.servers.append(_SlowServer())
+    t = threading.Thread(target=mv_session.shutdown)
+    t.start()
+    try:
+        assert entered.wait(5), "shutdown never reached the server drain"
+        got = Session._lock.acquire(timeout=2)
+        assert got, "Session.stop held its lock across the server drain"
+        Session._lock.release()
+    finally:
+        release.set()
+        t.join(10)
+    assert not t.is_alive()
+    assert not sess.started
+
+
+def test_concurrent_stop_waits_for_the_first_callers_teardown(mv_session):
+    """Companion to the outside-the-lock refactor: stop() still MEANS
+    stopped. A second concurrent stop() must not return while the first
+    caller's teardown is mid-drain (its caller might proceed to process
+    exit or re-init over live barriers) — it blocks on the claiming
+    caller's completion event instead."""
+    import threading
+    import time
+
+    sess = mv_session.session()
+    entered, release = threading.Event(), threading.Event()
+
+    class _SlowServer:
+        def stop(self):
+            entered.set()
+            release.wait(10)
+
+    sess.servers.append(_SlowServer())
+    first = threading.Thread(target=mv_session.shutdown)
+    first.start()
+    second_done = threading.Event()
+
+    def second():
+        sess.stop()
+        second_done.set()
+
+    t2 = threading.Thread(target=second)
+    try:
+        assert entered.wait(5), "shutdown never reached the server drain"
+        t2.start()
+        # mid-drain: the second stop() must be parked on the handshake
+        assert not second_done.wait(0.3)
+        release.set()
+        assert second_done.wait(5), "second stop() never unblocked"
+    finally:
+        release.set()
+        first.join(10)
+        t2.join(10)
+    assert not first.is_alive() and not t2.is_alive()
+    assert not sess.started
+
+
+def test_start_waits_for_a_pending_teardown(mv_session):
+    """A start() landing while a previous stop()'s (outside-the-lock)
+    teardown is still draining must wait for its completion event —
+    initializing over a live teardown races the old session's barriers
+    and distributed shutdown against the new one's."""
+    import threading
+
+    sess = mv_session.session()
+    entered, release = threading.Event(), threading.Event()
+
+    class _SlowServer:
+        def stop(self):
+            entered.set()
+            release.wait(10)
+
+    sess.servers.append(_SlowServer())
+    stopper = threading.Thread(target=mv_session.shutdown)
+    stopper.start()
+    restarted = threading.Event()
+
+    def reinit():
+        sess.start(["t"])
+        restarted.set()
+
+    t2 = threading.Thread(target=reinit)
+    try:
+        assert entered.wait(5), "shutdown never reached the server drain"
+        t2.start()
+        # mid-drain: start() must be parked on the teardown handshake
+        assert not restarted.wait(0.3), \
+            "start() initialized over a live teardown"
+        release.set()
+        assert restarted.wait(10), "start() never unblocked"
+    finally:
+        release.set()
+        stopper.join(10)
+        t2.join(10)
+    assert not stopper.is_alive() and not t2.is_alive()
+    assert sess.started       # fixture teardown shuts the new session down

@@ -193,6 +193,46 @@ def test_idle_server_never_wakes(mv_session):
     assert not batcher._thread.is_alive()
 
 
+def test_register_decoder_builds_engine_outside_registry_lock(mv_session):
+    """Regression (locklint LK203, found by this PR's lint pass):
+    DecodeEngine construction — the params replica copy plus the warmup
+    compiles, seconds of work — used to run under the server's registry
+    lock, wedging every submit() to every OTHER model behind one
+    registration. Mid-construction, the other model must still serve."""
+    from multiverso_tpu.serving import InferenceServer
+    from multiverso_tpu.serving import server as server_mod
+
+    srv = InferenceServer("t")
+    srv.register("echo", _Echo(), max_batch=4, deadline_ms=5.0,
+                 max_queue=64)
+    entered, release = threading.Event(), threading.Event()
+
+    class _SlowEngine:
+        def __init__(self, name, lm, cfg):
+            self.name = name
+            entered.set()
+            release.wait(10)
+
+        def stop(self):
+            pass
+
+    real = server_mod.DecodeEngine
+    server_mod.DecodeEngine = _SlowEngine
+    try:
+        t = threading.Thread(
+            target=lambda: srv.register_decoder("slow-lm", object()))
+        t.start()
+        assert entered.wait(5), "registration never reached construction"
+        fut = srv.submit("echo", 3)
+        assert fut.result(timeout=5)["result"] == 6
+        release.set()
+        t.join(10)
+        assert not t.is_alive()
+        assert srv._entry("slow-lm").engine.name == "slow-lm"
+    finally:
+        server_mod.DecodeEngine = real
+
+
 @pytest.mark.slow
 def test_decode_engine_ab_speedup(mv_session):
     """The serving_bench mixed-length trace: continuous batching must
@@ -361,3 +401,53 @@ def test_chunked_prefill_ab_bounds_itl(mv_session):
     assert row["chunked"]["step_traces"] == 1
     assert row["itl_p99_speedup"] >= 1.5
     assert row["tokens_per_s_ratio"] >= 0.75
+
+
+def test_register_decoder_losing_race_to_stop_stops_the_engine(
+        mv_session, monkeypatch):
+    """Regression: register_decoder's post-construction re-check only
+    looked for a duplicate name — a server.stop() landing during the
+    (outside-the-lock, seconds-long) engine construction left a live
+    engine registered on a stopped server, its decode loop outliving
+    the 'serving drains first' teardown."""
+    from multiverso_tpu.log import FatalError
+    from multiverso_tpu.serving import InferenceServer
+    from multiverso_tpu.serving import server as server_mod
+
+    building, release = threading.Event(), threading.Event()
+    stopped = []
+
+    class _StubEngine:
+        def __init__(self, name, lm, cfg):
+            self.name = name
+            building.set()
+            release.wait(10)
+
+        def stop(self):
+            stopped.append(self.name)
+
+    monkeypatch.setattr(server_mod, "DecodeEngine", _StubEngine)
+    srv = InferenceServer("t")
+    result = []
+
+    def register():
+        try:
+            srv.register_decoder("lm", object(), slots=2, max_prompt=4,
+                                 max_new=4)
+        except FatalError as exc:
+            result.append(str(exc))
+
+    t = threading.Thread(target=register)
+    t.start()
+    try:
+        assert building.wait(5), "construction never started"
+        srv.stop()                       # lands mid-construction
+        release.set()
+        t.join(10)
+    finally:
+        release.set()
+        t.join(10)
+    assert not t.is_alive()
+    assert result and "stopped during" in result[0]
+    assert stopped == ["lm"], "racing engine was never stopped"
+    assert "lm" not in srv._models

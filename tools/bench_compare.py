@@ -44,10 +44,12 @@ from typing import Dict, List, Tuple
 # zero trips, and the zero-baseline rule below makes ANY trip on the
 # candidate side regress (worseness = the trip count itself) — a
 # watchdog firing during a healthy bench is a bug, not noise.
+# lock_order_violations rides the same rule: the runtime witness
+# recording a cycle during a clean bench is a latent deadlock.
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
                   "capacity_seqs")
 _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
-                 "watchdog_trips")
+                 "watchdog_trips", "lock_order_violations")
 
 
 def metric_direction(name: str) -> int:

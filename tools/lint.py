@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Repo-native static analysis driver: trace hazards + lock discipline.
+
+Runs the two AST passes in ``multiverso_tpu/analysis`` over the package
+(and ``tools/``), subtracts the justified-suppression baseline, and
+reports what's left:
+
+    python tools/lint.py                  # report all findings
+    python tools/lint.py --check          # CI gate: exit 1 on anything
+                                          # unsuppressed OR a stale/
+                                          # unjustified baseline entry
+    python tools/lint.py --graph          # dump the inter-lock graph
+    python tools/lint.py serving/foo.py   # lint specific files/dirs
+
+Baseline format (``tools/lint_baseline.txt``), one suppression per line:
+
+    LK203 path.py::Qual.name::slug -- why this is by-design
+
+The ``-- justification`` part is REQUIRED — an entry without one makes
+the run fail, because the whole point is that every silenced finding
+carries its defense in-tree. Stale entries (nothing matches anymore)
+also fail ``--check``: a fixed finding must leave the baseline with it.
+See docs/ANALYSIS.md for the rule catalog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from multiverso_tpu.analysis import locklint, retrace_lint  # noqa: E402
+from multiverso_tpu.analysis.common import (  # noqa: E402
+    BaselineError, iter_py_files, load_baseline, parse_module,
+    split_findings)
+
+DEFAULT_PATHS = ("multiverso_tpu", "tools")
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "lint_baseline.txt")
+
+
+def run(paths, baseline_path, check=False, graph=False, verbose=False,
+        out=sys.stdout):
+    files = []
+    for p in paths:
+        resolved = (os.path.join(REPO_ROOT, p) if not os.path.isabs(p)
+                    and not os.path.exists(p) else p)
+        got = iter_py_files([resolved])
+        if not got:
+            # a typo'd path silently linting NOTHING (and exiting 0)
+            # reads as "clean" — fail loudly instead
+            print(f"ERROR: {p!r} matched no Python files (resolved to "
+                  f"{resolved!r})", file=out)
+            return 2
+        files.extend(got)
+    files = sorted(set(files))
+    modules = [m for m in (parse_module(f, root=REPO_ROOT) for f in files)
+               if m is not None]
+    lock_findings, linter = locklint.lint_modules(modules)
+    findings = lock_findings + retrace_lint.lint_modules(modules)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    try:
+        baseline = load_baseline(baseline_path) if baseline_path else {}
+    except BaselineError as exc:
+        print(f"BASELINE ERROR: {exc}", file=out)
+        return 1
+    fresh, silenced, stale = split_findings(findings, baseline)
+    if graph:
+        print(linter.graph_report(), file=out)
+    for f in fresh:
+        print(f.render(), file=out)
+    if verbose:
+        for f in silenced:
+            print(f"suppressed: {f.render()}", file=out)
+            print(f"  -- {baseline[f.identity]}", file=out)
+    for ident in stale:
+        print(f"STALE baseline entry (fix landed? delete the line): "
+              f"{ident}", file=out)
+    print(f"{len(modules)} modules: {len(fresh)} finding(s), "
+          f"{len(silenced)} suppressed, {len(stale)} stale baseline "
+          f"entr{'y' if len(stale) == 1 else 'ies'}", file=out)
+    if check and (fresh or stale):
+        return 1
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                    help="files/directories to lint (default: "
+                         "multiverso_tpu tools)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="justified-suppression file ('' = none)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any unsuppressed finding or stale "
+                         "baseline entry (the CI gate)")
+    ap.add_argument("--graph", action="store_true",
+                    help="also print the inter-lock acquisition graph")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print suppressed findings + justifications")
+    args = ap.parse_args(argv)
+    return run(args.paths or list(DEFAULT_PATHS), args.baseline,
+               check=args.check, graph=args.graph, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

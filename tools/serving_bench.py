@@ -472,6 +472,51 @@ def _observability_ab(server, lm_model, quick: bool):
     return row, engine
 
 
+def _lockwatch_ab(server, quick: bool):
+    """Prices the runtime lock-order witness (``-lockwatch``): the SAME
+    engine (``lm_obs``, registered by the observability A/B) serves the
+    same mixed-length trace with the witness disabled vs enabled,
+    best-of-3 alternating passes. Both tok/s columns are ``_info`` — on
+    the 2-CPU container the witness's per-acquisition cost (a
+    thread-local append; the graph lock only on never-before-seen edges,
+    docs/ANALYSIS.md "cost posture") sits inside the scheduling-noise
+    floor — while ``lock_order_violations`` is a zero-baseline gate: a
+    cycle recorded during a clean bench is a latent deadlock, not noise.
+    """
+    from multiverso_tpu.analysis import lockwatch
+
+    # quick keeps the full 48-request trace: each pass is still
+    # sub-second, and a shorter one puts a single ~50 ms scheduler
+    # hiccup at >15% of the window — the off/on delta becomes a coin
+    # flip (observed up to 0.55 at n=24)
+    max_prompt, cap = 8, 64
+    n = 48
+    tr = _decode_trace(n, seed=29, max_prompt=max_prompt, max_new_cap=cap,
+                       mean_gap_s=0.0005, vocab=256, min_new=8)
+    useful = sum(n_new for _, _, n_new in tr)
+    before = lockwatch.violation_count()
+    was_enabled = lockwatch.enabled()
+    tps = {"off": 0.0, "on": 0.0}
+    for _ in range(3):
+        for label, on in (("off", False), ("on", True)):
+            if on:
+                lockwatch.enable()
+            else:
+                lockwatch.disable()
+            _, elapsed = _play_decode_trace(server, "lm_obs", tr, True)
+            tps[label] = max(tps[label], round(useful / elapsed, 1))
+    (lockwatch.enable if was_enabled else lockwatch.disable)()
+    return {
+        "requests": n,
+        "useful_tokens": useful,
+        "tokens_per_s_lockwatch_off_info": tps["off"],
+        "tokens_per_s_lockwatch_on_info": tps["on"],
+        "lockwatch_overhead_frac_info": (
+            round(1.0 - tps["on"] / tps["off"], 4) if tps["off"] else 0.0),
+        "lock_order_violations": lockwatch.violation_count() - before,
+    }
+
+
 def _warm(workload, snap_mgr, buckets) -> None:
     """Compile every bucket outside the timed loop (and outside the
     latency histogram)."""
@@ -563,6 +608,10 @@ def run(duration_s: float = 2.0, clients: int = 32,
                                 n_layers=2, d_ff=256, max_seq=80)
     out["workloads"]["observability"], obs_engine = _observability_ab(
         server, TransformerLM(obs_cfg), quick)
+    # lockwatch A/B rides the warm lm_obs engine: witness-off vs -on
+    # tok/s (both _info — the delta lives under the noise floor) plus
+    # the zero-baseline lock_order_violations gate
+    out["workloads"]["lockwatch"] = _lockwatch_ab(server, quick)
     for name, (workload, knobs, n_clients, payload_fn) in specs.items():
         server.register(name, workload, **knobs)
         server.register(f"{name}_b1", workload, max_batch=1,
