@@ -247,6 +247,14 @@ define_int("kv_pool_blocks", 0,
            "slots * ceil((max_prompt + max_new) / kv_block_size). "
            "serving.block_pool.blocks_for_bytes converts a device-bytes "
            "budget into this count")
+define_bool("prefix_cache", True,
+            "decode engine: content-addressed KV block reuse over the "
+            "paged pool — full blocks get a hash-chained identity, "
+            "admission splices the longest cached prefix into the new "
+            "sequence's block table (refcounted, copy-on-write) and "
+            "prefills only the remainder; needs kv_block_size > 0 and "
+            "prefill_token_budget > 0. false = every prompt prefills "
+            "from token zero (the A/B baseline)")
 define_string("log_file", "", "optional log sink file")
 define_string("log_level", "info", "debug|info|error|fatal")
 define_bool("trace", False,

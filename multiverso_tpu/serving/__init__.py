@@ -20,7 +20,10 @@ The inference half of the train/serve stack (docs/SERVING.md). Pieces:
   admission/completion (``InferenceServer.register_decoder``), chunked
   prefill under a per-iteration token budget
   (``prefill_token_budget``) so admissions never stall in-flight
-  generations for more than one chunk of work.
+  generations for more than one chunk of work, and content-addressed
+  prefix caching (``prefix_cache``: hash-chained block identities via
+  :func:`chain_hashes`, refcounted sharing, copy-on-write) so prompts
+  sharing a prefix prefill it once (docs/SERVING.md "Prefix caching").
 * the black box — :class:`FlightRecorder` (always-on bounded ring of
   per-iteration engine records) and :class:`EngineWatchdog`
   (stall/leak/queue-age self-diagnosis; trips dump a diagnostic bundle
@@ -30,7 +33,8 @@ The inference half of the train/serve stack (docs/SERVING.md). Pieces:
 
 from .batcher import (BatcherConfig, MicroBatcher, OverloadedError,
                       bucket_for, shape_buckets)
-from .block_pool import BlockPool, blocks_for_bytes, kv_bytes_per_block
+from .block_pool import (BlockPool, blocks_for_bytes, chain_hashes,
+                         kv_bytes_per_block)
 from .decode_engine import DecodeEngine, DecodeEngineConfig
 from .flight_recorder import FlightRecorder
 from .server import InferenceServer
@@ -44,6 +48,6 @@ __all__ = [
     "shape_buckets", "InferenceServer", "Snapshot", "SnapshotManager",
     "EmbeddingNeighbors", "FTRLPredict", "LMGreedyDecode", "LogRegPredict",
     "DecodeEngine", "DecodeEngineConfig", "BlockPool", "blocks_for_bytes",
-    "kv_bytes_per_block", "FlightRecorder", "EngineWatchdog",
-    "WatchdogConfig",
+    "chain_hashes", "kv_bytes_per_block", "FlightRecorder",
+    "EngineWatchdog", "WatchdogConfig",
 ]

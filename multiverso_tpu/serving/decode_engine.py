@@ -22,6 +22,22 @@ cache (the fixed-slot precursor to vLLM's PagedAttention):
   with :class:`OverloadedError` (``kv_block_size=0`` restores the
   contiguous ``[L, S, T, D]`` strips — the A/B baseline). Caches are
   jit-donated so XLA updates them in place off-CPU.
+* **content-addressed prefix caching** (``-prefix_cache``, default on;
+  paged + chunked only) — every FULL block a prefill writes is
+  registered under a hash-chained identity (``block_pool.chain_hashes``
+  seeded by the pinned snapshot version); admission looks up the
+  longest cached prefix of an arriving prompt, splices the matched
+  blocks into the new slot's table with a refcount bump, and starts
+  chunked prefill at the first uncached token. A fully cached prompt
+  skips prefill entirely: its slot goes live at ``P - 1`` and the first
+  token falls out of the next fused step (one copy-on-write of the last
+  matched block first — writes never land in shared blocks). Completed
+  sequences ``decref``; refcount-0 content-addressed blocks park in a
+  cached-LRU tier that allocation pressure evicts, so shared system
+  prompts/templates prefill once and multiply both effective KV
+  capacity and TTFT (vLLM automatic prefix caching / SGLang
+  RadixAttention). All placement still rides the block tables as traced
+  data — one compiled trace per program, cache hits or not.
 * **one fused step per iteration** — every iteration runs ONE jitted
   :func:`models.transformer.decode_step` over all S slots, live or
   dead. Shapes never depend on the request mix, so the step compiles
@@ -83,7 +99,7 @@ from .. import trace
 from ..dashboard import Dashboard
 from ..log import Log
 from .batcher import OverloadedError, bucket_for, shape_buckets
-from .block_pool import SCRATCH_BLOCK, BlockPool
+from .block_pool import SCRATCH_BLOCK, BlockPool, chain_hashes
 from .flight_recorder import FlightRecorder
 from .snapshot import SnapshotManager, replicate_for_decode
 from .watchdog import EngineWatchdog, WatchdogConfig
@@ -111,6 +127,11 @@ class DecodeEngineConfig:
     # the contiguous-equivalent capacity slots * ceil(T / block_size))
     kv_block_size: Optional[int] = None
     kv_pool_blocks: Optional[int] = None
+    # content-addressed prefix caching over the paged pool (None = the
+    # -prefix_cache flag; needs paged KV AND chunked prefill, silently
+    # inert otherwise). False is the A/B baseline: same pool bytes,
+    # every prompt prefills from token zero.
+    prefix_cache: Optional[bool] = None
     # black-box layer (None = the matching flag): always-on flight
     # recorder ring, stall/leak watchdog, trip-bundle target, and the
     # rolling-window latency SLOs registered in the Dashboard
@@ -177,7 +198,8 @@ _RIDS = itertools.count(1)
 class _Request:
     __slots__ = ("prompt", "max_new", "future", "t_enq", "t_last",
                  "slot", "out", "version", "ctx", "pf_off", "pf_chunks",
-                 "t_admit", "blocks", "rid")
+                 "t_admit", "blocks", "rid", "hashes", "hash_seed",
+                 "n_hit", "full_hit", "saved", "pf_reg", "ttft_pending")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  ctx: Optional[trace.SpanContext] = None) -> None:
@@ -199,6 +221,19 @@ class _Request:
         self.pf_off = 0
         self.pf_chunks = 0
         self.t_admit = 0.0
+        # prefix caching: the prompt's full-block hash chain (memoized
+        # per seed), blocks matched at admission, whether the WHOLE
+        # prompt was cached, prefill tokens skipped, how many prompt
+        # blocks are registered so far, and whether the next fused-step
+        # token is this request's FIRST (full hit: TTFT lands on the
+        # first decode step, not on a prefill chunk)
+        self.hashes: Optional[List[bytes]] = None
+        self.hash_seed: Optional[bytes] = None
+        self.n_hit = 0
+        self.full_hit = False
+        self.saved = 0
+        self.pf_reg = 0
+        self.ttft_pending = False
 
 
 class DecodeEngine:
@@ -312,6 +347,25 @@ class DecodeEngine:
         # prompt (and must fit the [.., T, ..] cache): clamp the chunk
         # shape — budgets past max_prompt just mean one-chunk admission
         self._budget = min(self._budget, ec.max_prompt)
+        # content-addressed prefix caching: paged blocks + chunked
+        # prefill only (monolithic admission writes the WHOLE prompt
+        # through the table in one fused insert — it cannot start at the
+        # first uncached token, so the cache gates itself off)
+        self._prefix = (self._paged and self._budget > 0
+                        and bool(ec._resolved("prefix_cache")))
+        self._hash_seed = b""        # pinned-version scope for the chain
+        if self._prefix:
+            # copy-on-write: duplicate one block (both pools) before a
+            # write lands in a shared one. src/dst are traced scalars —
+            # ONE compiled trace per engine config, dispatched host-side
+            # at admission before the table ever reaches the fused step.
+            self._cow_fn = jax.jit(
+                lambda kc, vc, src, dst: (
+                    kc.at[:, dst].set(kc[:, src]),
+                    vc.at[:, dst].set(vc[:, src])),
+                donate_argnums=(0, 1) if donate else ())
+        else:
+            self._cow_fn = None
         if self._paged:
             # block tables ride every call as DATA ([S, M] int32, fixed
             # shape): which blocks a slot owns never touches an aval, so
@@ -429,6 +483,15 @@ class DecodeEngine:
         # monotonic by contract (MetricsExporter rates), so stats() and
         # reset_stats() read/zero this mirror instead
         self.prefill_tokens = 0
+        # prefix-cache mirrors (the pool's PREFIX_* counters stay
+        # monotonic; these reset with the bench window)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefill_tokens_saved = 0
+        self.cow_copies = 0
+        # window base for the pool's monotonic eviction counter, so
+        # stats()["prefix_evictions"] resets with its sibling mirrors
+        self._evictions_base = 0
         self.t_first: Optional[float] = None
         self._occ_sum = 0.0          # mean occupancy over iterations
         self._occ_n = 0
@@ -517,9 +580,13 @@ class DecodeEngine:
         or live blocks held while NOTHING is alive to hold them (no
         active slot, no admission mid-flight — chunked ``_pf`` or
         monolithic ``_admitting``, whose cold-bucket compile can hold
-        reservations for seconds — nothing queued). Sampled racily —
-        the watchdog requires the verdict to persist across two polls
-        before tripping."""
+        reservations for seconds — nothing queued). Refcounted sharing
+        is NOT a leak: ``n_live`` counts blocks with holders exactly
+        once however many sequences share them, and prefix-cached
+        blocks whose refcount hit zero sit in the pool's CACHED tier,
+        outside ``n_live`` entirely. Sampled racily — the watchdog
+        requires the verdict to persist across two polls before
+        tripping."""
         if not self._paged:
             return None
         msg = self._pool.drift()
@@ -534,19 +601,55 @@ class DecodeEngine:
         return None
 
     # -- engine loop --------------------------------------------------------
+    def _req_hashes(self, req: _Request) -> List[bytes]:
+        """The prompt's full-block hash chain, memoized per seed (the
+        admission gate polls it every loop pass while a request waits
+        for blocks; a pin move invalidates the memo)."""
+        if req.hashes is None or req.hash_seed != self._hash_seed:
+            req.hashes = chain_hashes(req.prompt, self._block_size,
+                                      self._hash_seed)
+            req.hash_seed = self._hash_seed
+        return req.hashes
+
+    def _prefix_usable_hits(self, req: _Request) -> int:
+        """Net blocks the prefix cache saves ``req`` against the
+        RECLAIMABLE supply (free + cached) the gate checks — a peek, no
+        refcounts move. A live-shared hit is a pure saving; a hit on a
+        CACHED block saves the prefill but still consumes one unit of
+        that supply when lookup reactivates it, so it cancels out of
+        the arithmetic (counting it double let an admission pass the
+        gate and then run the allocator dry mid-reservation). A FULLY
+        cached prompt costs one more fresh block: its last block gets
+        copy-on-written so the first decode step can land P-1's K/V.
+        Floored at ZERO: the CoW dup's cost is offset by its decref'd
+        source returning to the reclaimable pool before the fresh
+        allocation runs, so the true supply draw never exceeds the
+        plain uncached reservation — without the floor, a block-aligned
+        max-context prompt re-hitting its own cached blocks computed
+        need = capacity + 1 and deadlocked the FIFO head forever
+        (regression-tested)."""
+        m, cached = self._pool.peek_counts(self._req_hashes(req))
+        usable = m - 1 if (m and m * self._block_size == len(req.prompt)) \
+            else m
+        return max(0, usable - cached)
+
     def _blocks_cover(self, req: _Request, reserved: int) -> bool:
         """Paged-KV admission gate: a request admits only when its WHOLE
         reservation (``prompt + max_new`` worth of blocks, less what
-        earlier arrivals of the same wave will take) fits the free list.
-        A false verdict leaves it QUEUED — completions free blocks at
-        iteration granularity, so it admits as soon as enough return;
-        only a request larger than the entire pool could wait forever,
-        and ``submit`` shed that case up front (no admission deadlock,
-        tested)."""
+        earlier arrivals of the same wave will take — and, with prefix
+        caching, less the cached blocks it will share instead of
+        allocate) fits the reclaimable pool (free list + evictable
+        cached blocks). A false verdict leaves it QUEUED — completions
+        free blocks at iteration granularity, so it admits as soon as
+        enough return; only a request larger than the entire pool could
+        wait forever, and ``submit`` shed that case up front (no
+        admission deadlock, tested)."""
         if not self._paged:
             return True
         need = self._pool.blocks_needed(len(req.prompt) + req.max_new)
-        return need + reserved <= self._pool.n_free
+        if self._prefix:
+            need -= self._prefix_usable_hits(req)
+        return need + reserved <= self._pool.n_free + self._pool.n_cached
 
     def _loop(self) -> None:
         chunked = self._budget > 0
@@ -649,6 +752,7 @@ class DecodeEngine:
             self._it_prefill, self._it_decode,
             self._pool.n_free if self._paged else -1,
             self._pool.n_live if self._paged else -1,
+            self._pool.n_shared if self._paged else -1,
             self._snap.version if self._snap is not None else -1,
             tuple(self._it_admitted), tuple(self._it_completed)))
 
@@ -670,28 +774,78 @@ class DecodeEngine:
                             version=snap.version):
                 self._pinned = replicate_for_decode(snap.value)
             self._snap = snap
+            if self._prefix:
+                # the hash chain is scoped to the params the K/V was
+                # computed under: when the pin moves, cached blocks are
+                # garbage to the new version — flush them (the version
+                # seed alone would keep them resident but unreachable,
+                # silently shrinking effective capacity)
+                seed = str(snap.version).encode()
+                if seed != self._hash_seed:
+                    self._hash_seed = seed
+                    self._pool.flush_cache()
 
     def _reserve_blocks(self, req: _Request, slot: int) -> None:
-        """Paged KV: allocate the admission's WHOLE reservation
+        """Paged KV: build the admission's WHOLE reservation
         (``prompt + max_new`` positions) up front and install it in the
         slot's block table row — the loop's ``_blocks_cover`` gate
-        guaranteed coverage, so this cannot fail."""
+        guaranteed coverage, so this cannot fail.
+
+        With prefix caching the reservation SPLICES: the longest cached
+        prefix of the prompt is claimed from the content index (those
+        blocks gain a holder instead of being allocated) and only the
+        remainder comes off the free list. A fully cached prompt
+        additionally copy-on-writes its LAST matched block: the first
+        decode step recomputes position ``P - 1`` and writes its K/V
+        there, and a write must never land in a shared block — the copy
+        happens here, host-dispatched, before the table is ever handed
+        to the jitted step."""
         if not self._paged:
             return
-        need = self._pool.blocks_needed(len(req.prompt) + req.max_new)
-        req.blocks = self._pool.alloc(need)
+        total = self._pool.blocks_needed(len(req.prompt) + req.max_new)
+        matched: List[int] = []
+        if self._prefix:
+            hashes = self._req_hashes(req)
+            matched = self._pool.lookup(hashes)
+            req.n_hit = len(matched)
+            req.full_hit = bool(matched) and (
+                len(matched) * self._block_size == len(req.prompt))
+            if req.full_hit:
+                shared_last = matched[-1]
+                dup = self._pool.alloc(1)[0]
+                self._k_cache, self._v_cache = self._cow_fn(
+                    self._k_cache, self._v_cache,
+                    np.int32(shared_last), np.int32(dup))
+                self._pool.decref([shared_last])
+                matched[-1] = dup
+                self.cow_copies += 1
+            req.saved = (len(req.prompt) if req.full_hit
+                         else req.n_hit * self._block_size)
+            self.prefix_hits += req.n_hit
+            self.prefix_misses += len(hashes) - req.n_hit
+            self.prefill_tokens_saved += req.saved
+        req.blocks = matched + self._pool.alloc(total - len(matched))
         row = self._block_tables[slot]
         row[:] = SCRATCH_BLOCK
-        row[: need] = req.blocks
+        row[: total] = req.blocks
 
     def _release_seq(self, req: _Request) -> None:
         """Completion (eos / max_new / eos-at-first-token): the slot
         returns to the free set and, paged, the reservation's blocks
-        return to the pool — at iteration granularity, so a same-
+        drop this holder — at iteration granularity, so a same-
         iteration queued admission can reuse them on the very next
-        loop pass (tested)."""
+        loop pass (tested). ``decref``, not ``free``: a block shared
+        with a live sequence stays live under its remaining holders,
+        and a content-addressed block parks in the pool's cached-LRU
+        tier instead of losing its identity — the next shared-prefix
+        arrival reactivates it without re-prefilling. Decref TAIL
+        first: release order is LRU order, and peek/lookup walk the
+        hash chain head-first, so eviction must shrink a chain from
+        its END — a head-first release would have pressure evict the
+        chain's first block and strand every cached suffix block as
+        unreachable dead weight (the vLLM eviction convention)."""
         if self._paged and req.blocks:
-            self._pool.free(req.blocks)
+            self._pool.decref(reversed(req.blocks))
             req.blocks = []
             self._block_tables[req.slot][:] = SCRATCH_BLOCK
         self._free_q.append(req.slot)
@@ -706,10 +860,37 @@ class DecodeEngine:
         req.version = self._snap.version
         req.slot = slot
         self._reserve_blocks(req, slot)
-        req.pf_off = 0
         req.pf_chunks = 0
         req.t_admit = time.monotonic()   # queue.wait ends here
         self._it_admitted.append(req.rid)
+        if self._prefix and req.full_hit:
+            # the WHOLE prompt was cached: no prefill at all. The slot
+            # goes live at position P-1 with the prompt's last token as
+            # input — the next fused step recomputes that position's
+            # K/V (into the block CoW'd at reservation), and its output
+            # IS the request's first token (TTFT = one decode step).
+            if trace.enabled() and req.ctx is not None:
+                now = time.monotonic()
+                trace.record_span("queue.wait", req.ctx, req.t_enq,
+                                  req.t_admit, cause="admission")
+                trace.record_span(
+                    "decode.admit", req.ctx, req.t_admit, now,
+                    slot=slot, prompt_len=len(req.prompt), chunks=0,
+                    budget=self._budget, snapshot_version=req.version,
+                    blocks=len(req.blocks), pool_free=self._pool.n_free,
+                    prefix_hit_blocks=req.n_hit,
+                    prefill_tokens_saved=req.saved)
+            req.ttft_pending = True
+            self._slot_req[slot] = req
+            self._tok[slot] = int(req.prompt[-1])
+            self._pos[slot] = len(req.prompt) - 1
+            self._active[slot] = True
+            self._pf = None
+            return
+        # chunked prefill starts at the first UNCACHED token (block-
+        # aligned); the matched prefix blocks are already in the table
+        req.pf_off = req.n_hit * self._block_size if self._prefix else 0
+        req.pf_reg = req.n_hit
         self._pf = req
 
     def _prefill_one_chunk(self) -> None:
@@ -747,6 +928,17 @@ class DecodeEngine:
         self.prefill_tokens += n
         self.prefill_tok_counter.inc(n)
         self._it_prefill += n
+        if self._prefix:
+            # every prompt block this chunk COMPLETED gains its content
+            # identity now, not at release: a concurrent same-prefix
+            # arrival can share a still-prefilling sequence's blocks
+            # (register no-ops when an identical block beat us to it)
+            hashes = self._req_hashes(req)
+            while (req.pf_reg < len(hashes)
+                   and (req.pf_reg + 1) * self._block_size <= req.pf_off):
+                self._pool.register(req.blocks[req.pf_reg],
+                                    hashes[req.pf_reg])
+                req.pf_reg += 1
         final = req.pf_off >= len(req.prompt)
         if tracing and req.ctx is not None:
             trace.record_span(
@@ -771,6 +963,9 @@ class DecodeEngine:
             extra = ({"blocks": len(req.blocks),
                       "pool_free": self._pool.n_free}
                      if self._paged else {})
+            if self._prefix:
+                extra["prefix_hit_blocks"] = req.n_hit
+                extra["prefill_tokens_saved"] = req.saved
             trace.record_span(
                 "decode.admit", req.ctx, req.t_admit, now, slot=req.slot,
                 prompt_len=len(req.prompt), chunks=req.pf_chunks,
@@ -914,7 +1109,13 @@ class DecodeEngine:
             self.tokens += 1
             self.decode_tok_counter.inc()
             self._it_decode += 1
-            self.itl_hist.record((now - req.t_last) * 1e3)
+            if req.ttft_pending:
+                # fully-cached admission: THIS is the request's first
+                # token — it belongs in the TTFT histogram, not ITL
+                req.ttft_pending = False
+                self.ttft_hist.record((now - req.t_enq) * 1e3)
+            else:
+                self.itl_hist.record((now - req.t_last) * 1e3)
             req.t_last = now
             if tracing and req.ctx is not None:
                 # one fused step serves every live slot; each request
@@ -968,10 +1169,12 @@ class DecodeEngine:
             # the dying requests' reservations go back too — including
             # arrivals reserved mid-_admit but not yet slotted. The
             # engine is stopped, but stats()/gauges must not report
-            # phantom live blocks (the pool's leak invariant must hold)
+            # phantom live blocks (the pool's leak invariant must hold).
+            # decref, not free: prefix-shared blocks carry one holder
+            # per dying request, and each drops exactly its own
             for req in live + (in_flight or []):
                 if req.blocks:
-                    self._pool.free(req.blocks)
+                    self._pool.decref(req.blocks)
                     req.blocks = []
             self._block_tables[:] = SCRATCH_BLOCK
         self._active[:] = False
@@ -1039,6 +1242,13 @@ class DecodeEngine:
                             np.full((bb, M), SCRATCH_BLOCK, np.int32),
                             np.ones((bb, pb), np.int32),
                             np.ones(bb, np.int32))
+            if self._prefix:
+                # the CoW block copy is part of the serving path (a
+                # full-prompt cache hit dispatches it at admission):
+                # compile it here so no live request pays the trace
+                kc, vc = scratch()
+                jax.block_until_ready(self._cow_fn(
+                    kc, vc, np.int32(0), np.int32(0)))
             kc, vc = scratch()
             jax.block_until_ready(self._step_fn(
                 params, kc, vc, bt, np.zeros(S, np.int32),
@@ -1071,6 +1281,12 @@ class DecodeEngine:
         self.tokens = 0
         self.peak_live = 0
         self.prefill_tokens = 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefill_tokens_saved = 0
+        self.cow_copies = 0
+        if self._paged:
+            self._evictions_base = self._pool.evictions
         self.t_first = None
         self._occ_sum = 0.0
         self._occ_n = 0
@@ -1088,9 +1304,24 @@ class DecodeEngine:
                  "kv_pool_blocks": self._pool.capacity,
                  "kv_blocks_free": self._pool.n_free,
                  "kv_blocks_live": self._pool.n_live,
+                 "kv_blocks_cached": self._pool.n_cached,
+                 "blocks_shared": self._pool.n_shared,
                  "block_allocs": self._pool.allocs,
                  "block_frees": self._pool.frees}
                 if self._paged else {"kv_block_size": 0})
+        if self._paged:
+            lookups = self.prefix_hits + self.prefix_misses
+            pool.update({
+                "prefix_cache": int(self._prefix),
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_hit_rate": (self.prefix_hits / lookups
+                                    if lookups else 0.0),
+                "prefill_tokens_saved": self.prefill_tokens_saved,
+                "prefix_evictions": self._pool.evictions
+                - self._evictions_base,
+                "cow_copies": self.cow_copies,
+            })
         health = self.health()
         return {
             **pool,

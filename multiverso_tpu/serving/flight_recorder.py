@@ -33,6 +33,8 @@ Record schema (:data:`FIELDS`, positional):
 ``decode_toks``         tokens emitted THIS pass (first tokens included)
 ``pool_free``           paged-KV pool free blocks (-1 when contiguous)
 ``pool_live``           paged-KV pool live blocks (-1 when contiguous)
+``pool_shared``         prefix-cache shared blocks — live blocks held by
+                        >= 2 sequences (-1 when contiguous)
 ``version``             pinned snapshot version (-1 before the first pin)
 ``admitted``            request ids admitted this pass (tuple, usually empty)
 ``completed``           request ids completed this pass (tuple)
@@ -69,7 +71,7 @@ from typing import Any, Dict, List, Optional
 
 FIELDS = ("it", "ts", "busy_ms", "step_ms", "live", "reserved", "queue",
           "queue_age_ms", "prefill_toks", "decode_toks", "pool_free",
-          "pool_live", "version", "admitted", "completed")
+          "pool_live", "pool_shared", "version", "admitted", "completed")
 
 
 def window_digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -222,7 +224,8 @@ class FlightRecorder:
             if r[10] >= 0:
                 events.append({"name": f"{prefix}/kv_blocks", "ph": "C",
                                "ts": ts, "pid": pid, "tid": 0,
-                               "args": {"free": r[10], "live": r[11]}})
+                               "args": {"free": r[10], "live": r[11],
+                                        "shared": max(0, r[12])}})
         return events
 
     def merge_chrome(self, doc: dict) -> dict:

@@ -17,10 +17,14 @@ behaving like an engine:
   iterating happily while admission starves.
 * **block-pool drift** — the paged-KV allocator's books stopped
   balancing (``BlockPool.drift()``: double-frees, leaks, scratch-block
-  circulation) or live blocks exist with zero live sequences. Sampled
-  racily against the running loop, so a drift verdict must hold for two
-  consecutive polls before it trips (a mid-admission snapshot is not a
-  leak).
+  circulation, refcount/content-index skew) or live blocks exist with
+  zero live sequences. Refcounted prefix sharing is NOT drift: a
+  shared block counts as live exactly once however many sequences
+  hold it, and refcount-0 cached blocks sit in the pool's cached tier
+  — outside ``n_live`` — awaiting reuse or eviction, so a drained
+  engine with a warm prefix cache reads clean. Sampled racily against
+  the running loop, so a drift verdict must hold for two consecutive
+  polls before it trips (a mid-admission snapshot is not a leak).
 * **lock-order violation** — the runtime lock-order witness
   (:mod:`~multiverso_tpu.analysis.lockwatch`, ``-lockwatch``) recorded
   a new acquisition-order cycle anywhere in the process: two threads

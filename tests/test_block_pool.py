@@ -112,3 +112,154 @@ def test_occupancy_metrics_registered():
     assert Dashboard.stats("KV_BLOCKS_LIVE[t_bp]") == {"value": 3.0}
     assert Dashboard.stats("BLOCK_ALLOC[t_bp]") == {"value": 4}
     assert Dashboard.stats("BLOCK_FREE[t_bp]") == {"value": 1}
+
+
+# -- prefix caching: content addressing, refcounts, CoW bookkeeping ----------
+
+def test_chain_hashes_prefix_identity_and_divergence():
+    """Equal hashes <=> equal token PREFIXES: the chain folds each
+    block's predecessor in, so a divergence anywhere poisons every
+    later block's identity, and the seed scopes the whole chain."""
+    from multiverso_tpu.serving.block_pool import chain_hashes
+
+    a = chain_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9], 4)
+    assert len(a) == 2                            # trailing partial: no id
+    b = chain_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    assert a == chain_hashes(np.array([1, 2, 3, 4, 5, 6, 7, 8], np.int32), 4)
+    assert a[0] == b[0] and a[1] == b[1]
+    # divergence INSIDE block 0 changes both identities, even though
+    # block 1's own tokens are identical
+    c = chain_hashes([1, 2, 3, 99, 5, 6, 7, 8], 4)
+    assert c[0] != a[0] and c[1] != a[1]
+    # same tokens under a different seed (params version) never match
+    assert chain_hashes([1, 2, 3, 4], 4, seed=b"v1") != \
+        chain_hashes([1, 2, 3, 4], 4, seed=b"v2")
+    assert chain_hashes([1, 2, 3], 4) == []
+
+
+def test_refcount_share_decref_and_cached_reactivation():
+    """A registered block survives its last holder as CACHED (not
+    free), reactivates through lookup, and sharing guards hold: free()
+    on a shared block raises, decref drops exactly one holder."""
+    from multiverso_tpu.serving.block_pool import chain_hashes
+
+    pool = _pool(n=4, bs=4, name="t_rc")
+    h = chain_hashes([1, 2, 3, 4], 4)
+    (b0,) = pool.alloc(1)
+    assert pool.register(b0, h[0]) is True
+    assert pool.register(b0, h[0]) is False       # identical content: no-op
+    assert pool.lookup(h) == [b0]                 # live block gains a holder
+    assert pool.n_shared == 1
+    with pytest.raises(RuntimeError):
+        pool.free([b0])                           # shared: free() refuses
+    pool.decref([b0])
+    assert pool.n_shared == 0 and pool.n_live == 1
+    pool.decref([b0])                             # last holder out -> cached
+    assert pool.n_live == 0 and pool.n_cached == 1 and pool.n_free == 3
+    pool.check()
+    # reactivation: the SAME physical block comes back at refcount 1
+    assert pool.lookup(h) == [b0]
+    assert pool.n_cached == 0 and pool.n_live == 1
+    with pytest.raises(RuntimeError):
+        pool.decref([99])                         # foreign id
+    pool.decref([b0])
+    with pytest.raises(RuntimeError):
+        pool.decref([b0])                         # double-decref (cached now)
+    assert pool.stats()["prefix_hits"] == 2
+    pool.check()
+
+
+def test_eviction_is_lru_and_flush_clears_identity():
+    from multiverso_tpu.serving.block_pool import chain_hashes
+
+    pool = _pool(n=3, bs=2, name="t_ev")
+    hs = chain_hashes([1, 2, 3, 4, 5, 6], 2)      # 3 distinct identities
+    blocks = pool.alloc(3)
+    for b, h in zip(blocks, hs):
+        pool.register(b, h)
+    # release in order 1, 0, 2: LRU order is release order
+    pool.decref([blocks[1]])
+    pool.decref([blocks[0]])
+    pool.decref([blocks[2]])
+    assert pool.n_cached == 3 and pool.n_free == 0
+    assert pool.can_alloc(2)                      # cached IS reclaimable
+    got = pool.alloc(2)                           # evicts blocks[1], [0]
+    assert pool.evictions == 2
+    assert pool.peek(hs) == 0                     # hs[0]'s eviction breaks the chain walk
+    assert pool.peek(hs[2:]) == 1                 # blocks[2] survived (MRU)
+    pool.decref(got)                  # unregistered: straight back to free
+    assert pool.n_cached == 1
+    assert pool.flush_cache() == 1
+    assert pool.n_cached == 0 and pool.n_free == 3
+    assert pool.peek(hs) == 0                     # identities all gone
+    pool.check()
+
+
+def test_property_refcount_churn_never_leaks_or_double_frees():
+    """Randomized alloc/register/lookup/decref/evict/flush
+    churn: after EVERY operation drift() is clean (free+live+cached
+    partition capacity, refcounts >= 1, index bijective), and a fully
+    drained pool frees everything it allocated."""
+    from multiverso_tpu.serving.block_pool import chain_hashes
+
+    rng = np.random.default_rng(2)
+    pool = _pool(n=16, bs=4, name="t_pc_churn")
+    seqs: dict = {}                               # seq id -> blocks held
+    next_seq = 0
+    identities = [chain_hashes(rng.integers(1, 9, 8).tolist(), 4)
+                  for _ in range(6)]              # 6 chains x 2 blocks
+    for _ in range(600):
+        op = rng.random()
+        if op < 0.35 and pool.can_alloc(2):
+            blocks = pool.alloc(2)
+            chain = identities[int(rng.integers(0, len(identities)))]
+            for b, h in zip(blocks, chain):
+                pool.register(b, h)               # no-op on duplicates
+            seqs[next_seq] = blocks
+            next_seq += 1
+        elif op < 0.55:
+            chain = identities[int(rng.integers(0, len(identities)))]
+            matched = pool.lookup(chain)
+            if matched:
+                seqs[next_seq] = matched
+                next_seq += 1
+        elif op < 0.9 and seqs:
+            k = list(seqs)[int(rng.integers(0, len(seqs)))]
+            pool.decref(seqs.pop(k))
+        elif op < 0.95:
+            pool.flush_cache()
+        elif not pool.can_alloc(2):
+            with pytest.raises(RuntimeError):
+                pool.alloc(pool.capacity + 1)
+        assert pool.drift() is None, pool.drift()
+        held = sum(len(b) for b in seqs.values())
+        assert pool.n_live <= held                # sharing: live <= holders
+        assert pool.n_live + pool.n_free + pool.n_cached == pool.capacity
+    for blocks in seqs.values():
+        pool.decref(blocks)
+    pool.flush_cache()
+    pool.check()
+    assert pool.n_free == pool.capacity
+    assert pool.allocs == pool.frees              # drained: ledger balances
+
+
+def test_prefix_metrics_registered():
+    from multiverso_tpu.dashboard import Dashboard
+    from multiverso_tpu.serving.block_pool import chain_hashes
+
+    pool = _pool(n=4, bs=2, name="t_pm")
+    hs = chain_hashes([5, 6, 7, 8], 2)
+    blocks = pool.alloc(2)
+    for b, h in zip(blocks, hs):
+        pool.register(b, h)
+    pool.lookup(hs)                               # 2 hits, live -> shared
+    assert Dashboard.stats("KV_BLOCKS_SHARED[t_pm]") == {"value": 2.0}
+    assert Dashboard.stats("PREFIX_HITS[t_pm]") == {"value": 2}
+    pool.lookup(chain_hashes([9, 9, 9, 9], 2))    # 2 misses
+    assert Dashboard.stats("PREFIX_MISSES[t_pm]") == {"value": 2}
+    pool.decref(blocks)
+    pool.decref(blocks)                           # -> cached
+    pool.alloc(4)                                 # pressure: evicts both
+    assert Dashboard.stats("PREFIX_EVICTIONS[t_pm]") == {"value": 2}
+    assert Dashboard.stats("KV_BLOCKS_SHARED[t_pm]") == {"value": 0.0}
+    pool.check()

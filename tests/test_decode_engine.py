@@ -470,8 +470,15 @@ def test_paged_eos_frees_blocks_same_iteration_reuse(mv_session):
     stats = engine.stats()
     assert stats["completed"] == 8
     assert stats["kv_blocks_live"] == 0
-    assert stats["block_allocs"] == stats["block_frees"] > 0
-    assert stats["kv_blocks_free"] == 5
+    # drained: every block is reclaimable — free outright, or parked in
+    # the prefix cache's LRU tier (full prompt blocks keep their content
+    # identity past their last holder); flushing the cache balances the
+    # alloc/free ledger exactly
+    assert stats["kv_blocks_free"] + stats["kv_blocks_cached"] == 5
+    engine._pool.flush_cache()
+    s = engine._pool.stats()
+    assert s["allocs"] == s["frees"] > 0
+    engine._pool.check()
 
 
 def test_paged_engine_failure_path_returns_blocks(mv_session):
@@ -538,6 +545,288 @@ def test_paged_matches_contiguous_outputs(mv_session):
     assert paged_stats["kv_block_size"] == 4
     assert paged_stats["kv_blocks_live"] == 0
     assert engines[0].stats()["kv_block_size"] == 0
+
+
+# -- prefix caching: content-addressed, refcounted, copy-on-write blocks -----
+
+def test_prefix_cache_shared_prefix_bit_exact_vs_cache_off(mv_session):
+    """The prefix-caching acceptance oracle: a shared-prefix batch
+    served with the cache ON produces token-for-token identical outputs
+    to the cache-OFF engine AND the per-request ``greedy_decode``
+    oracle, while actually hitting the cache (hits > 0, prefill tokens
+    saved > 0) — and the compiled-trace set stays exactly (1 chunk +
+    1 step + 1 CoW) per engine: cache hits are data, not shapes."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+    from multiverso_tpu.serving.workloads import _jit_cache_size
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    engines = {
+        label: srv.register_decoder(
+            f"lm_{label}", lm, slots=4, max_prompt=16, max_new=8,
+            kv_block_size=4, prefill_token_budget=4, prefix_cache=on)
+        for label, on in (("on", True), ("off", False))
+    }
+    for e in engines.values():
+        e.warmup()
+    params, _ = lm.snapshot_params()
+
+    rng = np.random.default_rng(21)
+    shared = rng.integers(1, cfg.vocab_size, 8).astype(np.int32)  # 2 blocks
+    prompts = [shared]                    # registers the prefix
+    for _ in range(6):                    # shared prefix + unique tails
+        tail = rng.integers(1, cfg.vocab_size,
+                            int(rng.integers(1, 9))).astype(np.int32)
+        prompts.append(np.concatenate([shared, tail]))
+    prompts.append(shared.copy())         # exact repeat: the FULL hit
+    outs = {}
+    for label in engines:
+        futs = [srv.submit(f"lm_{label}", {"prompt": p, "max_new": 6})
+                for p in prompts]
+        outs[label] = [f.result(timeout=120)["result"] for f in futs]
+    for i, p in enumerate(prompts):
+        expect = _oracle(cfg, params, p, 6)
+        np.testing.assert_array_equal(
+            outs["on"][i], expect, err_msg=f"cache-on diverged, prompt {i}")
+        np.testing.assert_array_equal(
+            outs["off"][i], expect, err_msg=f"cache-off diverged, prompt {i}")
+    on, off = engines["on"].stats(), engines["off"].stats()
+    assert on["prefix_hits"] > 0 and on["prefill_tokens_saved"] > 0
+    assert 0.0 < on["prefix_hit_rate"] <= 1.0
+    assert on["cow_copies"] >= 1          # the full-hit repeat CoW'd
+    assert off["prefix_hits"] == off["prefill_tokens_saved"] == 0
+    # the cached side did strictly less prefill work for the same tokens
+    assert on["prefill_tokens"] < off["prefill_tokens"]
+    assert on["tokens"] == off["tokens"]
+    # one-trace-under-cache-hits: hits/misses/CoW never add a compile
+    for e in engines.values():
+        assert e.step_cache_size() == 1
+        assert e.prefill_cache_size() == 1
+    assert _jit_cache_size(engines["on"]._cow_fn) == 1
+    engines["on"]._pool.check()
+    assert engines["on"].pool_drift() is None
+
+
+def test_prefix_cache_cow_divergence(mv_session):
+    """Copy-on-write correctness at the divergence boundary: an exact
+    full-prompt repeat (decode must rewrite position P-1 inside a
+    SHARED block -> CoW) interleaved with prompts diverging INSIDE the
+    last shared block — every output stays oracle-exact and the books
+    balance. Serial submits force each request to see its predecessors'
+    blocks as cached-or-shared, not private."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    engine = srv.register_decoder("lm", lm, slots=2, max_prompt=12,
+                                  max_new=6, kv_block_size=4,
+                                  prefill_token_budget=4)
+    engine.warmup()
+    params, _ = lm.snapshot_params()
+    rng = np.random.default_rng(31)
+    base = rng.integers(1, cfg.vocab_size, 8).astype(np.int32)
+    diverged = base.copy()
+    diverged[6] = (diverged[6] % (cfg.vocab_size - 1)) + 1  # inside block 1
+    longer = np.concatenate(
+        [base, rng.integers(1, cfg.vocab_size, 3).astype(np.int32)])
+    cases = [base, base.copy(), diverged, base.copy(), longer, diverged.copy()]
+    for i, p in enumerate(cases):
+        out = srv.submit("lm", {"prompt": p, "max_new": 6}).result(
+            timeout=120)["result"]
+        np.testing.assert_array_equal(
+            out, _oracle(cfg, params, p, 6),
+            err_msg=f"case {i} (len {len(p)})")
+    s = engine.stats()
+    # the exact repeats were full hits (2 blocks each), so positions
+    # P-1 were recomputed into CoW'd copies, never into shared blocks
+    assert s["cow_copies"] >= 2
+    # diverged shares block 0 but NOT block 1 (hash chain breaks at the
+    # divergent token), longer shares both full blocks
+    assert s["prefix_hits"] >= 2 and s["prefix_misses"] >= 1
+    engine._pool.check()
+    assert engine.pool_drift() is None
+
+
+def test_prefix_cache_eviction_under_pressure_stays_exact(mv_session):
+    """A pool too small to cache every distinct prefix must EVICT (LRU)
+    rather than refuse admissions — outputs stay oracle-exact through
+    eviction churn and the allocator's invariants hold throughout."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    # 4 usable blocks x 4 positions: one reservation (8 + 6 -> 4 blocks)
+    # is the WHOLE pool, so every admission must first evict whatever
+    # the previous ones cached
+    engine = srv.register_decoder("lm", lm, slots=2, max_prompt=8,
+                                  max_new=6, kv_block_size=4,
+                                  kv_pool_blocks=4, prefill_token_budget=4)
+    engine.warmup()
+    params, _ = lm.snapshot_params()
+    rng = np.random.default_rng(41)
+    distinct = [rng.integers(1, cfg.vocab_size, 8).astype(np.int32)
+                for _ in range(4)]
+    order = [0, 1, 2, 3, 0, 2, 1, 3]              # revisits after eviction
+    for i in order:
+        out = srv.submit("lm", {"prompt": distinct[i],
+                                "max_new": 4}).result(timeout=120)["result"]
+        np.testing.assert_array_equal(
+            out, _oracle(cfg, params, distinct[i], 4),
+            err_msg=f"prefix {i} after eviction churn")
+    s = engine.stats()
+    assert s["prefix_evictions"] > 0, "pool never came under pressure"
+    assert s["kv_blocks_live"] == 0
+    engine._pool.check()
+    assert engine.pool_drift() is None
+
+
+def test_prefix_cache_gate_counts_cached_hits_against_supply(mv_session):
+    """Regression (review finding): a matched CACHED block satisfies
+    the prefix hit but still consumes one unit of the reclaimable
+    (free + cached) supply when lookup reactivates it. The old gate
+    credited it twice — need shrank by the hit AND the block stayed in
+    the availability count — so an admission could pass the gate and
+    then run the allocator dry mid-reservation, killing the engine
+    loop (_fail_all). Scenario: pool of 4, a live non-sharing sequence
+    holding 1 block, 2 cached prefix blocks, 1 free; a prompt whose
+    first 2 blocks are the cached prefix and whose reservation needs 4
+    must QUEUE until the live sequence completes — and then succeed."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    engine = srv.register_decoder("lm", lm, slots=2, max_prompt=12,
+                                  max_new=4, kv_block_size=4,
+                                  kv_pool_blocks=4, prefill_token_budget=4)
+    engine.warmup()
+    params, _ = lm.snapshot_params()
+    rng = np.random.default_rng(61)
+    prefix = rng.integers(1, cfg.vocab_size, 8).astype(np.int32)
+    # seed: completes and parks its 2 full blocks in the cached tier
+    srv.submit("lm", {"prompt": prefix, "max_new": 2}).result(timeout=120)
+    assert engine._pool.n_cached == 2
+    # occupant: 1 block (prompt 1 + max_new 3), live for ~3 iterations
+    occ = srv.submit("lm", {"prompt": prefix[:1], "max_new": 3})
+    # victim: 12-token prompt hitting both cached blocks, total = 4
+    # blocks — with the occupant holding one, it must wait, not die
+    victim_prompt = np.concatenate(
+        [prefix, rng.integers(1, cfg.vocab_size, 4).astype(np.int32)])
+    victim = srv.submit("lm", {"prompt": victim_prompt, "max_new": 4})
+    np.testing.assert_array_equal(
+        occ.result(timeout=120)["result"], _oracle(cfg, params,
+                                                   prefix[:1], 3))
+    np.testing.assert_array_equal(
+        victim.result(timeout=120)["result"],
+        _oracle(cfg, params, victim_prompt, 4))
+    assert engine.stats()["prefix_hits"] >= 2
+    engine._pool.check()
+    assert engine.pool_drift() is None
+
+
+def test_prefix_cache_full_pool_full_hit_resubmit_never_deadlocks(
+        mv_session):
+    """Regression (review finding): a block-aligned max-context prompt
+    whose reservation IS the whole pool passes submit's shed check,
+    completes, and parks its prompt blocks in the cached tier. An
+    identical resubmission then peeks an all-cached FULL hit; the
+    gate's CoW +1 adjustment computed need = capacity + 1 — a bar no
+    drained pool can ever meet — and wedged the FIFO head forever. The
+    CoW dup is actually free there (its decref'd source returns to the
+    reclaimable pool before the fresh alloc), so the floored gate must
+    admit it; both submissions stay oracle-exact."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    # total = ceil((8 + 8) / 4) = 4 blocks == the whole pool
+    engine = srv.register_decoder("lm", lm, slots=2, max_prompt=8,
+                                  max_new=8, kv_block_size=4,
+                                  kv_pool_blocks=4, prefill_token_budget=4)
+    engine.warmup()
+    params, _ = lm.snapshot_params()
+    rng = np.random.default_rng(71)
+    prompt = rng.integers(1, cfg.vocab_size, 8).astype(np.int32)
+    expect = _oracle(cfg, params, prompt, 8)
+    for attempt in range(3):                      # retry-storm shape
+        out = srv.submit("lm", {"prompt": prompt,
+                                "max_new": 8}).result(timeout=120)["result"]
+        np.testing.assert_array_equal(out, expect,
+                                      err_msg=f"resubmission {attempt}")
+    s = engine.stats()
+    assert s["cow_copies"] >= 1                   # the full hits CoW'd
+    assert s["shed"] == 0
+    engine._pool.check()
+    assert engine.pool_drift() is None
+
+
+def test_prefix_cache_release_order_evicts_chain_tail_first(mv_session):
+    """Regression (review finding): release order is LRU order and
+    peek/lookup walk the chain head-first, so a completed sequence
+    must release TAIL first — head-first release had pressure evict
+    block 0 of a chain and strand its cached suffix as unreachable."""
+    from multiverso_tpu.serving.block_pool import BlockPool, chain_hashes
+
+    pool = BlockPool(4, 2, name="t_tail")
+    hs = chain_hashes([1, 2, 3, 4, 5, 6], 2)      # one 3-block chain
+    blocks = pool.alloc(3)
+    for b, h in zip(blocks, hs):
+        pool.register(b, h)
+    # engine-style release: tail first (what _release_seq does)
+    pool.decref(reversed(blocks))
+    assert pool.can_alloc(2)
+    pool.alloc(2)                    # free list held 1: evicts ONE block
+    assert pool.evictions == 1
+    # the evicted block was the chain's TAIL: head + middle still hit
+    assert pool.peek(hs) == 2
+    pool.alloc(1)                    # next LRU out: the middle
+    assert pool.peek(hs) == 1        # chain keeps shrinking from the END
+    pool.check()
+
+
+def test_prefix_cache_survives_failure_path(mv_session):
+    """_fail_all with SHARED reservations: each dying request drops
+    exactly its own holder (decref, not free) — no double-free crash,
+    no phantom live blocks, pool invariants clean after the engine
+    dies."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    engine = srv.register_decoder("lm", lm, slots=4, max_prompt=12,
+                                  max_new=8, kv_block_size=4,
+                                  prefill_token_budget=4)
+    engine.warmup()
+    rng = np.random.default_rng(51)
+    shared = rng.integers(1, cfg.vocab_size, 8).astype(np.int32)
+    # seed the cache, then wedge the step so the NEXT admissions (which
+    # share the cached prefix) die mid-flight holding refcounted blocks
+    srv.submit("lm", {"prompt": shared, "max_new": 2}).result(timeout=120)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected step failure")
+
+    engine._step_fn = boom
+    futs = [srv.submit("lm", {"prompt": np.concatenate(
+        [shared, np.array([7 + i], np.int32)]), "max_new": 4})
+        for i in range(2)]
+    for f in futs:
+        with pytest.raises(RuntimeError):
+            f.result(timeout=60)
+    stats = engine.stats()
+    assert stats["kv_blocks_live"] == 0
+    engine._pool.check()
 
 
 def test_gauge_registry():

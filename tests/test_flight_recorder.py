@@ -19,10 +19,10 @@ from tools.engine_timeline import load_ring, main, render, timeline_report
 
 def _rec(it, ts, busy=1.0, step=0.5, live=1, reserved=0, queue=0,
          queue_age=0.0, prefill=0, decode=1, pool_free=-1, pool_live=-1,
-         version=0, admitted=(), completed=()):
+         pool_shared=-1, version=0, admitted=(), completed=()):
     return (it, ts, busy, step, live, reserved, queue, queue_age,
-            prefill, decode, pool_free, pool_live, version, admitted,
-            completed)
+            prefill, decode, pool_free, pool_live, pool_shared, version,
+            admitted, completed)
 
 
 # -- ring ---------------------------------------------------------------------

@@ -352,6 +352,33 @@ def test_derived_cache_single_compute_under_concurrent_readers():
 
 
 @pytest.mark.slow
+def test_prefix_cache_ab_capacity_and_saved_tokens(mv_session):
+    """The serving_bench prefix-cache A/B on the shared-prefix zipf
+    trace: at EQUAL pool bytes the cached engine must hold strictly
+    more concurrent sequences, save a strictly positive prefill-token
+    count, and keep the one-trace invariant — the acceptance gate's
+    capacity-led face (latency columns stay _info per the 2-CPU
+    noise-floor rule)."""
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import InferenceServer
+    from tools.serving_bench import _prefix_cache_ab
+
+    srv = InferenceServer("t")
+    cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                            n_layers=2, d_ff=256, max_seq=96)
+    row = _prefix_cache_ab(srv, TransformerLM(cfg), quick=True)
+    on, off = row["cache_on"], row["cache_off"]
+    assert on["capacity_seqs"] > off["capacity_seqs"]
+    assert on["prefill_tokens_saved"] > 0
+    assert off["prefill_tokens_saved"] == 0
+    assert on["prefix_hit_rate"] > 0.0
+    assert on["prefill_tokens"] < off["prefill_tokens"]
+    assert on["step_traces"] == off["step_traces"] == 1
+    assert on["prefill_traces"] == off["prefill_traces"] == 1
+
+
+@pytest.mark.slow
 def test_observability_ab_black_box_clean(mv_session):
     """The serving_bench observability A/B: tracing-off vs tail-sampled
     tracing on the same engine — the black box (flight recorder +

@@ -46,8 +46,14 @@ from typing import Dict, List, Tuple
 # watchdog firing during a healthy bench is a bug, not noise.
 # lock_order_violations rides the same rule: the runtime witness
 # recording a cycle during a clean bench is a latent deadlock.
+# prefill_tokens_saved / prefix_hit_rate are the prefix-cache capacity
+# metrics (serving_bench's lm_prefix_cache A/B): prompt tokens the
+# content-addressed block cache kept off the prefill path, and the
+# fraction of looked-up blocks it served — both regress DOWN (a
+# candidate that stops hitting the cache re-prefills shared prefixes).
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
-                  "capacity_seqs")
+                  "capacity_seqs", "prefill_tokens_saved",
+                  "prefix_hit_rate")
 _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
                  "watchdog_trips", "lock_order_violations")
 

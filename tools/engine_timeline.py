@@ -75,6 +75,11 @@ def timeline_report(records: List[Dict[str, Any]], buckets: int = 40,
     report = {"iterations": len(records), **digest,
               "gaps": digest["gaps"][:top_gaps], "buckets": []}
     report.pop("max_idle_gap_ms")
+    # prefix-cache effectiveness over time: the shared-block count rides
+    # every record since the prefix-caching PR (-1 on contiguous engines;
+    # absent in older dumps — both render as "no cache data")
+    report["peak_shared"] = max(
+        (r.get("pool_shared", -1) for r in records), default=-1)
     if not records:
         return report
     t0 = records[0]["ts"] - records[0]["busy_ms"] / 1e3
@@ -85,7 +90,7 @@ def timeline_report(records: List[Dict[str, Any]], buckets: int = 40,
     rows: List[Dict[str, Any]] = [
         {"t_s": round(b * width, 6), "iters": 0, "busy_ms": 0.0,
          "prefill_toks": 0, "decode_toks": 0, "live_sum": 0, "live_max": 0,
-         "queue_max": 0, "queue_age_ms_max": 0.0}
+         "queue_max": 0, "queue_age_ms_max": 0.0, "shared_max": -1}
         for b in range(n_buckets)]
     for r in records:
         b = min(n_buckets - 1, int((r["ts"] - t0) / width))
@@ -99,6 +104,8 @@ def timeline_report(records: List[Dict[str, Any]], buckets: int = 40,
         row["queue_max"] = max(row["queue_max"], r["queue"])
         row["queue_age_ms_max"] = max(row["queue_age_ms_max"],
                                       r["queue_age_ms"])
+        row["shared_max"] = max(row["shared_max"],
+                                r.get("pool_shared", -1))
     for row in rows:
         row["busy_frac"] = min(1.0, row["busy_ms"] / (width * 1e3))
         row["live_mean"] = (row["live_sum"] / row["iters"]
@@ -131,7 +138,9 @@ def render(report: Dict[str, Any], name: str = "") -> str:
         f"{report['decode_tokens']} decode ({report['prefill_share']:.1%} "
         f"prefill share of {total}); {report['steps']} fused steps, "
         f"mean {report['mean_step_ms']:.3f} ms; peak live "
-        f"{report['peak_live']}")
+        f"{report['peak_live']}"
+        + (f"; peak shared KV blocks {report['peak_shared']}"
+           if report.get("peak_shared", -1) >= 0 else ""))
     if report["gaps"]:
         worst = ", ".join(f"{g['gap_ms']:.1f}ms@{g['t_s']:.3f}s"
                           for g in report["gaps"])
@@ -144,17 +153,22 @@ def render(report: Dict[str, Any], name: str = "") -> str:
                      f"(scale: '{_BARS[0]}'=0 .. '{_BARS[-1]}'=1, "
                      f"{report['wall_s'] / len(report['buckets']):.3f}s "
                      f"per column)")
+        has_shared = report.get("peak_shared", -1) >= 0
         lines.append(f"{'t_s':>8} {'iters':>6} {'busy':>6} {'live':>6} "
                      f"{'qmax':>5} {'qage_ms':>8} {'prefill':>8} "
-                     f"{'decode':>8}")
+                     f"{'decode':>8}"
+                     + (f" {'shared':>7}" if has_shared else ""))
         for b in report["buckets"]:
             if not b["iters"]:
                 continue
-            lines.append(
+            line = (
                 f"{b['t_s']:8.3f} {b['iters']:6d} {b['busy_frac']:6.1%} "
                 f"{b['live_mean']:6.2f} {b['queue_max']:5d} "
                 f"{b['queue_age_ms_max']:8.1f} {b['prefill_toks']:8d} "
                 f"{b['decode_toks']:8d}")
+            if has_shared:
+                line += f" {max(0, b.get('shared_max', 0)):7d}"
+            lines.append(line)
     return "\n".join(lines)
 
 

@@ -184,6 +184,14 @@ def request_report(spans, device_events=None):
         if admits and "blocks" in admits[0]["args"]:
             row["blocks"] = admits[0]["args"]["blocks"]
             row["pool_free"] = admits[0]["args"].get("pool_free")
+        # prefix-cache engines annotate the admit span with the blocks
+        # matched and the prefill tokens they saved: a near-zero
+        # admit/prefill column next to a fat "saved" one says this
+        # request's TTFT came from the cache, not from prefill work
+        if admits and "prefix_hit_blocks" in admits[0]["args"]:
+            row["prefix_hit_blocks"] = admits[0]["args"]["prefix_hit_blocks"]
+            row["prefill_tokens_saved"] = admits[0]["args"].get(
+                "prefill_tokens_saved", 0)
         if device:
             w0, w1 = root["ts"], root["ts"] + root["dur"]
             row["device_ms"] = sum(
@@ -199,6 +207,7 @@ def print_request_report(rows, top: int, sort: str,
     rows = sorted(rows, key=lambda r: r.get(key, 0.0), reverse=True)
     has_dev = any("device_ms" in r for r in rows)
     has_blocks = any("blocks" in r for r in rows)
+    has_prefix = any("prefix_hit_blocks" in r for r in rows)
     has_keep = any(r.get("keep") for r in rows)
     breaches = (sum(r["total_ms"] > slo_ms for r in rows) if slo_ms > 0
                 else 0)
@@ -211,6 +220,8 @@ def print_request_report(rows, top: int, sort: str,
            f"{'exec':>8} {'decode':>8} {'iters':>6}")
     if has_blocks:
         hdr += f" {'blocks':>7} {'pfree':>6}"
+    if has_prefix:
+        hdr += f" {'pfxhit':>7} {'saved':>6}"
     if has_dev:
         hdr += f" {'device':>9}"
     if has_keep:
@@ -225,6 +236,9 @@ def print_request_report(rows, top: int, sort: str,
         if has_blocks:
             line += (f" {str(r.get('blocks', '-')):>7} "
                      f"{str(r.get('pool_free', '-')):>6}")
+        if has_prefix:
+            line += (f" {str(r.get('prefix_hit_blocks', '-')):>7} "
+                     f"{str(r.get('prefill_tokens_saved', '-')):>6}")
         if has_dev:
             line += f" {r.get('device_ms', 0.0):9.3f}"
         if has_keep:
