@@ -34,8 +34,16 @@ AST pass flags the hazard patterns statically:
 * **RT106 jit-in-iteration-path** — the one-trace invariant, enforced
   structurally: in any class with a ``_loop`` method (the engine
   shape), no ``jax.jit``/``pjit`` construction may be reachable from
-  ``_loop`` via self-calls. Jits belong to construction
-  (``__init__``) and ``warmup`` only.
+  ``_loop`` via self-calls — neither directly nor through a
+  module-level BUILDER function that (transitively) constructs one
+  (the sharded-program-builder shape:
+  ``models.transformer.make_sharded_decode_programs`` and friends
+  return pre-partitioned pjit handles). Same-module builders are
+  caught per-module; imported ones link in whole-tree runs
+  (:func:`lint_modules` — the ``tools/lint.py`` path), including
+  function-level imports. Jits and builder calls belong
+  to construction (``__init__``) and ``warmup`` only — those are
+  construction-time sites by contract, not per-iteration hazards.
 
 Jit-traced functions are found per module (decorated ``@jax.jit`` /
 ``@partial(jax.jit, ...)``, wrapped ``jax.jit(f)``, jitted lambdas) and
@@ -462,6 +470,7 @@ class RetraceLint:
         self.mod = mod
         self.findings: List[Finding] = []
         self._emitted: Set[Tuple[str, str, str]] = set()
+        self._builders: Optional[Set[str]] = None
         collector = _ScopeCollector(mod)
         collector.visit(mod.tree)
         self.collector = collector
@@ -477,13 +486,16 @@ class RetraceLint:
                                      message=msg))
 
     # -- entry --------------------------------------------------------------
-    def run(self) -> List[Finding]:
+    def run(self, extern_builders: Set[str] = frozenset()) -> List[Finding]:
+        """``extern_builders``: local names imported from OTHER modules
+        that :func:`lint_modules` resolved to jit/pjit builders there —
+        the cross-module half of RT106's builder detection."""
         self._rt101_jit_in_loop()
         jit_targets = self._traced_targets()
         self._rt102_103_taint(jit_targets)
         self._rt104_mutable_static()
         self._rt105_donated_reuse()
-        self._rt106_loop_reachable_jit()
+        self._rt106_loop_reachable_jit(extern_builders)
         return self.findings
 
     # -- RT101 --------------------------------------------------------------
@@ -795,7 +807,54 @@ class RetraceLint:
         return out
 
     # -- RT106 --------------------------------------------------------------
-    def _rt106_loop_reachable_jit(self) -> None:
+    # construction-time methods by contract: the engine shape builds its
+    # (possibly sharded/pjit) programs in __init__ and may rebuild them
+    # in warmup — the decode-mesh builders are sanctioned there, and
+    # ONLY there
+    _RT106_CONSTRUCTION = frozenset({"__init__", "warmup"})
+
+    def _module_jit_builders(self) -> Set[str]:
+        """Module-level functions that (transitively) construct a
+        jit/pjit IN THEIR BODY — the sharded-program-builder shape. A
+        call to one from the iteration path is the same per-call
+        recompile as an inline ``jax.jit``, just hidden behind a
+        helper. Decorators are excluded on purpose: a
+        ``@jax.jit``/``@partial(jax.jit, ...)``-decorated function IS a
+        pre-built cached handle, and calling it is sanctioned dispatch,
+        not construction. Memoized (``lint_modules`` reads it for the
+        cross-module map before ``run()`` needs it again)."""
+        if self._builders is not None:
+            return self._builders
+        builders: Set[str] = set()
+        calls: Dict[str, Set[str]] = {}
+        for name, fn in self.mod.functions.items():
+            # decorators are excluded from BOTH scans: the jit check
+            # (a decorated function is a pre-built handle) and the
+            # closure map (a `@my_jit_factory(...)` decoration must not
+            # make the wrapped function read as calling a builder)
+            deco_nodes = {id(n) for dec in fn.decorator_list
+                          for n in ast.walk(dec)}
+            if any(isinstance(n, ast.Call) and id(n) not in deco_nodes
+                   and _jit_construction(n) for n in ast.walk(fn)):
+                builders.add(name)
+            calls[name] = {c[0] for n in ast.walk(fn)
+                           if isinstance(n, ast.Call)
+                           and id(n) not in deco_nodes
+                           for c in (_chain(n.func),)
+                           if c and len(c) == 1}
+        changed = True
+        while changed:
+            changed = False
+            for name, callees in calls.items():
+                if name not in builders and callees & builders:
+                    builders.add(name)
+                    changed = True
+        self._builders = builders
+        return builders
+
+    def _rt106_loop_reachable_jit(
+            self, extern_builders: Set[str] = frozenset()) -> None:
+        builders = self._module_jit_builders() | set(extern_builders)
         for cls_name, cls_node in self.mod.classes.items():
             methods = {n.name: n for n in cls_node.body
                        if isinstance(n, ast.FunctionDef)}
@@ -813,11 +872,12 @@ class RetraceLint:
                         ch = _chain(node.func)
                         if ch and len(ch) == 2 and ch[0] == "self":
                             queue.append(ch[1])
-            reachable.discard("warmup")
+            reachable -= self._RT106_CONSTRUCTION
             for mname in sorted(reachable):
                 for node in ast.walk(methods[mname]):
-                    if isinstance(node, ast.Call) \
-                            and _jit_construction(node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if _jit_construction(node):
                         self.add_finding(
                             "RT106", "jit-in-iteration-path", node.lineno,
                             f"{cls_name}.{mname}",
@@ -825,6 +885,37 @@ class RetraceLint:
                             "from the engine iteration path (_loop) — "
                             "the one-trace invariant allows jit "
                             "construction only in __init__/warmup")
+                        continue
+                    ch = _chain(node.func)
+                    if ch and len(ch) == 1 and ch[0] in builders:
+                        self.add_finding(
+                            "RT106", "builder-in-iteration-path",
+                            node.lineno, f"{cls_name}.{mname}",
+                            f"{ch[0]}() — a module-level jit/pjit "
+                            "builder — called from the engine iteration "
+                            "path (_loop): every call constructs fresh "
+                            "programs with cold compile caches; build "
+                            "in __init__/warmup and dispatch the "
+                            "handles")
+
+
+def _all_imported_names(mod: Module) -> Dict[str, Tuple[str, str]]:
+    """Local name -> (source module, attr) for EVERY ``from X import Y``
+    in the module — including function-level imports (the engine's
+    construction-time import idiom), which ``parse_module`` does not
+    record. Relative levels resolve against the module's package."""
+    pkg_parts = mod.name.split(".")[:-1]
+    out: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        base = node.module or ""
+        if node.level:
+            up = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+            base = ".".join(up + ([base] if base else []))
+        for alias in node.names:
+            out[alias.asname or alias.name] = (base, alias.name)
+    return out
 
 
 def lint_module(mod: Module) -> List[Finding]:
@@ -832,7 +923,21 @@ def lint_module(mod: Module) -> List[Finding]:
 
 
 def lint_modules(modules: Sequence[Module]) -> List[Finding]:
+    """Whole-tree pass: RT106's builder detection links ACROSS modules
+    here — pass 1 collects every module's jit/pjit-constructing
+    module-level functions, pass 2 lints each module with the imported
+    names that resolve to another module's builders marked as builders
+    too (so `from models.transformer import make_sharded_decode_programs`
+    called from an iteration path fires exactly like a local one)."""
+    linters = [RetraceLint(mod) for mod in modules]
+    builders_by_module = {lt.mod.name: lt._module_jit_builders()
+                          for lt in linters}
     out: List[Finding] = []
-    for mod in modules:
-        out.extend(lint_module(mod))
+    for lt in linters:
+        extern = {
+            local for local, (src, attr)
+            in _all_imported_names(lt.mod).items()
+            if attr in builders_by_module.get(src, ())
+        }
+        out.extend(lt.run(extern_builders=extern))
     return out

@@ -247,6 +247,17 @@ define_int("kv_pool_blocks", 0,
            "slots * ceil((max_prompt + max_new) / kv_block_size). "
            "serving.block_pool.blocks_for_bytes converts a device-bytes "
            "budget into this count")
+define_int("decode_tp", 1,
+           "decode engine: tensor-parallel width of the decode mesh — "
+           "attention heads and the MLP hidden dim shard over a 'tp' axis "
+           "spanning the first decode_tp devices, the paged K/V pools "
+           "shard over the head slice of D, params reshard onto the mesh "
+           "once per snapshot pin (serving.snapshot.shard_for_decode), and "
+           "every per-token program compiles once against matched "
+           "in/out_shardings (no spmd repartition in the hot loop). "
+           "1 = single-device replicated decode (replicate_for_decode, "
+           "the pre-PR 9 path). Needs kv_block_size > 0, "
+           "decode_tp | n_heads and decode_tp | d_ff")
 define_bool("prefix_cache", True,
             "decode engine: content-addressed KV block reuse over the "
             "paged pool — full blocks get a hash-chained identity, "

@@ -606,6 +606,168 @@ def cache_insert_paged(k_pool: jax.Array, v_pool: jax.Array,
     return k_pool, v_pool
 
 
+# -- serving: tensor-parallel sharded decode ----------------------------------
+#
+# PR 2 gated decode to a single-device params replica because feeding the
+# train mesh's ``NamedSharding``s to the tiny per-token programs dragged
+# every call through the spmd partitioner (~10x step wall). That was a
+# workaround with a hard ceiling: params + KV pool had to fit ONE device.
+# The fix is a DECODE-SPECIFIC mesh — Megatron-style tensor parallelism over
+# attention heads and the MLP hidden dim, applied to autoregressive decode
+# the way Pope et al. apply it: weights and KV cache partitioned ONCE, and
+# every serving program jitted ONCE against matched ``in_shardings``/
+# ``out_shardings`` (the pre-partitioned-pjit pattern — when a call's inputs
+# already carry the shardings the program was compiled for, dispatch never
+# goes back through the partitioner). The paged K/V pools
+# ``[L, n_blocks + 1, Bs, D]`` shard over the head slice of ``D``; block
+# tables, token ids, positions and the active mask stay REPLICATED
+# traced-as-data, so the one-compiled-trace-per-engine-config invariant
+# holds per mesh exactly as it does on one device.
+#
+# Head-sharding math: ``D = n_heads * dh`` and the decode kernels reshape
+# ``[..., D] -> [..., n_heads, dh]``. Sharding ``D`` into ``tp`` contiguous
+# slices of ``D/tp = (n_heads/tp) * dh`` therefore lands WHOLE heads on each
+# device: the reshape is a local split (no resharding), attention is
+# embarrassingly parallel over its head axis, and the only collectives are
+# the two Megatron all-reduces per layer (after row-parallel ``w_o`` and
+# ``w_ff2``). Requires ``tp | n_heads`` and ``tp | d_ff``.
+
+DECODE_TP_AXIS = "tp"
+
+
+def validate_decode_tp(cfg: TransformerConfig, tp: int,
+                       name: str = "decode") -> None:
+    """Fail fast on a tp width the head-sharding math cannot honour."""
+    if tp < 1:
+        Log.fatal(f"{name}: decode_tp must be >= 1, got {tp}")
+    if cfg.n_heads % tp != 0:
+        Log.fatal(f"{name}: decode_tp {tp} does not divide n_heads "
+                  f"{cfg.n_heads} — head sharding needs whole heads per "
+                  f"device")
+    if cfg.d_ff % tp != 0:
+        Log.fatal(f"{name}: decode_tp {tp} does not divide d_ff "
+                  f"{cfg.d_ff} — the MLP hidden dim shards over tp")
+
+
+def decode_param_shardings(mesh, tp_axis: str = DECODE_TP_AXIS
+                           ) -> Dict[str, Any]:
+    """Serving-param layout under the decode mesh.
+
+    Column-parallel ``w_q``/``w_k``/``w_v``/``w_ff1`` (output dim — the
+    head slice of ``D`` / the hidden dim — sharded), row-parallel
+    ``w_o``/``w_ff2`` (input dim sharded, partial sums all-reduced by
+    XLA). Embeddings REPLICATE, unlike the train layout's row shard: the
+    decode logits einsum contracts over ``d`` for an ``[S, V]`` output
+    that is already tiny, and a vocab shard would all-gather it every
+    token; positions and norms replicate as always.
+    """
+    ns = lambda *spec: NamedSharding(mesh, P(*spec))
+    return {
+        "embed": ns(),
+        "pos": ns(),
+        "layers": {
+            "ln1_g": ns(),
+            "ln2_g": ns(),
+            "w_q": ns(None, None, tp_axis),
+            "w_k": ns(None, None, tp_axis),
+            "w_v": ns(None, None, tp_axis),
+            "w_o": ns(None, tp_axis, None),
+            "w_ff1": ns(None, None, tp_axis),
+            "w_ff2": ns(None, tp_axis, None),
+        },
+        "ln_f_g": ns(),
+    }
+
+
+def kv_pool_sharding(mesh, tp_axis: str = DECODE_TP_AXIS) -> NamedSharding:
+    """Paged K/V pools ``[L, n_blocks + 1, Bs, D]`` sharded over the
+    head slice of ``D`` — each device holds its heads' cache for every
+    block, so table gathers/scatters stay device-local."""
+    return NamedSharding(mesh, P(None, None, None, tp_axis))
+
+
+def admit_insert_paged(cfg: TransformerConfig, params: Dict[str, Any],
+                       k_pool: jax.Array, v_pool: jax.Array,
+                       block_tables: jax.Array, tokens: jax.Array,
+                       lengths: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused monolithic admission against the paged pool: whole-prompt
+    :func:`prefill`, last-REAL-position first tokens, and the
+    :func:`cache_insert_paged` table scatter — one dispatch. The body
+    the engine jits; shared by the replicated and sharded variants so
+    the two paths cannot drift."""
+    logits, ks, vs = prefill(cfg, params, tokens)
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    first = jnp.argmax(last, axis=-1).astype(tokens.dtype)
+    k_pool, v_pool = cache_insert_paged(k_pool, v_pool, block_tables,
+                                        ks, vs)
+    return first, k_pool, v_pool
+
+
+def cow_block_copy(k_pool: jax.Array, v_pool: jax.Array, src: jax.Array,
+                   dst: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Copy-on-write block duplication across both pools; ``src``/``dst``
+    are traced block ids (one compiled trace serves every copy)."""
+    return (k_pool.at[:, dst].set(k_pool[:, src]),
+            v_pool.at[:, dst].set(v_pool[:, src]))
+
+
+def make_sharded_decode_programs(cfg: TransformerConfig, mesh,
+                                 t_logical: int, donate: bool = False,
+                                 tp_axis: str = DECODE_TP_AXIS
+                                 ) -> Dict[str, Any]:
+    """Pre-partitioned decode-mesh variants of the paged serving programs.
+
+    Returns ``{"step", "chunk", "admit", "cow", "param_shardings",
+    "pool_sharding"}`` — each program jitted exactly once with matched
+    ``in_shardings``/``out_shardings``: params carry
+    :func:`decode_param_shardings`, both pools carry
+    :func:`kv_pool_sharding` (outputs included, so iteration N's pools
+    re-enter iteration N+1 already partitioned), and everything traced
+    as data (block tables, tokens, positions, masks, scalars)
+    replicates. A caller that pins its params via
+    ``serving.snapshot.shard_for_decode`` and round-trips the pools
+    through these programs never re-enters the spmd partitioner after
+    the first compile — the construction-time contract ``DecodeEngine``
+    builds these under (``__init__``/``warmup`` only; RT106).
+    """
+    ps = decode_param_shardings(mesh, tp_axis)
+    pool = kv_pool_sharding(mesh, tp_axis)
+    rep = NamedSharding(mesh, P())
+    T = int(t_logical)
+    kv_donate = (1, 2) if donate else ()
+    step = jax.jit(
+        lambda params, kc, vc, bt, tok, pos, active: decode_step_paged(
+            cfg, params, kc, vc, bt, tok, pos, active, t_logical=T),
+        in_shardings=(ps, pool, pool, rep, rep, rep, rep),
+        out_shardings=(pool, pool, rep, rep),
+        donate_argnums=kv_donate)
+    chunk = jax.jit(
+        lambda params, kc, vc, bt, slot, toks, off, n: prefill_chunk_paged(
+            cfg, params, kc, vc, bt, slot, toks, off, n, t_logical=T),
+        in_shardings=(ps, pool, pool, rep, rep, rep, rep, rep),
+        out_shardings=(pool, pool, rep),
+        donate_argnums=kv_donate)
+    admit = jax.jit(
+        lambda params, kc, vc, bts, toks, lens: admit_insert_paged(
+            cfg, params, kc, vc, bts, toks, lens),
+        in_shardings=(ps, pool, pool, rep, rep, rep),
+        out_shardings=(rep, pool, pool),
+        donate_argnums=kv_donate)
+    # every program wraps in a FRESH lambda (cow included): jit caches
+    # key on the function object, so jitting a shared module-level
+    # function directly would pool every engine's compiled traces on
+    # one handle and break per-engine one-trace accounting
+    cow = jax.jit(
+        lambda kc, vc, src, dst: cow_block_copy(kc, vc, src, dst),
+        in_shardings=(pool, pool, rep, rep),
+        out_shardings=(pool, pool),
+        donate_argnums=(0, 1) if donate else ())
+    return {"step": step, "chunk": chunk, "admit": admit, "cow": cow,
+            "param_shardings": ps, "pool_sharding": pool}
+
+
 def cache_insert(k_cache: jax.Array, v_cache: jax.Array, slots: jax.Array,
                  ks: jax.Array, vs: jax.Array
                  ) -> Tuple[jax.Array, jax.Array]:

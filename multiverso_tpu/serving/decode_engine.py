@@ -42,6 +42,22 @@ cache (the fixed-slot precursor to vLLM's PagedAttention):
   :func:`models.transformer.decode_step` over all S slots, live or
   dead. Shapes never depend on the request mix, so the step compiles
   exactly once per engine config.
+* **tensor-parallel decode mesh** (``-decode_tp``, default 1) — with
+  ``decode_tp > 1`` the engine owns a decode-SPECIFIC mesh over the
+  first ``tp`` devices: attention heads and the MLP hidden dim shard
+  Megatron-style, the paged K/V pools shard over the head slice of
+  ``D``, and every serving program is built ONCE at construction with
+  matched ``in/out_shardings``
+  (:func:`models.transformer.make_sharded_decode_programs`) so the spmd
+  partitioner runs at compile time and never in the hot loop. Snapshot
+  pins reshard the params onto the mesh
+  (:func:`snapshot.shard_for_decode`) instead of replicating them onto
+  one device — models whose params + KV pool exceed a single device's
+  memory serve by splitting over the mesh, which removes the PR 2
+  single-device gate (now just the ``tp=1`` default, not a hard
+  limit). Block tables / tokens / positions stay replicated
+  traced-as-data, so the one-trace invariant holds per mesh, and
+  outputs are token-identical to the replicated path.
 * **chunked, budget-bounded admission** — an arriving prompt prefills
   in fixed-size chunks (:func:`models.transformer.prefill_chunk`, K/V
   written straight into its reserved slot), AT MOST ONE chunk per
@@ -99,9 +115,11 @@ from .. import trace
 from ..dashboard import Dashboard
 from ..log import Log
 from .batcher import OverloadedError, bucket_for, shape_buckets
-from .block_pool import SCRATCH_BLOCK, BlockPool, chain_hashes
+from .block_pool import (SCRATCH_BLOCK, BlockPool, chain_hashes,
+                         kv_bytes_per_block)
 from .flight_recorder import FlightRecorder
-from .snapshot import SnapshotManager, replicate_for_decode
+from .snapshot import (SnapshotManager, replicate_for_decode,
+                       shard_for_decode)
 from .watchdog import EngineWatchdog, WatchdogConfig
 from .workloads import _jit_cache_size
 
@@ -127,6 +145,13 @@ class DecodeEngineConfig:
     # the contiguous-equivalent capacity slots * ceil(T / block_size))
     kv_block_size: Optional[int] = None
     kv_pool_blocks: Optional[int] = None
+    # tensor-parallel decode mesh width (None = the -decode_tp flag).
+    # 1 reduces exactly to the single-device replicated path; > 1 builds
+    # a decode-specific mesh over the first decode_tp devices, shards
+    # attention heads / the MLP hidden dim / the head slice of the paged
+    # K/V pools over a "tp" axis, and compiles every serving program
+    # once against matched in/out_shardings (needs the paged KV cache)
+    decode_tp: Optional[int] = None
     # content-addressed prefix caching over the paged pool (None = the
     # -prefix_cache flag; needs paged KV AND chunked prefill, silently
     # inert otherwise). False is the A/B baseline: same pool bytes,
@@ -248,8 +273,10 @@ class DecodeEngine:
 
     def __init__(self, name: str, lm, config: Optional[DecodeEngineConfig]
                  = None) -> None:
-        from ..models.transformer import (cache_insert, cache_insert_paged,
-                                          decode_step, decode_step_paged,
+        from ..models.transformer import (admit_insert_paged, cache_insert,
+                                          cow_block_copy, decode_step,
+                                          decode_step_paged,
+                                          make_sharded_decode_programs,
                                           prefill, prefill_chunk,
                                           prefill_chunk_paged)
 
@@ -298,9 +325,58 @@ class DecodeEngine:
             self._pool = None
             self._block_tables = None
 
+        # -- decode mesh (tensor-parallel serving) --------------------------
+        # decode_tp=1 (default) reduces exactly to the single-device
+        # replicated path; > 1 builds a decode-SPECIFIC mesh over the
+        # first tp devices — NOT the train mesh, whose NamedShardings
+        # dragged per-token programs through the spmd partitioner
+        # (~10x step wall, the PR 2 gate this replaces)
+        self._tp = int(ec._resolved("decode_tp"))
+        self._decode_mesh = None
+        self._param_shardings = None     # decode-mesh pin target (tp > 1)
+        self._cache_sharding = None      # device_put target for the pools
+        if self._tp < 1:
+            Log.fatal(f"DecodeEngine {name!r}: decode_tp must be >= 1, "
+                      f"got {self._tp}")
+        if self._tp > 1:
+            from ..models.transformer import (DECODE_TP_AXIS,
+                                              validate_decode_tp)
+            from ..topology import make_mesh
+
+            if not self._paged:
+                Log.fatal(f"DecodeEngine {name!r}: decode_tp={self._tp} "
+                          f"needs the paged KV cache (kv_block_size > 0) "
+                          f"— the sharded programs partition the block "
+                          f"pools over the head slice of D")
+            validate_decode_tp(cfg, self._tp, name=f"DecodeEngine {name!r}")
+            ndev = len(jax.devices())
+            if self._tp > ndev:
+                Log.fatal(f"DecodeEngine {name!r}: decode_tp {self._tp} "
+                          f"exceeds the {ndev} visible device(s)")
+            if jax.process_count() > 1:
+                # fail at construction, not at pin time on the loop
+                # thread: in a multi-process mesh jax.devices()[:tp]
+                # includes devices this host cannot address, and the
+                # pin's cross-mesh device_put would raise mid-serving
+                # (replicate_for_decode has the same single-process
+                # scope; multi-process decode meshes are the
+                # serving-fleet item, not this knob)
+                Log.fatal(f"DecodeEngine {name!r}: decode_tp > 1 is "
+                          f"single-process only — a multi-process mesh "
+                          f"cannot address jax.devices()[:{self._tp}] "
+                          f"from one host")
+            self._decode_mesh = make_mesh(
+                (self._tp,), axis_names=(DECODE_TP_AXIS,),
+                devices=jax.devices()[: self._tp])
+
         self._manager = SnapshotManager.of(lm, name=name)
         self._snap = None            # pinned while any slot is live
         self._pinned = None          # the pinned snapshot's DECODE params
+        self._pinned_version: Optional[int] = None
+        # replica/reshard copies actually taken: the pin memoizes on
+        # snapshot VERSION, so a drain/re-pin cycle (or a forced
+        # re-publish) without a version move is copy-free (tested)
+        self.pin_copies = 0
 
         # cache donation is real only where XLA implements input aliasing
         # (TPU/GPU). On CPU a donated arg forces a defensive copy AND a
@@ -309,35 +385,9 @@ class DecodeEngine:
         donate = (1, 2) if jax.default_backend() != "cpu" else ()
 
         # -- jitted programs ------------------------------------------------
-        # fused admission: prefill a group of prompts (padded to a batch
-        # bucket x prompt bucket), gather each last REAL position's logits
-        # -> first tokens, and insert every prompt's K/V into its free
-        # slot, all in ONE dispatch. Placement is traced either way — slot
-        # indices for the contiguous DUS chain, per-row block tables for
-        # the paged scatter — so there is one trace per (batch bucket,
-        # prompt bucket), shared by every slot/block choice.
-        def _first_tokens(logits, lengths, dtype):
-            last = jnp.take_along_axis(
-                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-            return jnp.argmax(last, axis=-1).astype(dtype)
-
-        if self._paged:
-            def _admit_insert(params, kc, vc, bts, toks, lengths):
-                logits, ks, vs = prefill(cfg, params, toks)
-                first = _first_tokens(logits, lengths, toks.dtype)
-                kc, vc = cache_insert_paged(kc, vc, bts, ks, vs)
-                return first, kc, vc
-        else:
-            def _admit_insert(params, kc, vc, slots, toks, lengths):
-                logits, ks, vs = prefill(cfg, params, toks)
-                first = _first_tokens(logits, lengths, toks.dtype)
-                kc, vc = cache_insert(kc, vc, slots, ks, vs)
-                return first, kc, vc
-
-        self._admit_fn = jax.jit(_admit_insert, donate_argnums=donate)
-        # chunked admission: a fixed-size chunk prefilled straight into
-        # the slot cache at a traced (slot, offset, length) — the chunk
-        # shape is the ONLY static, so this is exactly one extra
+        # chunked admission budget: a fixed-size chunk prefilled straight
+        # into the slot cache at a traced (slot, offset, length) — the
+        # chunk shape is the ONLY static, so it is exactly one extra
         # compiled trace per engine config (asserted in the tests)
         self._budget = ec.resolved_prefill_budget()
         if self._budget < 0:
@@ -354,46 +404,99 @@ class DecodeEngine:
         self._prefix = (self._paged and self._budget > 0
                         and bool(ec._resolved("prefix_cache")))
         self._hash_seed = b""        # pinned-version scope for the chain
-        if self._prefix:
-            # copy-on-write: duplicate one block (both pools) before a
-            # write lands in a shared one. src/dst are traced scalars —
-            # ONE compiled trace per engine config, dispatched host-side
-            # at admission before the table ever reaches the fused step.
-            self._cow_fn = jax.jit(
-                lambda kc, vc, src, dst: (
-                    kc.at[:, dst].set(kc[:, src]),
-                    vc.at[:, dst].set(vc[:, src])),
-                donate_argnums=(0, 1) if donate else ())
+
+        # fused admission: prefill a group of prompts (padded to a batch
+        # bucket x prompt bucket), gather each last REAL position's logits
+        # -> first tokens, and insert every prompt's K/V into its free
+        # slot, all in ONE dispatch. Placement is traced either way — slot
+        # indices for the contiguous DUS chain, per-row block tables for
+        # the paged scatter — so there is one trace per (batch bucket,
+        # prompt bucket), shared by every slot/block choice.
+        def _first_tokens(logits, lengths, dtype):
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            return jnp.argmax(last, axis=-1).astype(dtype)
+
+        if self._tp > 1:
+            # decode-mesh programs, pre-partitioned: every program is
+            # jitted ONCE here (construction time — the RT106 contract)
+            # with matched in/out_shardings, so the partitioner runs at
+            # compile and never again; params arrive resharded by the
+            # pin (shard_for_decode) and the pools round-trip with their
+            # sharding intact. Copy-on-write rides the same mesh: the
+            # one write that can touch a shared block stays one site.
+            progs = make_sharded_decode_programs(
+                cfg, self._decode_mesh, T, donate=bool(donate))
+            self._param_shardings = progs["param_shardings"]
+            self._cache_sharding = progs["pool_sharding"]
+            self._admit_fn = progs["admit"]
+            self._chunk_fn = progs["chunk"]
+            self._step_fn = progs["step"]
+            self._cow_fn = progs["cow"] if self._prefix else None
         else:
-            self._cow_fn = None
-        if self._paged:
-            # block tables ride every call as DATA ([S, M] int32, fixed
-            # shape): which blocks a slot owns never touches an aval, so
-            # the one-trace-per-config invariant survives paging. The
-            # gathered views are sliced to T inside the kernels, keeping
-            # the attention operand (and outputs) bit-identical to the
-            # contiguous layout's.
-            self._chunk_fn = jax.jit(
-                lambda params, kc, vc, bt, slot, toks, off, n:
-                prefill_chunk_paged(cfg, params, kc, vc, bt, slot, toks,
-                                    off, n, t_logical=T),
-                donate_argnums=donate)
-            self._step_fn = jax.jit(
-                lambda params, kc, vc, bt, tok, pos, active:
-                decode_step_paged(cfg, params, kc, vc, bt, tok, pos,
-                                  active, t_logical=T),
-                donate_argnums=donate)
-        else:
-            self._chunk_fn = jax.jit(
-                lambda params, kc, vc, slot, toks, off, n: prefill_chunk(
-                    cfg, params, kc, vc, slot, toks, off, n),
-                donate_argnums=donate)
-            # THE fused step: all shapes fixed by the engine config ->
-            # exactly one compiled trace no matter which slots are live
-            self._step_fn = jax.jit(
-                lambda params, kc, vc, tok, pos, active: decode_step(
-                    cfg, params, kc, vc, tok, pos, active),
-                donate_argnums=donate)
+            if self._paged:
+                # the ONE paged admission body (prefill + last-real-
+                # position gather + table-scatter insert) lives in
+                # transformer.admit_insert_paged — the sharded variant
+                # jits the same function, so the two paths cannot drift
+                def _admit_insert(params, kc, vc, bts, toks, lengths):
+                    return admit_insert_paged(cfg, params, kc, vc, bts,
+                                              toks, lengths)
+            else:
+                def _admit_insert(params, kc, vc, slots, toks, lengths):
+                    logits, ks, vs = prefill(cfg, params, toks)
+                    first = _first_tokens(logits, lengths, toks.dtype)
+                    kc, vc = cache_insert(kc, vc, slots, ks, vs)
+                    return first, kc, vc
+
+            self._admit_fn = jax.jit(_admit_insert, donate_argnums=donate)
+            if self._prefix:
+                # copy-on-write: duplicate one block (both pools) before
+                # a write lands in a shared one. src/dst are traced
+                # scalars — ONE compiled trace per engine config,
+                # dispatched host-side at admission before the table
+                # ever reaches the fused step.
+                # the lambda is load-bearing: jitting the shared
+                # module-level function directly would pool every
+                # engine's compile cache on one handle (jit caches key
+                # on the function object), breaking the per-engine
+                # one-trace accounting
+                self._cow_fn = jax.jit(
+                    lambda kc, vc, src, dst: cow_block_copy(
+                        kc, vc, src, dst),
+                    donate_argnums=(0, 1) if donate else ())
+            else:
+                self._cow_fn = None
+            if self._paged:
+                # block tables ride every call as DATA ([S, M] int32,
+                # fixed shape): which blocks a slot owns never touches an
+                # aval, so the one-trace-per-config invariant survives
+                # paging. The gathered views are sliced to T inside the
+                # kernels, keeping the attention operand (and outputs)
+                # bit-identical to the contiguous layout's.
+                self._chunk_fn = jax.jit(
+                    lambda params, kc, vc, bt, slot, toks, off, n:
+                    prefill_chunk_paged(cfg, params, kc, vc, bt, slot,
+                                        toks, off, n, t_logical=T),
+                    donate_argnums=donate)
+                self._step_fn = jax.jit(
+                    lambda params, kc, vc, bt, tok, pos, active:
+                    decode_step_paged(cfg, params, kc, vc, bt, tok, pos,
+                                      active, t_logical=T),
+                    donate_argnums=donate)
+            else:
+                self._chunk_fn = jax.jit(
+                    lambda params, kc, vc, slot, toks, off, n:
+                    prefill_chunk(
+                        cfg, params, kc, vc, slot, toks, off, n),
+                    donate_argnums=donate)
+                # THE fused step: all shapes fixed by the engine config
+                # -> exactly one compiled trace no matter which slots
+                # are live
+                self._step_fn = jax.jit(
+                    lambda params, kc, vc, tok, pos, active: decode_step(
+                        cfg, params, kc, vc, tok, pos, active),
+                    donate_argnums=donate)
 
         # -- device state (owned by the loop thread after start) -------------
         # committed placement from birth: warmup scratch caches use the
@@ -403,10 +506,18 @@ class DecodeEngine:
             cache_shape = (L, self._pool.capacity + 1, self._block_size, D)
         else:
             cache_shape = (L, S, T, D)
+        # mesh-aware placement: sharded engines commit the pools to the
+        # decode mesh's pool sharding (matching the programs'
+        # in_shardings — a plain devices()[0] put would be rejected as
+        # an incompatible committed placement); replicated engines keep
+        # the single-device put
+        self._cache_target = (self._cache_sharding
+                              if self._cache_sharding is not None
+                              else jax.devices()[0])
         self._k_cache = jax.device_put(
-            jnp.zeros(cache_shape, cfg.dtype), jax.devices()[0])
+            jnp.zeros(cache_shape, cfg.dtype), self._cache_target)
         self._v_cache = jax.device_put(
-            jnp.zeros(cache_shape, cfg.dtype), jax.devices()[0])
+            jnp.zeros(cache_shape, cfg.dtype), self._cache_target)
         # -- host state -----------------------------------------------------
         self._slot_req: List[Optional[_Request]] = [None] * S
         # explicit free-slot set, maintained at admit/complete (the loop
@@ -466,6 +577,16 @@ class DecodeEngine:
         if bool(ec._resolved("flight_recorder")):
             self.recorder = FlightRecorder(
                 int(ec._resolved("flight_recorder_capacity")), name=name)
+            # static mesh facts ride the black box: a post-mortem dump
+            # must say which tensor-parallel config produced its records
+            self.recorder.meta.update(
+                decode_tp=self._tp,
+                mesh_devices=(self._decode_mesh.size
+                              if self._decode_mesh is not None else 1))
+        # admit-span mesh annotation (trace_summary ships the column):
+        # only sharded engines carry it, so replicated reports stay flat
+        self._mesh_attrs = ({"decode_tp": self._tp} if self._tp > 1
+                            else {})
         # per-iteration scratch the recorder drains (reused, not realloc'd)
         self._it_admitted: List[int] = []
         self._it_completed: List[int] = []
@@ -766,13 +887,29 @@ class DecodeEngine:
         elif not self._active.any() and self._pf is None:
             snap = self._manager.ensure_fresh(self.config.max_staleness_s)
         if self._snap is not snap or self._pinned is None:
-            # one replica copy per PIN (snapshot.replicate_for_decode:
-            # ~10x per-step wall otherwise; falls back to the sharded
-            # snapshot multi-process), amortized over the whole
-            # generation stream the pin serves
-            with trace.span("snapshot.pin", engine=self.name,
-                            version=snap.version):
-                self._pinned = replicate_for_decode(snap.value)
+            # the decode copy memoizes on snapshot VERSION: a drain/
+            # re-pin cycle (or a forced re-publish) without an
+            # intervening version move reuses the existing replica —
+            # the full-tree copy only happens when training actually
+            # produced new params
+            if self._pinned is None or snap.version != self._pinned_version:
+                # one copy per pinned VERSION, amortized over the whole
+                # generation stream the pin serves: tp=1 replicates onto
+                # one device (snapshot.replicate_for_decode — ~10x
+                # per-step wall through the partitioner otherwise,
+                # sharded fallback multi-process); tp>1 reshards onto
+                # the decode mesh (snapshot.shard_for_decode), matching
+                # the pre-partitioned programs' in_shardings exactly
+                with trace.span("snapshot.pin", engine=self.name,
+                                version=snap.version):
+                    if self._tp > 1:
+                        self._pinned = shard_for_decode(
+                            snap.value, self._decode_mesh,
+                            self._param_shardings)
+                    else:
+                        self._pinned = replicate_for_decode(snap.value)
+                self._pinned_version = snap.version
+                self.pin_copies += 1
             self._snap = snap
             if self._prefix:
                 # the hash chain is scoped to the params the K/V was
@@ -879,7 +1016,7 @@ class DecodeEngine:
                     budget=self._budget, snapshot_version=req.version,
                     blocks=len(req.blocks), pool_free=self._pool.n_free,
                     prefix_hit_blocks=req.n_hit,
-                    prefill_tokens_saved=req.saved)
+                    prefill_tokens_saved=req.saved, **self._mesh_attrs)
             req.ttft_pending = True
             self._slot_req[slot] = req
             self._tok[slot] = int(req.prompt[-1])
@@ -966,6 +1103,7 @@ class DecodeEngine:
             if self._prefix:
                 extra["prefix_hit_blocks"] = req.n_hit
                 extra["prefill_tokens_saved"] = req.saved
+            extra.update(self._mesh_attrs)
             trace.record_span(
                 "decode.admit", req.ctx, req.t_admit, now, slot=req.slot,
                 prompt_len=len(req.prompt), chunks=req.pf_chunks,
@@ -1058,6 +1196,7 @@ class DecodeEngine:
                     extra = ({"blocks": len(req.blocks),
                               "pool_free": self._pool.n_free}
                              if self._paged else {})
+                    extra.update(self._mesh_attrs)
                     trace.record_span(
                         "decode.admit", req.ctx, t_admit, now, slot=slot,
                         prompt_len=len(req.prompt), prompt_bucket=pb,
@@ -1219,8 +1358,14 @@ class DecodeEngine:
         dtype = self._k_cache.dtype
 
         def scratch():
-            return (jax.device_put(jnp.zeros(shape, dtype), jax.devices()[0]),
-                    jax.device_put(jnp.zeros(shape, dtype), jax.devices()[0]))
+            # the live caches' placement (devices()[0], or the decode
+            # mesh's pool sharding when tp > 1): warmup traces only ARE
+            # the serving traces if their operands carry the same
+            # committed sharding
+            return (jax.device_put(jnp.zeros(shape, dtype),
+                                   self._cache_target),
+                    jax.device_put(jnp.zeros(shape, dtype),
+                                   self._cache_target))
 
         if self._paged:
             # all-scratch block tables: warmup writes park in the
@@ -1302,6 +1447,15 @@ class DecodeEngine:
         # count it allowed) belongs next to slot occupancy
         pool = ({"kv_block_size": self._block_size,
                  "kv_pool_blocks": self._pool.capacity,
+                 # mesh-aware capacity: the pools (scratch included)
+                 # shard over the head slice of D, so each device holds
+                 # 1/tp of the KV bytes — the number that decides
+                 # whether a model + pool fits the hardware
+                 "kv_bytes_per_device": (
+                     (self._pool.capacity + 1) * kv_bytes_per_block(
+                         self._model_cfg.n_layers, self._model_cfg.d_model,
+                         self._block_size, np.dtype(self._model_cfg.dtype))
+                     // self._tp),
                  "kv_blocks_free": self._pool.n_free,
                  "kv_blocks_live": self._pool.n_live,
                  "kv_blocks_cached": self._pool.n_cached,
@@ -1325,6 +1479,14 @@ class DecodeEngine:
         health = self.health()
         return {
             **pool,
+            "decode_tp": self._tp,
+            "mesh_devices": (self._decode_mesh.size
+                             if self._decode_mesh is not None else 1),
+            # the zero-baseline hot-loop gate: any repartition/retrace
+            # of the fused step past warmup shows up here (the PR 2
+            # ~10x partitioner drag, now asserted gone)
+            "decode_step_retraces": max(0, self.step_cache_size() - 1),
+            "pin_copies": self.pin_copies,
             "iters_total": health["iters_total"],
             "last_iter_age_s": health["last_iter_age_s"],
             "live_seqs": health["live_seqs"],

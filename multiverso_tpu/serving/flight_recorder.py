@@ -129,6 +129,10 @@ class FlightRecorder:
                              f"got {capacity}")
         self.name = name
         self.capacity = int(capacity)
+        # static engine facts (decode_tp, mesh_devices, ...) the owner
+        # attaches once; ride every summary() and the JSONL meta line so
+        # a post-mortem dump identifies its mesh config
+        self.meta: Dict[str, Any] = {}
         self._buf: List[Optional[tuple]] = [None] * self.capacity
         self._pos = 0
         self._n = 0
@@ -175,6 +179,7 @@ class FlightRecorder:
             "name": self.name, "iterations": self.total,
             "retained": len(recs), "capacity": self.capacity,
             "wrapped": self.total > self.capacity,
+            **self.meta,
         }
         digest = window_digest(recs)
         # the per-bubble list is timeline_report's concern; the digest
@@ -197,6 +202,7 @@ class FlightRecorder:
                 "anchor_epoch_s": self._anchor_wall,
                 "anchor_mono_s": self._anchor_mono,
                 "fields": list(FIELDS),
+                **self.meta,
             }}) + "\n")
             for rec in recs:
                 f.write(json.dumps(rec) + "\n")

@@ -117,6 +117,7 @@ class InferenceServer:
                          prefill_token_budget: Optional[int] = None,
                          kv_block_size: Optional[int] = None,
                          kv_pool_blocks: Optional[int] = None,
+                         decode_tp: Optional[int] = None,
                          prefix_cache: Optional[bool] = None,
                          watchdog: Optional[bool] = None,
                          debug_dump_dir: Optional[str] = None,
@@ -140,7 +141,14 @@ class InferenceServer:
         capacity rather than slot geometry bounds concurrency, and a
         submit whose ``prompt + max_new`` can never fit the pool sheds
         with :class:`OverloadedError` (docs/SERVING.md "Paged KV
-        cache"). ``prefix_cache`` (None = the ``-prefix_cache`` flag,
+        cache"). ``decode_tp`` (None = the ``-decode_tp`` flag, default
+        1) sets the tensor-parallel width of the decode mesh: heads/MLP
+        shards + head-sharded K/V pools over the first ``decode_tp``
+        devices, params resharded once per snapshot pin, per-token
+        programs compiled once against matched shardings — the knob
+        that serves models bigger than one device (docs/SERVING.md
+        "Sharded decode"; 1 = the replicated single-device path).
+        ``prefix_cache`` (None = the ``-prefix_cache`` flag,
         default on) turns on content-addressed block reuse over that
         pool: prompts sharing a prefix prefill it once and splice the
         cached blocks refcounted/copy-on-write (docs/SERVING.md
@@ -160,7 +168,7 @@ class InferenceServer:
             max_staleness_s=max_staleness_s, prompt_buckets=prompt_buckets,
             prefill_token_budget=prefill_token_budget,
             kv_block_size=kv_block_size, kv_pool_blocks=kv_pool_blocks,
-            prefix_cache=prefix_cache,
+            decode_tp=decode_tp, prefix_cache=prefix_cache,
             watchdog=watchdog, debug_dump_dir=debug_dump_dir,
             slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
         with self._lock:

@@ -37,6 +37,11 @@ def test_metric_direction_rules():
     # budget regress DOWN, bytes per held sequence regress UP
     assert metric_direction("capacity_seqs") == 1
     assert metric_direction("kv_bytes_per_seq") == -1
+    # sharded-decode metrics: per-device KV bytes regress UP (tensor
+    # parallelism exists to shrink them); step retraces ride the
+    # zero-baseline rule — one compiled fused step per engine config
+    assert metric_direction("kv_bytes_per_device") == -1
+    assert metric_direction("decode_step_retraces") == -1
     # the _info suffix overrides every pattern rule: measured-but-noisy
     # columns ride the archive without flapping the gate
     assert metric_direction("tokens_per_s_info") == 0
@@ -66,6 +71,23 @@ def test_watchdog_trips_hard_gate():
     assert [r["metric"] for r in regressions] == [
         "observability.watchdog_trips"]
     assert compare(base, base)[0] == []           # clean stays clean
+
+
+def test_sharded_decode_metrics_gate():
+    """The lm_sharded_decode surface: a retrace of the fused step on a
+    zero-retrace baseline regresses hard (the PR 2 partitioner drag
+    must stay out of the hot loop), and per-device KV bytes growing
+    past tolerance regresses like any capacity metric."""
+    base = _line(lm_sharded_decode={"sharded": {
+        "decode_step_retraces": 0.0, "kv_bytes_per_device": 25600.0,
+        "tokens_per_s_info": 900.0}})
+    bad = _line(lm_sharded_decode={"sharded": {
+        "decode_step_retraces": 3.0, "kv_bytes_per_device": 51200.0,
+        "tokens_per_s_info": 400.0}})
+    names = {r["metric"] for r in compare(base, bad)[0]}
+    assert names == {"lm_sharded_decode.sharded.decode_step_retraces",
+                     "lm_sharded_decode.sharded.kv_bytes_per_device"}
+    assert compare(base, base)[0] == []
 
 
 def test_capacity_metrics_gate_both_directions():
@@ -159,3 +181,20 @@ def test_cli_exit_codes(tmp_path):
     bad = tmp_path / "bad.json"
     bad.write_text("not json at all\n")
     assert main([str(base_f), str(bad)]) == 2                # malformed
+
+
+def test_dropped_gated_metrics_surfaced():
+    """A gated metric present in the baseline but absent from the
+    candidate (e.g. the sharded A/B skipping on a 1-device run) is
+    reported as lost coverage — the intersection-only compare must not
+    make a disappearing gate invisible."""
+    from tools.bench_compare import dropped_gated_metrics
+
+    base = _line(lm_sharded_decode={"sharded": {
+        "decode_step_retraces": 0.0, "kv_bytes_per_device": 25600.0,
+        "pin_copies_info": 1.0}})
+    new = _line(lm_sharded_decode={"skipped": "needs >= 2 devices"})
+    dropped = dropped_gated_metrics(base, new)
+    assert dropped == ["lm_sharded_decode.sharded.decode_step_retraces",
+                       "lm_sharded_decode.sharded.kv_bytes_per_device"]
+    assert dropped_gated_metrics(base, base) == []

@@ -190,6 +190,38 @@ def test_engine_pins_snapshot_per_generation(mv_session):
     assert engine.stats()["snapshot_publishes"] >= 1
 
 
+def test_pin_replica_memoized_on_snapshot_version(mv_session):
+    """The pin's full-tree decode copy memoizes on snapshot VERSION: a
+    drain/re-pin cycle — even through a FORCED re-publish that mints a
+    fresh Snapshot object of the same version — is copy-free, and the
+    copy happens again only when training actually moved the params."""
+    from multiverso_tpu.models.transformer import TransformerLM
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = _small_cfg()
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    engine = srv.register_decoder("lm", lm, slots=2, max_prompt=6,
+                                  max_new=4)
+    engine.warmup()                          # first pin: one copy
+    assert engine.pin_copies == 1
+    prompt = np.array([3, 5, 7], np.int32)
+    srv.submit("lm", prompt).result(timeout=120)
+    assert engine.pin_copies == 1            # same snapshot object
+    # forced re-publish with NO intervening train step: new Snapshot
+    # object, same version — the drain/re-pin cycle must not re-copy
+    engine._manager.publish()
+    srv.submit("lm", prompt).result(timeout=120)
+    assert engine.pin_copies == 1
+    # training moves the version: once the staleness bound passes, the
+    # next drained admission re-pins and pays exactly one more copy
+    lm.train_batch(np.ones((2, 12), np.int32))
+    time.sleep(engine.config.max_staleness_s + 0.05)
+    reply = srv.submit("lm", prompt).result(timeout=120)
+    assert engine.pin_copies == 2
+    assert reply["snapshot_version"] == lm.version
+
+
 def test_engine_sheds_past_queue_cap(mv_session):
     from multiverso_tpu.models.transformer import TransformerLM
     from multiverso_tpu.serving import InferenceServer, OverloadedError

@@ -53,6 +53,7 @@ EXPECTED_TP = {
     ("RT104", "rt104_unhashable_static"),
     ("RT105", "rt105_donated_reuse"),
     ("RT106", "Rt106Engine._iterate"),
+    ("RT106", "Rt106ShardedEngine._iterate"),    # builder on the hot path
 }
 
 
@@ -174,3 +175,173 @@ def test_donated_reuse_across_statements_still_caught():
             return y
     """)
     assert [f for f in findings if f.rule == "RT105"]
+
+
+def test_rt106_builder_call_on_iteration_path_fires():
+    """A module-level function that (transitively) constructs a pjit is
+    a program BUILDER: calling it from a method reachable from _loop is
+    the per-iteration recompile RT106 exists to catch, even though no
+    jax.jit literal appears in the method."""
+    findings = _lint_snippet("""
+        import jax
+
+        def _make_step(fn, specs):
+            return jax.jit(fn, in_shardings=specs, out_shardings=specs)
+
+        def _make_programs(fn, specs):
+            return _make_step(fn, specs), _make_step(fn, specs)
+
+        class Engine:
+            def _loop(self):
+                while True:
+                    self._iterate()
+
+            def _iterate(self):
+                step, _ = _make_programs(self._fn, self._specs)
+                return step(1.0)
+    """)
+    hits = [f for f in findings if f.rule == "RT106"]
+    assert hits and hits[0].qualname == "Engine._iterate", findings
+
+
+def test_rt106_builder_in_init_and_warmup_is_construction_time():
+    """The decode-mesh contract: __init__/warmup building sharded
+    programs through a builder (and the iteration path only DISPATCHING
+    the handles) is clean — construction-time sites, not hazards."""
+    findings = _lint_snippet("""
+        import jax
+
+        def _make_step(fn, specs):
+            return jax.jit(fn, in_shardings=specs, out_shardings=specs)
+
+        class Engine:
+            def __init__(self, fn, specs):
+                self._specs = specs
+                self._step = _make_step(fn, specs)
+
+            def warmup(self):
+                self._step = _make_step(lambda x: x, self._specs)
+                return self._step(0.0)
+
+            def _loop(self):
+                while True:
+                    self._iterate()
+
+            def _iterate(self):
+                return self._step(1.0)
+    """)
+    assert not [f for f in findings if f.rule == "RT106"], findings
+
+
+def _snippet_module(name, src):
+    import ast
+
+    from multiverso_tpu.analysis.common import Module
+
+    tree = ast.parse(textwrap.dedent(src))
+    mod = Module(path=name.replace(".", "/") + ".py", name=name,
+                 tree=tree, source=src)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            mod.classes[node.name] = node
+        elif isinstance(node, ast.FunctionDef):
+            mod.functions[node.name] = node
+    return mod
+
+
+def test_rt106_cross_module_builder_links_in_whole_tree_runs():
+    """lint_modules links builders ACROSS modules: an engine importing
+    make_sharded_decode_programs-style builders from another module —
+    even via a function-level relative import, the engine idiom — and
+    calling one from the iteration path fires RT106; the same import
+    used only in __init__ stays clean."""
+    builders = _snippet_module("pkg.models.transformer", """
+        import jax
+
+        def make_sharded_decode_programs(fn, specs):
+            return jax.jit(fn, in_shardings=specs, out_shardings=specs)
+    """)
+    hot = _snippet_module("pkg.serving.engine", """
+        class Engine:
+            def _loop(self):
+                while True:
+                    self._iterate()
+
+            def _iterate(self):
+                from ..models.transformer import make_sharded_decode_programs
+
+                step = make_sharded_decode_programs(self._fn, self._specs)
+                return step(1.0)
+    """)
+    findings = retrace_lint.lint_modules([builders, hot])
+    hits = [f for f in findings if f.rule == "RT106"]
+    assert hits and hits[0].qualname == "Engine._iterate", findings
+
+    clean = _snippet_module("pkg.serving.engine2", """
+        class Engine:
+            def __init__(self, fn, specs):
+                from ..models.transformer import make_sharded_decode_programs
+
+                self._step = make_sharded_decode_programs(fn, specs)
+
+            def _loop(self):
+                while True:
+                    self._iterate()
+
+            def _iterate(self):
+                return self._step(1.0)
+    """)
+    findings = retrace_lint.lint_modules([builders, clean])
+    assert not [f for f in findings if f.rule == "RT106"], findings
+
+
+def test_rt106_decorated_jit_handle_dispatch_is_not_a_builder():
+    """A @partial(jax.jit, ...)-decorated module function is a PRE-BUILT
+    cached handle — calling it from the iteration path is sanctioned
+    dispatch, not per-call construction (the decorator must not make
+    the function read as a builder)."""
+    findings = _lint_snippet("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(0,))
+        def scaled(n, x):
+            return x * n
+
+        class Engine:
+            def _loop(self):
+                while True:
+                    self._iterate()
+
+            def _iterate(self):
+                return scaled(2, self._x)
+    """)
+    assert not [f for f in findings if f.rule == "RT106"], findings
+
+
+def test_rt106_jit_factory_decorated_function_is_dispatch():
+    """A function decorated by a custom jit-wrapping decorator FACTORY
+    (the `@my_jit(...)` shape) is a pre-built handle too: the decorator
+    call must not leak into the builder closure map and flag its
+    dispatch from the iteration path."""
+    findings = _lint_snippet("""
+        import jax
+
+        def _make_step(n):
+            def deco(fn):
+                return jax.jit(fn, static_argnums=(n,))
+            return deco
+
+        @_make_step(0)
+        def scaled(n, x):
+            return x * n
+
+        class Engine:
+            def _loop(self):
+                while True:
+                    self._iterate()
+
+            def _iterate(self):
+                return scaled(2, self._x)
+    """)
+    assert not [f for f in findings if f.rule == "RT106"], findings

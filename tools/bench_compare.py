@@ -51,10 +51,18 @@ from typing import Dict, List, Tuple
 # content-addressed block cache kept off the prefill path, and the
 # fraction of looked-up blocks it served — both regress DOWN (a
 # candidate that stops hitting the cache re-prefills shared prefixes).
+# kv_bytes_per_device is the sharded-decode capacity metric
+# (lm_sharded_decode A/B): KV bytes each decode-mesh device must hold —
+# tensor parallelism exists to push it DOWN, so it regresses UP.
+# decode_step_retraces rides the zero-baseline rule like
+# watchdog_trips: the fused step compiles ONCE per engine config, and
+# any retrace on the candidate side is the PR 2 ~10x partitioner drag
+# sneaking back into the hot loop — a bug, not noise.
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
                   "capacity_seqs", "prefill_tokens_saved",
                   "prefix_hit_rate")
 _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
+                 "kv_bytes_per_device", "decode_step_retraces",
                  "watchdog_trips", "lock_order_violations")
 
 
@@ -90,6 +98,20 @@ def flatten_workloads(line: dict) -> Dict[str, float]:
     out: Dict[str, float] = {}
     _flatten("", line.get("workloads", {}), out)
     return out
+
+
+def dropped_gated_metrics(base: dict, new: dict) -> List[str]:
+    """Gated-direction metric paths present in ``base`` but ABSENT from
+    ``new`` — lost coverage the intersection-only compare would
+    otherwise hide (e.g. the ``lm_sharded_decode`` A/B archiving its
+    skip marker on a 1-device candidate run while the baseline ran
+    under ``--devices``: its zero-baseline ``decode_step_retraces``
+    gate would silently vanish). Surfaced as a loud warning, not an
+    exit-code flip: metrics legitimately evolve between rounds, but a
+    gate disappearing must never be invisible."""
+    b, n = flatten_workloads(base), flatten_workloads(new)
+    return sorted(path for path in set(b) - set(n)
+                  if metric_direction(path.rsplit(".", 1)[-1]) != 0)
 
 
 def compare(base: dict, new: dict, tolerance: float = 0.25,
@@ -175,6 +197,11 @@ def main(argv=None) -> int:
     if not rows:
         print("bench_compare: no comparable metrics", file=sys.stderr)
         return 2
+    dropped = dropped_gated_metrics(base, new)
+    if dropped:
+        print(f"WARNING: {len(dropped)} gated metric(s) in the baseline "
+              f"are ABSENT from the candidate (coverage lost, not "
+              f"compared): {', '.join(dropped)}", file=sys.stderr)
     print(f"{len(rows)} metrics compared, {len(regressions)} regressed "
           f"(tolerance {args.tolerance:.0%})")
     print(f"{'metric':<52} {'base':>10} {'new':>10} {'worse':>8}")

@@ -43,6 +43,16 @@ tokens (``prefill_tokens_saved``, ``prefix_hit_rate`` — all three ride
 the bench_compare gate); saturated tok/s and TTFT columns archive as
 gate-exempt ``_info`` per the 2-CPU noise-floor rule.
 
+A fifth decode A/B (``lm_sharded_decode``) prices the DECODE MESH:
+tp=2 tensor-parallel decode (heads/MLP/K-V pools sharded, params
+resharded once per pin, programs compiled once against matched
+shardings) vs the tp=1 single-device replica, same model and pool
+bytes. Gated: ``kv_bytes_per_device`` (down) and
+``decode_step_retraces`` (zero-baseline — the PR 2 ~10x partitioner
+drag must stay out of the hot loop); tok/s and step wall archive as
+``_info``. Runs only when >= 2 devices are visible (``--devices N`` /
+the multichip dryrun harness) and archives a skip marker otherwise.
+
 The black box stays ON for the whole bench: the per-engine flight
 recorder (always-on iteration ring), the stall/leak watchdog (a clean
 bench must report ZERO trips — ``observability.watchdog_trips`` rides
@@ -507,6 +517,120 @@ def _prefix_cache_ab(server, lm_model, quick: bool) -> dict:
     }
 
 
+def _sharded_decode_ab(server, quick: bool) -> dict:
+    """Sharded-decode A/B: tp=2 vs tp=1 at EQUAL model + pool bytes.
+
+    Same model, same paged pool, same arrival trace — the only
+    difference is the decode mesh: the sharded side partitions heads/
+    MLP/K-V pools over 2 devices (params resharded once per pin,
+    programs compiled once against matched shardings), the replicated
+    side is the classic single-device pin. The gated columns are
+    ``kv_bytes_per_device`` (down — tensor parallelism exists to shrink
+    what ONE device must hold; with the model row alongside, the line
+    records when params + pool stop fitting a single device and tp>1 is
+    the only way to serve) and ``decode_step_retraces`` (zero-baseline:
+    any repartition/retrace of the fused step past warmup is the PR 2
+    ~10x partitioner drag back in the hot loop). Wall-clock tok/s and
+    step wall are ``_info`` per the 2-CPU noise rule — on a container
+    whose virtual devices timeshare 2 cores, tp=2 pays real collective
+    overhead for no real parallel compute, so the honest headline here
+    is capacity, not speed.
+
+    Needs >= 2 devices: run under ``--devices N`` (the scaling_bench
+    pattern) or the multichip dryrun harness; the default 1-device
+    bench archives a skip marker instead (no gated metrics emitted).
+    """
+    import jax
+
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import kv_bytes_per_block
+
+    if jax.device_count() < 2:
+        return {"skipped": "needs >= 2 devices — run with --devices N "
+                           "or under the multichip dryrun harness"}
+    tp = 2
+    max_prompt, cap, block_size = 16, 48, 8
+    T = max_prompt + cap
+    sd_cfg = TransformerConfig(vocab_size=256, d_model=256, n_heads=4,
+                               n_layers=2, d_ff=512, max_seq=T)
+    lm = TransformerLM(sd_cfg)
+    pool_blocks = 8 * (T // block_size)
+    kv_bytes = (pool_blocks + 1) * kv_bytes_per_block(
+        sd_cfg.n_layers, sd_cfg.d_model, block_size)
+
+    def _nbytes(a):
+        return int(np.prod(a.shape)) * a.dtype.itemsize
+
+    params_bytes = sum(_nbytes(a) for a in jax.tree.leaves(lm.params))
+    # the decode layout replicates embed/pos/norms and shards the layer
+    # stack (decode_param_shardings): what one device holds at tp
+    rep_bytes = (_nbytes(lm.params["embed"]) + _nbytes(lm.params["pos"])
+                 + _nbytes(lm.params["ln_f_g"])
+                 + _nbytes(lm.params["layers"]["ln1_g"])
+                 + _nbytes(lm.params["layers"]["ln2_g"]))
+    n = 24 if quick else 48
+    trace = _decode_trace(n, seed=31, max_prompt=max_prompt,
+                          max_new_cap=cap, mean_gap_s=0.001,
+                          vocab=sd_cfg.vocab_size, min_new=8)
+    useful = sum(n_new for _, _, n_new in trace)
+
+    rows, outs = {}, {}
+    for label, tp_n in (("sharded", tp), ("replicated", 1)):
+        engine = server.register_decoder(
+            f"lm_sd_{label}", lm, slots=8, max_prompt=max_prompt,
+            max_new=cap, max_queue=max(64, n),
+            prompt_buckets=(max_prompt,), kv_block_size=block_size,
+            kv_pool_blocks=pool_blocks, prefill_token_budget=16,
+            decode_tp=tp_n)
+        engine.warmup()
+        _play_decode_trace(server, f"lm_sd_{label}",
+                           [(0.0, np.ones(4, np.int32), 2)] * 4, True)
+        engine.reset_stats()
+        results, elapsed = _play_decode_trace(server, f"lm_sd_{label}",
+                                              trace, True)
+        outs[label] = [r["result"] for r in results]
+        s = engine.stats()
+        flight = engine.recorder.summary() if engine.recorder else {}
+        rows[label] = {
+            "decode_tp": s["decode_tp"],
+            "mesh_devices": s["mesh_devices"],
+            "kv_bytes_per_device": s["kv_bytes_per_device"],
+            "decode_step_retraces": s["decode_step_retraces"],
+            "step_traces": s["step_traces"],
+            "prefill_traces": s["prefill_traces"],
+            "pin_copies_info": s["pin_copies"],
+            "tokens_per_s_info": round(useful / elapsed, 1),
+            "ttft_p50_ms_info": round(s["ttft_p50_ms"], 3),
+            "itl_p50_ms_info": round(s["itl_p50_ms"], 3),
+            "mean_step_ms_info": round(flight.get("mean_step_ms", 0.0),
+                                       3),
+        }
+    mismatches = sum(
+        not np.array_equal(a, b)
+        for a, b in zip(outs["sharded"], outs["replicated"]))
+    sh = rows["sharded"]
+    return {
+        "requests": n,
+        "useful_tokens": useful,
+        "decode_tp": tp,
+        # the model-size story the mesh exists for: what ONE device must
+        # hold. replicated = whole params + whole pool; sharded = the
+        # replicated embed/pos/norm slice + 1/tp of the layer stack and
+        # pool — when the replicated number exceeds a device's memory,
+        # tp>1 is the only config that serves at all
+        "model_params_bytes": params_bytes,
+        "kv_pool_bytes": kv_bytes,
+        "bytes_per_device_replicated": params_bytes + kv_bytes,
+        "bytes_per_device_sharded": (
+            rep_bytes + (params_bytes - rep_bytes) // tp
+            + kv_bytes // tp),
+        "output_mismatches_vs_tp1": mismatches,   # informational; tested
+        "sharded": sh,
+        "replicated": rows["replicated"],
+    }
+
+
 def _observability_ab(server, lm_model, quick: bool):
     """Prices the always-on black box: the SAME engine serves the same
     mixed-length trace twice — tracing fully disabled, then tail-sampled
@@ -711,6 +835,12 @@ def run(duration_s: float = 2.0, clients: int = 32,
                                n_layers=2, d_ff=256, max_seq=96)
     out["workloads"]["lm_prefix_cache"] = _prefix_cache_ab(
         server, TransformerLM(pc_cfg), quick)
+    # sharded-decode A/B fourth: capacity-led like the paged/prefix
+    # A/Bs (gated columns are byte and retrace counts, wall clock is
+    # _info); needs >= 2 devices (--devices / the dryrun harness), the
+    # default 1-device bench archives a skip marker
+    out["workloads"]["lm_sharded_decode"] = _sharded_decode_ab(
+        server, quick)
     # observability A/B (tracing-off vs tail-sampled-on) before the
     # closed-loop phase saturates the box — it measures tok/s deltas
     # that must sit in the noise floor, not under 32 client threads
@@ -791,7 +921,23 @@ def main() -> None:
     ap.add_argument("--debug_dump_dir", default="",
                     help="watchdog trip bundles land here (passed through "
                          "as -debug_dump_dir)")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="pin a virtual CPU mesh of N devices before jax "
+                         "initializes (the tools/scaling_bench.py pattern) "
+                         "so the lm_sharded_decode A/B can run tp>1; "
+                         "0 = leave the platform alone (the A/B then "
+                         "skips on a 1-device host)")
     args, _ = ap.parse_known_args()
+    if args.devices > 0:
+        # CLI runs own the process: pin the virtual mesh BEFORE the jax
+        # import inside run() fixes the backend (scaling_bench.py:48 —
+        # XLA_FLAGS must be set before JAX import, never after)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.devices}").strip()
     result = run(args.duration, args.clients, args.quick, args.trace,
                  args.debug_dump_dir, args.flight)
     print(json.dumps(result))

@@ -192,6 +192,11 @@ def request_report(spans, device_events=None):
             row["prefix_hit_blocks"] = admits[0]["args"]["prefix_hit_blocks"]
             row["prefill_tokens_saved"] = admits[0]["args"].get(
                 "prefill_tokens_saved", 0)
+        # sharded-decode engines annotate the admit span with the decode
+        # mesh width: the report then says which tensor-parallel config
+        # served each row (replicated engines omit it — no column)
+        if admits and "decode_tp" in admits[0]["args"]:
+            row["decode_tp"] = admits[0]["args"]["decode_tp"]
         if device:
             w0, w1 = root["ts"], root["ts"] + root["dur"]
             row["device_ms"] = sum(
@@ -208,6 +213,7 @@ def print_request_report(rows, top: int, sort: str,
     has_dev = any("device_ms" in r for r in rows)
     has_blocks = any("blocks" in r for r in rows)
     has_prefix = any("prefix_hit_blocks" in r for r in rows)
+    has_tp = any("decode_tp" in r for r in rows)
     has_keep = any(r.get("keep") for r in rows)
     breaches = (sum(r["total_ms"] > slo_ms for r in rows) if slo_ms > 0
                 else 0)
@@ -222,6 +228,8 @@ def print_request_report(rows, top: int, sort: str,
         hdr += f" {'blocks':>7} {'pfree':>6}"
     if has_prefix:
         hdr += f" {'pfxhit':>7} {'saved':>6}"
+    if has_tp:
+        hdr += f" {'tp':>3}"
     if has_dev:
         hdr += f" {'device':>9}"
     if has_keep:
@@ -239,6 +247,8 @@ def print_request_report(rows, top: int, sort: str,
         if has_prefix:
             line += (f" {str(r.get('prefix_hit_blocks', '-')):>7} "
                      f"{str(r.get('prefill_tokens_saved', '-')):>6}")
+        if has_tp:
+            line += f" {str(r.get('decode_tp', '-')):>3}"
         if has_dev:
             line += f" {r.get('device_ms', 0.0):9.3f}"
         if has_keep:
