@@ -266,6 +266,14 @@ define_bool("prefix_cache", True,
             "prefills only the remainder; needs kv_block_size > 0 and "
             "prefill_token_budget > 0. false = every prompt prefills "
             "from token zero (the A/B baseline)")
+define_int("spec_k", 0,
+           "decode engine: speculative decoding draft length — up to "
+           "spec_k n-gram prompt-lookup drafts per live slot are scored "
+           "by ONE fused verify step per iteration (fixed-K window "
+           "[slots, spec_k + 1]; accepted length handled as traced data), "
+           "emitting up to spec_k + 1 tokens per iteration with outputs "
+           "token-identical to plain greedy decode. 0 = off (today's "
+           "one-token path, bit-for-bit). Needs kv_block_size > 0")
 define_string("log_file", "", "optional log sink file")
 define_string("log_level", "info", "debug|info|error|fatal")
 define_bool("trace", False,

@@ -606,6 +606,124 @@ def cache_insert_paged(k_pool: jax.Array, v_pool: jax.Array,
     return k_pool, v_pool
 
 
+# -- serving: speculative decoding (fixed-K verify step) ----------------------
+#
+# Speculative decoding amortizes per-step fixed costs (dispatch, host
+# scheduling, all-reduces at decode_tp > 1) over up to K + 1 tokens per
+# engine iteration: a host-side drafter proposes K cheap continuation
+# guesses (n-gram prompt lookup — no draft model), and ONE fused forward
+# scores all K + 1 positions against the paged pool. Greedy verification
+# then accepts the longest drafted prefix that matches the model's own
+# argmax chain plus one correction token, so outputs are token-identical
+# to plain one-token decode by construction. The hard invariant survives:
+# K is FIXED per engine config (the [S, K + 1] window is the only new
+# static shape), while the drafted tokens, per-slot valid counts, block
+# tables and positions are all traced data — exactly one compiled verify
+# trace per engine config, next to the one fused step.
+
+
+def _verify_attention(q, k_cache, v_cache, n_heads: int, pos) -> jax.Array:
+    """Windowed multi-position attention: ``q`` [S, K1, D] against each
+    slot's gathered cache [S, T, D].
+
+    Window position ``j`` of slot ``s`` sits at cache position
+    ``pos[s] + j`` and attends entries at positions ``<= pos[s] + j`` —
+    the committed prefix plus the window's own already-written K/V
+    (causal WITHIN the drafted window, exactly
+    :func:`_chunk_attention`'s mask with the chunk offset per slot).
+    Math matches :func:`_cached_attention` (1/sqrt(dh) scale, f32
+    softmax), so window position 0's argmax is the token the plain
+    fused step would emit.
+    """
+    S, K1, D = q.shape
+    T = k_cache.shape[1]
+    dh = D // n_heads
+    qh = q.reshape(S, K1, n_heads, dh)
+    kh = k_cache.reshape(S, T, n_heads, dh)
+    vh = v_cache.reshape(S, T, n_heads, dh)
+    scores = jnp.einsum("skhd,sthd->shkt", qh, kh,
+                        preferred_element_type=jnp.float32) / np.sqrt(dh)
+    mask = (jnp.arange(T)[None, None, :]
+            <= (pos[:, None] + jnp.arange(K1))[:, :, None])[:, None, :, :]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("shkt,sthd->skhd", probs.astype(vh.dtype), vh)
+    return out.reshape(S, K1, D).astype(q.dtype)
+
+
+def verify_step_paged(cfg: TransformerConfig, params: Dict[str, Any],
+                      k_pool: jax.Array, v_pool: jax.Array,
+                      block_tables: jax.Array, toks: jax.Array,
+                      pos: jax.Array, active: jax.Array,
+                      n_valid: jax.Array, t_logical: Optional[int] = None
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused multi-position step: score K drafted tokens in one forward.
+
+    ``toks`` [S, K1] is each slot's verification window — position 0 is
+    the token the plain step would consume, positions ``1 .. K1 - 1``
+    are drafted guesses; ``pos`` [S] is the cache position of
+    ``toks[:, 0]``; ``n_valid`` [S] int32 in ``[1, K1]`` counts each
+    slot's REAL window entries (a slot with no drafts this iteration
+    runs ``n_valid = 1``). K1 = K + 1 is the ONLY static the feature
+    adds: toks/pos/active/n_valid and the block tables are all traced,
+    so one compiled trace serves every draft mix, acceptance outcome
+    and block assignment — the accepted length is handled host-side as
+    data, never as a shape.
+
+    Every valid window position writes its K/V at
+    ``(block_tables[s, (pos + j) // Bs], (pos + j) % Bs)`` BEFORE
+    attention (so the causal window sees itself), then attends the
+    slot's gathered view sliced to ``t_logical`` via
+    :func:`_verify_attention`. Dead lanes and pad positions
+    (``j >= n_valid``) park their writes in the scratch block — the
+    engine clamps drafts to ``remaining - 1`` tokens, so valid writes
+    never escape the slot's admission-time reservation and rejected
+    positions need NO device-side rollback: the next window starts at
+    the first unverified position and rewrites every speculated
+    position before any mask can reach it (the same
+    overwrite-before-the-mask contract pad garbage already rides).
+
+    Returns ``(k_pool, v_pool, out_tok [S, K1])`` where
+    ``out_tok[s, j]`` is the greedy token following inputs
+    ``toks[s, : j + 1]``: the host accepts drafts while
+    ``toks[s, j] == out_tok[s, j - 1]`` and emits
+    ``out_tok[s, : accepted + 1]`` — position ``accepted``'s entry is
+    the correction token, so every iteration emits at least the one
+    token the plain step would have.
+    """
+    S, K1 = toks.shape
+    Bs = k_pool.shape[2]
+    M = block_tables.shape[1]
+    T = M * Bs if t_logical is None else int(t_logical)
+    pos_ix = pos[:, None] + jnp.arange(K1)[None, :]            # [S, K1]
+    valid = (jnp.arange(K1)[None, :] < n_valid[:, None]) & active[:, None]
+    blk = jnp.where(
+        valid,
+        jnp.take_along_axis(block_tables,
+                            jnp.clip(pos_ix // Bs, 0, M - 1), axis=1), 0)
+    off = jnp.where(valid, pos_ix % Bs, 0)
+    h = (jnp.take(params["embed"], toks, axis=0)
+         + jnp.take(params["pos"], pos_ix, axis=0))
+    for i in range(cfg.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["layers"])
+        x = _rmsnorm(h, layer["ln1_g"])
+        q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
+        k_pool = k_pool.at[i, blk, off].set(k)
+        v_pool = v_pool.at[i, blk, off].set(v)
+        kv_shape = (S, M * Bs, -1)
+        kc = jnp.take(k_pool[i], block_tables, axis=0).reshape(kv_shape)
+        vc = jnp.take(v_pool[i], block_tables, axis=0).reshape(kv_shape)
+        h = h + _verify_attention(
+            q, kc[:, :T], vc[:, :T], cfg.n_heads, pos) @ layer["w_o"]
+        x = _rmsnorm(h, layer["ln2_g"])
+        h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+    h = _rmsnorm(h, params["ln_f_g"])
+    out = jnp.einsum("skd,vd->skv", h, params["embed"],
+                     preferred_element_type=jnp.float32)
+    nxt = jnp.argmax(out, axis=-1).astype(toks.dtype)
+    return k_pool, v_pool, jnp.where(valid, nxt, jnp.zeros_like(nxt))
+
+
 # -- serving: tensor-parallel sharded decode ----------------------------------
 #
 # PR 2 gated decode to a single-device params replica because feeding the
@@ -719,8 +837,9 @@ def make_sharded_decode_programs(cfg: TransformerConfig, mesh,
                                  ) -> Dict[str, Any]:
     """Pre-partitioned decode-mesh variants of the paged serving programs.
 
-    Returns ``{"step", "chunk", "admit", "cow", "param_shardings",
-    "pool_sharding"}`` — each program jitted exactly once with matched
+    Returns ``{"step", "chunk", "admit", "cow", "verify",
+    "param_shardings", "pool_sharding"}`` — each program jitted exactly
+    once with matched
     ``in_shardings``/``out_shardings``: params carry
     :func:`decode_param_shardings`, both pools carry
     :func:`kv_pool_sharding` (outputs included, so iteration N's pools
@@ -764,8 +883,22 @@ def make_sharded_decode_programs(cfg: TransformerConfig, mesh,
         in_shardings=(pool, pool, rep, rep),
         out_shardings=(pool, pool),
         donate_argnums=(0, 1) if donate else ())
+    # the speculative verify step pins and partitions exactly like the
+    # fused step: params sharded, pools round-tripped pool-sharded, the
+    # [S, K + 1] window / positions / valid counts replicated traced-as-
+    # data. K rides the window SHAPE, so the engine (which always passes
+    # its fixed spec_k + 1 columns) gets exactly one compiled trace; a
+    # spec_k=0 engine never dispatches it and its cache stays empty.
+    verify = jax.jit(
+        lambda params, kc, vc, bt, toks, pos, active, nv:
+        verify_step_paged(cfg, params, kc, vc, bt, toks, pos, active, nv,
+                          t_logical=T),
+        in_shardings=(ps, pool, pool, rep, rep, rep, rep, rep),
+        out_shardings=(pool, pool, rep),
+        donate_argnums=kv_donate)
     return {"step": step, "chunk": chunk, "admit": admit, "cow": cow,
-            "param_shardings": ps, "pool_sharding": pool}
+            "verify": verify, "param_shardings": ps,
+            "pool_sharding": pool}
 
 
 def cache_insert(k_cache: jax.Array, v_cache: jax.Array, slots: jax.Array,

@@ -76,6 +76,26 @@ cache (the fixed-slot precursor to vLLM's PagedAttention):
   benched against in ``tools/serving_bench.py``). Either way the first
   token falls out of the (last chunk of the) prefill, so TTFT is one
   prefill — not one full batch drain.
+* **speculative decoding** (``-spec_k``, default 0 = off) — the engine
+  emits up to ``spec_k + 1`` tokens per iteration: a host-side n-gram
+  **prompt-lookup** drafter (Saxena; no draft model) proposes up to K
+  continuation guesses per live slot from the sequence's own history
+  (prompt + emitted tokens, indexed incrementally per accept), and ONE
+  fused :func:`models.transformer.verify_step_paged` scores all K + 1
+  positions against the paged pool in a single forward. Greedy
+  verification accepts the longest drafted prefix matching the model's
+  own argmax chain plus one correction token, so outputs are
+  **token-identical to plain greedy decode** — speculation changes the
+  schedule, never the tokens. K is fixed per engine config (the
+  ``[S, K + 1]`` window is the only new static shape; drafts, valid
+  counts and the accepted length are traced data), so the feature adds
+  exactly ONE compiled verify trace next to the one fused step. Drafts
+  clamp to the request's remaining budget, so speculative writes never
+  escape the admission-time block reservation (rejected positions need
+  no device rollback — the next window rewrites them before any mask
+  can reach them), and a full-hit shared block is CoW'd at admission
+  *before* speculation, preserving the prefix-cache one-write-site
+  contract. ``spec_k=0`` is today's one-token path, bit-for-bit.
 * **iteration-granular completion** — a slot frees the moment its
   sequence emits ``eos_id`` or reaches its per-request ``max_new``;
   the finished tokens resolve the caller's Future immediately and the
@@ -157,6 +177,11 @@ class DecodeEngineConfig:
     # inert otherwise). False is the A/B baseline: same pool bytes,
     # every prompt prefills from token zero.
     prefix_cache: Optional[bool] = None
+    # speculative decoding draft length (None = the -spec_k flag).
+    # 0 = off (today's one-token path, bit-for-bit); > 0 drafts up to
+    # spec_k tokens per live slot via n-gram prompt lookup and verifies
+    # them in one fused fixed-K step (needs the paged KV cache)
+    spec_k: Optional[int] = None
     # black-box layer (None = the matching flag): always-on flight
     # recorder ring, stall/leak watchdog, trip-bundle target, and the
     # rolling-window latency SLOs registered in the Dashboard
@@ -219,12 +244,75 @@ class DecodeEngineConfig:
 # completed columns join ring records to requests without holding refs
 _RIDS = itertools.count(1)
 
+# prompt-lookup n-gram width: the drafter keys on the sequence's last
+# _SPEC_NGRAM tokens. 2 is the sweet spot for the repetitive tails
+# speculation targets (templated/looping continuations re-enter their
+# cycle within a couple of tokens); a larger n only delays the first
+# match without improving the greedy-verified acceptance contract.
+_SPEC_NGRAM = 2
+
+
+class _PromptLookup:
+    """Per-slot n-gram prompt-lookup index (Saxena, "Prompt Lookup
+    Decoding"): maps every :data:`_SPEC_NGRAM`-gram of the sequence so
+    far (prompt + emitted tokens) to the position right after its most
+    recent earlier occurrence. A proposal reads the continuation that
+    followed the last time the sequence's current tail was seen — free
+    drafts with high acceptance on the repetitive tails of real traffic
+    (templates, code, multi-turn echoes), and by construction the tail
+    n-gram itself is never indexed until a later token gives it a
+    continuation, so a proposal never self-matches. Pure host state,
+    O(1) amortized per token (the index extends incrementally with each
+    accepted token), so drafting can never add a compiled trace."""
+
+    __slots__ = ("toks", "index")
+
+    def __init__(self) -> None:
+        self.toks: List[int] = []
+        self.index: dict = {}
+
+    def extend(self, tokens) -> None:
+        """Append tokens; each one gives the n-gram ENDING just before
+        it a continuation, which is when that n-gram becomes usable."""
+        for t in tokens:
+            p = len(self.toks)
+            self.toks.append(int(t))
+            if p >= _SPEC_NGRAM:
+                self.index[tuple(self.toks[p - _SPEC_NGRAM: p])] = p
+
+    def propose(self, limit: int) -> List[int]:
+        """Up to ``limit`` draft tokens continuing the current tail, or
+        ``[]`` when the tail n-gram has no earlier occurrence.
+
+        The lookup FOLLOWS THROUGH its own extension: when the matched
+        continuation runs out before ``limit`` (a tight cycle whose
+        period is shorter than the draft window), the tail of (sequence
+        + draft-so-far) is looked up again — so a period-2 loop still
+        fills a K=4 window instead of stalling at the match boundary,
+        which is exactly where greedy generations spend their
+        repetitive tails."""
+        if limit <= 0 or len(self.toks) < _SPEC_NGRAM:
+            return []
+        out: List[int] = []
+        key = tuple(self.toks[-_SPEC_NGRAM:])
+        while len(out) < limit:
+            start = self.index.get(key)
+            if start is None:
+                break
+            take = self.toks[start: start + (limit - len(out))]
+            if not take:
+                break
+            out.extend(take)
+            key = tuple((list(key) + take)[-_SPEC_NGRAM:])
+        return out
+
 
 class _Request:
     __slots__ = ("prompt", "max_new", "future", "t_enq", "t_last",
                  "slot", "out", "version", "ctx", "pf_off", "pf_chunks",
                  "t_admit", "blocks", "rid", "hashes", "hash_seed",
-                 "n_hit", "full_hit", "saved", "pf_reg", "ttft_pending")
+                 "n_hit", "full_hit", "saved", "pf_reg", "ttft_pending",
+                 "drafter")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  ctx: Optional[trace.SpanContext] = None) -> None:
@@ -259,6 +347,9 @@ class _Request:
         self.saved = 0
         self.pf_reg = 0
         self.ttft_pending = False
+        # speculative decoding: the slot's prompt-lookup draft index
+        # (None on spec_k=0 engines — created at admission)
+        self.drafter: Optional[_PromptLookup] = None
 
 
 class DecodeEngine:
@@ -278,7 +369,8 @@ class DecodeEngine:
                                           decode_step_paged,
                                           make_sharded_decode_programs,
                                           prefill, prefill_chunk,
-                                          prefill_chunk_paged)
+                                          prefill_chunk_paged,
+                                          verify_step_paged)
 
         self.name = name
         self.config = config or DecodeEngineConfig()
@@ -404,6 +496,21 @@ class DecodeEngine:
         self._prefix = (self._paged and self._budget > 0
                         and bool(ec._resolved("prefix_cache")))
         self._hash_seed = b""        # pinned-version scope for the chain
+        # speculative decoding: up to spec_k prompt-lookup drafts per
+        # live slot, verified by one fused fixed-K step per iteration.
+        # Paged-only: the verify window's scatter/rollback contract is
+        # written against block tables (dead/pad writes park in scratch;
+        # the contiguous strips have no per-position sentinel for a
+        # multi-position window), so spec_k > 0 fail-fasts on contiguous
+        self._spec = int(ec._resolved("spec_k"))
+        if self._spec < 0:
+            Log.fatal(f"DecodeEngine {name!r}: negative spec_k "
+                      f"{self._spec}")
+        if self._spec and not self._paged:
+            Log.fatal(f"DecodeEngine {name!r}: spec_k={self._spec} needs "
+                      f"the paged KV cache (kv_block_size > 0) — the "
+                      f"verify window parks rejected/pad writes in the "
+                      f"scratch block")
 
         # fused admission: prefill a group of prompts (padded to a batch
         # bucket x prompt bucket), gather each last REAL position's logits
@@ -433,6 +540,11 @@ class DecodeEngine:
             self._chunk_fn = progs["chunk"]
             self._step_fn = progs["step"]
             self._cow_fn = progs["cow"] if self._prefix else None
+            # the verify step pins and partitions like the fused step
+            # (the builder's in/out_shardings match); K rides the fixed
+            # [S, spec_k + 1] window shape, so dispatching it is one
+            # compiled trace exactly like the step
+            self._verify_fn = progs["verify"] if self._spec else None
         else:
             if self._paged:
                 # the ONE paged admission body (prefill + last-real-
@@ -484,7 +596,21 @@ class DecodeEngine:
                     decode_step_paged(cfg, params, kc, vc, bt, tok, pos,
                                       active, t_logical=T),
                     donate_argnums=donate)
+                if self._spec:
+                    # the fixed-K verify step: the [S, spec_k + 1]
+                    # window is the only static — drafts, valid counts
+                    # and block tables are data, so ONE compiled trace
+                    # serves every draft mix and acceptance outcome
+                    # (fresh lambda per engine, same as the step)
+                    self._verify_fn = jax.jit(
+                        lambda params, kc, vc, bt, toks, pos, active, nv:
+                        verify_step_paged(cfg, params, kc, vc, bt, toks,
+                                          pos, active, nv, t_logical=T),
+                        donate_argnums=donate)
+                else:
+                    self._verify_fn = None
             else:
+                self._verify_fn = None
                 self._chunk_fn = jax.jit(
                     lambda params, kc, vc, slot, toks, off, n:
                     prefill_chunk(
@@ -552,11 +678,23 @@ class DecodeEngine:
             f"DECODE_STEPS[{name}]")
         # token-accounting split: prompt tokens prefilled vs tokens
         # emitted — interval-deltas (MetricsExporter) become the two
-        # rates whose ratio says where the engine's FLOPs are going
+        # rates whose ratio says where the engine's FLOPs are going.
+        # DECODE_TOKENS counts every EMITTED token (a speculative
+        # iteration emits up to spec_k + 1), so DECODE_TPS and the
+        # exporter's token rate stay honest under speculation
         self.prefill_tok_counter = Dashboard.get_or_create_counter(
             f"PREFILL_TOKENS[{name}]")
         self.decode_tok_counter = Dashboard.get_or_create_counter(
             f"DECODE_TOKENS[{name}]")
+        # speculative decoding instruments, created only on spec engines
+        # so a spec_k=0 engine's dashboard/stats surface is byte-for-
+        # byte today's (the metrics regression contract)
+        self.spec_prop_counter = self.spec_acc_counter = None
+        if self._spec:
+            self.spec_prop_counter = Dashboard.get_or_create_counter(
+                f"SPEC_PROPOSED[{name}]")
+            self.spec_acc_counter = Dashboard.get_or_create_counter(
+                f"SPEC_ACCEPTED[{name}]")
         # iteration progress: the counter for dashboards/rates, the local
         # mirror + monotonic age for stats()/the watchdog's stall check
         self.iters_counter = Dashboard.get_or_create_counter(
@@ -583,6 +721,8 @@ class DecodeEngine:
                 decode_tp=self._tp,
                 mesh_devices=(self._decode_mesh.size
                               if self._decode_mesh is not None else 1))
+            if self._spec:
+                self.recorder.meta["spec_k"] = self._spec
         # admit-span mesh annotation (trace_summary ships the column):
         # only sharded engines carry it, so replicated reports stay flat
         self._mesh_attrs = ({"decode_tp": self._tp} if self._tp > 1
@@ -592,6 +732,8 @@ class DecodeEngine:
         self._it_completed: List[int] = []
         self._it_prefill = 0
         self._it_decode = 0
+        self._it_spec_proposed = 0
+        self._it_spec_accepted = 0
         self.completed = 0
         self.shed = 0
         self.tokens = 0
@@ -610,6 +752,12 @@ class DecodeEngine:
         self.prefix_misses = 0
         self.prefill_tokens_saved = 0
         self.cow_copies = 0
+        # speculative-decoding mirrors (the SPEC_* counters stay
+        # monotonic; these reset with the bench window): drafts
+        # proposed/accepted and verify-step dispatches
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_steps = 0
         # window base for the pool's monotonic eviction counter, so
         # stats()["prefix_evictions"] resets with its sibling mirrors
         self._evictions_base = 0
@@ -812,6 +960,7 @@ class DecodeEngine:
             self._it_admitted.clear()
             self._it_completed.clear()
             self._it_prefill = self._it_decode = 0
+            self._it_spec_proposed = self._it_spec_accepted = 0
             step_ms = 0.0
             worked = False
             try:
@@ -875,7 +1024,9 @@ class DecodeEngine:
             self._pool.n_live if self._paged else -1,
             self._pool.n_shared if self._paged else -1,
             self._snap.version if self._snap is not None else -1,
-            tuple(self._it_admitted), tuple(self._it_completed)))
+            tuple(self._it_admitted), tuple(self._it_completed),
+            self._it_spec_proposed if self._spec else -1,
+            self._it_spec_accepted if self._spec else -1))
 
     def _maybe_refresh(self) -> None:
         """Move the pinned snapshot only while NO generation is in flight
@@ -999,6 +1150,11 @@ class DecodeEngine:
         self._reserve_blocks(req, slot)
         req.pf_chunks = 0
         req.t_admit = time.monotonic()   # queue.wait ends here
+        if self._spec:
+            # prompt-lookup drafting indexes the prompt up front; every
+            # emitted token extends the index incrementally from here
+            req.drafter = _PromptLookup()
+            req.drafter.extend(req.prompt)
         self._it_admitted.append(req.rid)
         if self._prefix and req.full_hit:
             # the WHOLE prompt was cached: no prefill at all. The slot
@@ -1018,6 +1174,12 @@ class DecodeEngine:
                     prefix_hit_blocks=req.n_hit,
                     prefill_tokens_saved=req.saved, **self._mesh_attrs)
             req.ttft_pending = True
+            # the ITL base moves to ADMISSION: the next step's first
+            # token records TTFT, but a speculative window's extra
+            # tokens divide (now - t_last) as ITL samples — left at
+            # t_enq, a queued full hit would bleed its whole queue wait
+            # into the ITL histogram (review-found, regression-tested)
+            req.t_last = req.t_admit
             self._slot_req[slot] = req
             self._tok[slot] = int(req.prompt[-1])
             self._pos[slot] = len(req.prompt) - 1
@@ -1094,6 +1256,8 @@ class DecodeEngine:
         self.decode_tok_counter.inc()
         self._it_decode += 1
         req.out.append(tok0)
+        if req.drafter is not None:
+            req.drafter.extend((tok0,))
         if tracing and req.ctx is not None:
             trace.record_span("queue.wait", req.ctx, req.t_enq,
                               req.t_admit, cause="admission")
@@ -1153,6 +1317,9 @@ class DecodeEngine:
                 slots[i] = slot
                 req.slot = slot
                 self._reserve_blocks(req, slot)
+                if self._spec:
+                    req.drafter = _PromptLookup()
+                    req.drafter.extend(req.prompt)
                 if self._paged:
                     bts[i] = self._block_tables[slot]
                 self.prefill_tokens += len(req.prompt)
@@ -1186,6 +1353,8 @@ class DecodeEngine:
                 self.decode_tok_counter.inc()
                 self._it_decode += 1
                 req.out.append(tok0)
+                if req.drafter is not None:
+                    req.drafter.extend((tok0,))
                 if tracing and req.ctx is not None:
                     # the two child spans that explain a slow TTFT: how
                     # long the prompt queued for a free slot, then the
@@ -1213,16 +1382,60 @@ class DecodeEngine:
                 self._pos[slot] = len(req.prompt)
                 self._active[slot] = True
 
+    def _propose_drafts(self):
+        """Gather this iteration's verification window: up to ``spec_k``
+        prompt-lookup drafts per live slot. Drafts clamp to the
+        request's REMAINING budget minus one (the correction token
+        always fills the final emission), so a valid window write never
+        passes position ``prompt + max_new - 2`` — strictly inside the
+        admission-time block reservation, which is how the K-token
+        overhang is accounted for without reserving a single extra
+        block. Returns ``(None, None)`` when no slot drafted: the
+        iteration then runs the plain fused step, so a spec engine's
+        draft-less iterations (and the whole life of a ``spec_k=0``
+        engine) stay on today's path bit-for-bit."""
+        K = self._spec
+        toks = n_valid = None
+        for s in range(self.config.slots):
+            req = self._slot_req[s]
+            if req is None:
+                continue
+            limit = min(K, req.max_new - len(req.out) - 1)
+            if limit <= 0:
+                continue
+            drafts = req.drafter.propose(limit)
+            if not drafts:
+                continue
+            if toks is None:
+                toks = np.zeros((self.config.slots, K + 1), np.int32)
+                toks[:, 0] = self._tok
+                n_valid = np.ones(self.config.slots, np.int32)
+            toks[s, 1: 1 + len(drafts)] = drafts
+            n_valid[s] = 1 + len(drafts)
+        return toks, n_valid
+
     def _step(self) -> None:
         # ONE branch decides all per-iteration trace work: when tracing
         # is off this loop allocates nothing trace-related (guarded by
         # test_observability's overhead test)
         tracing = trace.enabled()
         t_it0 = time.monotonic() if tracing else 0.0
+        spec_toks = n_valid = None
+        if self._spec:
+            spec_toks, n_valid = self._propose_drafts()
         # host state (tok/pos/active — and, paged, the block tables)
         # feeds the jit as plain numpy: the same aval signature warmup()
         # uses, so the two share one trace
-        if self._paged:
+        if spec_toks is not None:
+            # fused verify: ONE forward scores every window position;
+            # acceptance is decided below on the host from the argmax
+            # chain (traced data in, plain ints out — never a shape)
+            self.spec_steps += 1
+            self._k_cache, self._v_cache, nxt = self._verify_fn(
+                self._pinned, self._k_cache, self._v_cache,
+                self._block_tables, spec_toks, self._pos, self._active,
+                n_valid)
+        elif self._paged:
             self._k_cache, self._v_cache, nxt, _ = self._step_fn(
                 self._pinned, self._k_cache, self._v_cache,
                 self._block_tables, self._tok, self._pos, self._active)
@@ -1230,11 +1443,7 @@ class DecodeEngine:
             self._k_cache, self._v_cache, nxt, _ = self._step_fn(
                 self._pinned, self._k_cache, self._v_cache,
                 self._tok, self._pos, self._active)
-        nxt = np.array(nxt)           # the per-iteration host sync point
-        # pos is mirrored host-side (active lanes advanced one) rather
-        # than read back: one device->host transfer per iteration, not two
-        self._pos[self._active] += 1
-        self._tok = nxt               # np.array above: a fresh writable copy
+        nxt = np.array(nxt)       # [S] or [S, K+1]; the host sync point
         now = time.monotonic()
         self.steps_counter.inc()
         n_active = 0
@@ -1243,27 +1452,84 @@ class DecodeEngine:
             if req is None:
                 continue
             n_active += 1
-            tok = int(nxt[s])
-            req.out.append(tok)
-            self.tokens += 1
-            self.decode_tok_counter.inc()
-            self._it_decode += 1
-            if req.ttft_pending:
-                # fully-cached admission: THIS is the request's first
-                # token — it belongs in the TTFT histogram, not ITL
-                req.ttft_pending = False
-                self.ttft_hist.record((now - req.t_enq) * 1e3)
+            if spec_toks is None:
+                emitted = [int(nxt[s])]
+                accepted = 0
             else:
-                self.itl_hist.record((now - req.t_last) * 1e3)
+                # greedy verification: drafts are accepted while they
+                # match the model's own argmax chain; entry ``accepted``
+                # of the window's outputs is the correction token, so
+                # at least the plain step's one token always emits and
+                # every emission equals sequential greedy decode
+                nv = int(n_valid[s])
+                accepted = 0
+                while (accepted + 1 < nv
+                       and int(spec_toks[s, accepted + 1])
+                       == int(nxt[s, accepted])):
+                    accepted += 1
+                emitted = [int(nxt[s, j]) for j in range(accepted + 1)]
+                eos = self.config.eos_id
+                if eos is not None and eos in emitted:
+                    # an in-window eos truncates the window HERE, so
+                    # the accounting credits only REALIZED drafts —
+                    # matches accepted past the eos were never emitted,
+                    # and accepted_per_step is documented (and gated)
+                    # as extra tokens actually bought per dispatch
+                    emitted = emitted[: emitted.index(eos) + 1]
+                    accepted = len(emitted) - 1
+                proposed = nv - 1
+                self.spec_proposed += proposed
+                self.spec_accepted += accepted
+                self._it_spec_proposed += proposed
+                self._it_spec_accepted += accepted
+                if proposed:
+                    self.spec_prop_counter.inc(proposed)
+                if accepted:
+                    self.spec_acc_counter.inc(accepted)
+            # pos/tok mirror host-side (consumed inputs advance the
+            # position; rejected window positions are simply never
+            # consumed — the next window starts at the first unverified
+            # position and rewrites them before any mask reaches them)
+            self._pos[s] += len(emitted)
+            self._tok[s] = emitted[-1]
+            # ITL is per EMITTED token: the step interval divides across
+            # this iteration's emissions (spec_k=0 emits one token, so
+            # the sample is exactly today's now - t_last)
+            share = (now - req.t_last) * 1e3 / len(emitted)
+            done = False
+            for tok in emitted:
+                req.out.append(tok)
+                self.tokens += 1
+                self.decode_tok_counter.inc()
+                self._it_decode += 1
+                if req.ttft_pending:
+                    # fully-cached admission: THIS is the request's
+                    # first token — it belongs in TTFT, not ITL
+                    req.ttft_pending = False
+                    self.ttft_hist.record((now - req.t_enq) * 1e3)
+                else:
+                    self.itl_hist.record(share)
+                if self._finished(req, tok):
+                    # eos inside the window truncates it: emissions past
+                    # eos are dropped exactly as sequential decode would
+                    # never have produced them
+                    done = True
+                    break
             req.t_last = now
+            if req.drafter is not None and not done:
+                req.drafter.extend(emitted)
             if tracing and req.ctx is not None:
                 # one fused step serves every live slot; each request
                 # gets the iteration as ITS child span (same interval),
                 # so a slow request's trace shows every co-batched
-                # iteration it sat through and on which slot
+                # iteration it sat through and on which slot. Spec
+                # engines annotate how many drafts the window kept
+                # (spec_k=0 spans stay flat — today's attrs exactly)
+                extra = {"accepted": accepted} if self._spec else {}
                 trace.record_span("decode.iter", req.ctx, t_it0, now,
-                                  slot=s, token_index=len(req.out))
-            if self._finished(req, tok):
+                                  slot=s, token_index=len(req.out),
+                                  **extra)
+            if done:
                 self._active[s] = False
                 self._slot_req[s] = None
                 self._release_seq(req)
@@ -1341,6 +1607,14 @@ class DecodeEngine:
             return _jit_cache_size(self._chunk_fn)
         return _jit_cache_size(self._admit_fn)
 
+    def verify_cache_size(self) -> int:
+        """Compiled-trace count of the speculative verify step (1 after
+        warmup on a spec engine: the fixed-K window is the whole
+        signature; 0 when ``spec_k=0`` — the program doesn't exist)."""
+        if self._verify_fn is None:
+            return 0
+        return _jit_cache_size(self._verify_fn)
+
     def warmup(self) -> None:
         """Compile every admission trace (the ONE chunk program when
         chunked, else every (batch bucket, prompt bucket) fused
@@ -1394,6 +1668,17 @@ class DecodeEngine:
                 kc, vc = scratch()
                 jax.block_until_ready(self._cow_fn(
                     kc, vc, np.int32(0), np.int32(0)))
+            if self._spec:
+                # the verify step pins like the step programs: compiled
+                # here against the pinned params + scratch pools, so
+                # the trace warmup builds IS the serving trace (the
+                # [S, K + 1] window shape is the whole signature)
+                kc, vc = scratch()
+                jax.block_until_ready(self._verify_fn(
+                    params, kc, vc, bt,
+                    np.zeros((S, self._spec + 1), np.int32),
+                    np.zeros(S, np.int32), np.zeros(S, bool),
+                    np.ones(S, np.int32)))
             kc, vc = scratch()
             jax.block_until_ready(self._step_fn(
                 params, kc, vc, bt, np.zeros(S, np.int32),
@@ -1430,6 +1715,9 @@ class DecodeEngine:
         self.prefix_misses = 0
         self.prefill_tokens_saved = 0
         self.cow_copies = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_steps = 0
         if self._paged:
             self._evictions_base = self._pool.evictions
         self.t_first = None
@@ -1475,6 +1763,27 @@ class DecodeEngine:
                 "prefix_evictions": self._pool.evictions
                 - self._evictions_base,
                 "cow_copies": self.cow_copies,
+            })
+        if self._spec:
+            # speculative-decoding surface, present only on spec
+            # engines (a spec_k=0 engine's stats dict stays byte-for-
+            # byte today's — the metrics regression contract).
+            # accepted_per_step is the amortization headline: mean
+            # EXTRA tokens each verify dispatch bought; acceptance_rate
+            # is the drafter-quality diagnostic (archived _info in the
+            # bench — trace-dependent, so it never gates)
+            pool.update({
+                "spec_k": self._spec,
+                "spec_steps": self.spec_steps,
+                "spec_proposed": self.spec_proposed,
+                "spec_accepted": self.spec_accepted,
+                "acceptance_rate": (self.spec_accepted
+                                    / self.spec_proposed
+                                    if self.spec_proposed else 0.0),
+                "accepted_per_step": (self.spec_accepted
+                                      / self.spec_steps
+                                      if self.spec_steps else 0.0),
+                "verify_traces": self.verify_cache_size(),
             })
         health = self.health()
         return {

@@ -38,6 +38,12 @@ Record schema (:data:`FIELDS`, positional):
 ``version``             pinned snapshot version (-1 before the first pin)
 ``admitted``            request ids admitted this pass (tuple, usually empty)
 ``completed``           request ids completed this pass (tuple)
+``spec_proposed``       speculative drafts verified this pass (-1 when
+                        ``spec_k=0`` — the engine isn't speculating)
+``spec_accepted``       speculative drafts ACCEPTED this pass (-1 when
+                        ``spec_k=0``); accepted/proposed per time bucket
+                        is the acceptance-rate strip
+                        ``tools/engine_timeline.py`` renders
 ======================  =====================================================
 
 Timestamps are monotonic; the recorder captures a wall/mono anchor at
@@ -69,9 +75,14 @@ except ImportError:
     lockwatch = _PlainLocks()  # type: ignore[assignment]
 from typing import Any, Dict, List, Optional
 
+# new columns append at the END: readers index the stable prefix
+# positionally, and a pre-PR-11 dump (15/16-field records) still zips
+# cleanly against the longer FIELDS — consumers read the tail columns
+# with .get() defaults (the PR 8 pool_shared pattern)
 FIELDS = ("it", "ts", "busy_ms", "step_ms", "live", "reserved", "queue",
           "queue_age_ms", "prefill_toks", "decode_toks", "pool_free",
-          "pool_live", "pool_shared", "version", "admitted", "completed")
+          "pool_live", "pool_shared", "version", "admitted", "completed",
+          "spec_proposed", "spec_accepted")
 
 
 def window_digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -232,6 +243,13 @@ class FlightRecorder:
                                "ts": ts, "pid": pid, "tid": 0,
                                "args": {"free": r[10], "live": r[11],
                                         "shared": max(0, r[12])}})
+            # speculative-decoding track: only spec engines emit it
+            # (len guard: pre-PR-11 tuples are 16 fields)
+            if len(r) > 17 and r[16] >= 0:
+                events.append({"name": f"{prefix}/spec", "ph": "C",
+                               "ts": ts, "pid": pid, "tid": 0,
+                               "args": {"proposed": r[16],
+                                        "accepted": r[17]}})
         return events
 
     def merge_chrome(self, doc: dict) -> dict:

@@ -119,6 +119,7 @@ class InferenceServer:
                          kv_pool_blocks: Optional[int] = None,
                          decode_tp: Optional[int] = None,
                          prefix_cache: Optional[bool] = None,
+                         spec_k: Optional[int] = None,
                          watchdog: Optional[bool] = None,
                          debug_dump_dir: Optional[str] = None,
                          slo_ttft_ms: Optional[float] = None,
@@ -152,7 +153,13 @@ class InferenceServer:
         default on) turns on content-addressed block reuse over that
         pool: prompts sharing a prefix prefill it once and splice the
         cached blocks refcounted/copy-on-write (docs/SERVING.md
-        "Prefix caching").
+        "Prefix caching"). ``spec_k`` (None = the ``-spec_k`` flag,
+        default 0 = off) turns on speculative decoding: up to
+        ``spec_k`` n-gram prompt-lookup drafts per live slot, verified
+        by one fused fixed-K step per iteration — up to ``spec_k + 1``
+        tokens per iteration, outputs token-identical to plain greedy
+        decode (docs/SERVING.md "Speculative decoding"; needs the
+        paged KV cache).
 
         The black-box layer rides along by default: an always-on
         flight recorder (``engine.recorder``) and a stall/leak/queue-age
@@ -169,7 +176,7 @@ class InferenceServer:
             prefill_token_budget=prefill_token_budget,
             kv_block_size=kv_block_size, kv_pool_blocks=kv_pool_blocks,
             decode_tp=decode_tp, prefix_cache=prefix_cache,
-            watchdog=watchdog, debug_dump_dir=debug_dump_dir,
+            spec_k=spec_k, watchdog=watchdog, debug_dump_dir=debug_dump_dir,
             slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
         with self._lock:
             if self._stopped:

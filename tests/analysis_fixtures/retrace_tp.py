@@ -135,3 +135,29 @@ class Rt106ShardedEngine:
     def _iterate(self):
         step = _build_sharded_step(self._fn, self._specs)  # RT106 builder
         return step(1.0)
+
+
+def _build_verify_step(fn, k):
+    """A fixed-K speculative-verify program builder: constructing the
+    jit IS its job (sanctioned at construction time; hazardous only
+    when the iteration path calls it — see Rt106SpecEngine)."""
+    return jax.jit(fn, static_argnums=(0,))
+
+
+class Rt106SpecEngine:
+    """RT106 via a verify-step builder: rebuilding the fixed-K verify
+    program per iteration (e.g. 'adapting' K to the draft count, which
+    turns the accepted length into a SHAPE) recompiles on the hot path
+    — K must be fixed per engine config and the accepted length must
+    stay traced data."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def _loop(self):
+        while True:
+            self._iterate()
+
+    def _iterate(self):
+        verify = _build_verify_step(self._fn, 4)   # RT106 builder
+        return verify(4, 1.0)

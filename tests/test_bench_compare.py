@@ -42,6 +42,11 @@ def test_metric_direction_rules():
     # zero-baseline rule — one compiled fused step per engine config
     assert metric_direction("kv_bytes_per_device") == -1
     assert metric_direction("decode_step_retraces") == -1
+    # speculative decoding (lm_spec_decode A/B): amortization gates,
+    # trace-dependent acceptance archives _info
+    assert metric_direction("accepted_per_step") == 1
+    assert metric_direction("speedup_spec") == 1
+    assert metric_direction("acceptance_rate_info") == 0
     # the _info suffix overrides every pattern rule: measured-but-noisy
     # columns ride the archive without flapping the gate
     assert metric_direction("tokens_per_s_info") == 0

@@ -19,10 +19,11 @@ from tools.engine_timeline import load_ring, main, render, timeline_report
 
 def _rec(it, ts, busy=1.0, step=0.5, live=1, reserved=0, queue=0,
          queue_age=0.0, prefill=0, decode=1, pool_free=-1, pool_live=-1,
-         pool_shared=-1, version=0, admitted=(), completed=()):
+         pool_shared=-1, version=0, admitted=(), completed=(),
+         spec_proposed=-1, spec_accepted=-1):
     return (it, ts, busy, step, live, reserved, queue, queue_age,
             prefill, decode, pool_free, pool_live, pool_shared, version,
-            admitted, completed)
+            admitted, completed, spec_proposed, spec_accepted)
 
 
 # -- ring ---------------------------------------------------------------------
@@ -118,6 +119,33 @@ def test_chrome_counter_tracks_merge_with_span_export():
     assert sum(e["ph"] == "C" for e in merged["traceEvents"]) == 4
     assert [e["ts"] for e in merged["traceEvents"]] == sorted(
         e["ts"] for e in merged["traceEvents"])
+
+
+def test_spec_counter_track_and_legacy_tuple_tolerance():
+    """The spec columns ride the END of FIELDS: spec engines get a
+    ``fr/<name>/spec`` counter track, -1 columns (spec_k=0) emit none,
+    and a pre-PR-11 16-field tuple still reads cleanly everywhere
+    (records/summary/chrome skip the absent tail columns)."""
+    fr = FlightRecorder(capacity=8, name="eng")
+    fr.record(_rec(1, time.monotonic(), spec_proposed=4, spec_accepted=3))
+    events = fr.chrome_counter_events()
+    spec = [e for e in events if e["name"] == "fr/eng/spec"]
+    assert len(spec) == 1
+    assert spec[0]["args"] == {"proposed": 4, "accepted": 3}
+    assert fr.records()[0]["spec_proposed"] == 4
+
+    off = FlightRecorder(capacity=8, name="off")
+    off.record(_rec(1, time.monotonic()))
+    assert not any(e["name"].endswith("/spec")
+                   for e in off.chrome_counter_events())
+
+    legacy = FlightRecorder(capacity=8, name="old")
+    legacy.record(_rec(1, time.monotonic())[:16])   # pre-PR-11 shape
+    recs = legacy.records()
+    assert len(recs) == 1 and "spec_proposed" not in recs[0]
+    assert legacy.summary()["iterations"] == 1
+    assert not any(e["name"].endswith("/spec")
+                   for e in legacy.chrome_counter_events())
 
 
 # -- engine integration -------------------------------------------------------

@@ -57,7 +57,9 @@ def _seed_inversion(prefix):
     return a, b
 
 
-def test_ab_ba_inversion_trips_counter_and_violation():
+def test_lock_inversion_trips_counter_and_violation():
+    # (named without "_ab_": the conftest @slow audit reserves that
+    # pattern for perf A/B tests; this is a fast AB/BA inversion unit)
     counter = Dashboard.get_or_create_counter("LOCK_ORDER_VIOLATIONS")
     before_count = counter.get()
     before = lockwatch.violation_count()

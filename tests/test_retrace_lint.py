@@ -54,6 +54,7 @@ EXPECTED_TP = {
     ("RT105", "rt105_donated_reuse"),
     ("RT106", "Rt106Engine._iterate"),
     ("RT106", "Rt106ShardedEngine._iterate"),    # builder on the hot path
+    ("RT106", "Rt106SpecEngine._iterate"),       # verify-step builder
 }
 
 

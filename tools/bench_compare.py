@@ -58,9 +58,14 @@ from typing import Dict, List, Tuple
 # watchdog_trips: the fused step compiles ONCE per engine config, and
 # any retrace on the candidate side is the PR 2 ~10x partitioner drag
 # sneaking back into the hot loop — a bug, not noise.
+# accepted_per_step is the speculative-decoding amortization metric
+# (lm_spec_decode A/B): mean EXTRA tokens each fused verify step
+# bought — a candidate whose drafter stops matching (or whose verify
+# window shrinks) regresses DOWN. acceptance_rate itself archives as
+# _info: it depends on the trace's repetitiveness, not on the code.
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
                   "capacity_seqs", "prefill_tokens_saved",
-                  "prefix_hit_rate")
+                  "prefix_hit_rate", "accepted_per_step")
 _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
                  "kv_bytes_per_device", "decode_step_retraces",
                  "watchdog_trips", "lock_order_violations")

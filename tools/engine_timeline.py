@@ -13,7 +13,10 @@ post-hoc capacity questions the ring exists for:
   and per time bucket (a prefill-heavy stripe is an admission wave, a
   decode-only tail is the drain);
 * **what was the engine holding** — mean/peak live slots, queue depth
-  and max queue age per bucket, pool occupancy when paged.
+  and max queue age per bucket, pool occupancy when paged;
+* **was speculation earning its keep** — drafts verified vs accepted
+  per bucket as an acceptance-rate strip (spec engines only; pre-PR-11
+  dumps and ``spec_k=0`` rings render without it).
 
 Usage::
 
@@ -80,6 +83,17 @@ def timeline_report(records: List[Dict[str, Any]], buckets: int = 40,
     # absent in older dumps — both render as "no cache data")
     report["peak_shared"] = max(
         (r.get("pool_shared", -1) for r in records), default=-1)
+    # speculative decoding: drafts verified/accepted ride every record
+    # since the spec-decode PR (-1 on spec_k=0 engines; absent in older
+    # dumps — both render as "no spec data" and skip the strip)
+    spec_prop = sum(max(0, r.get("spec_proposed", -1)) for r in records)
+    spec_acc = sum(max(0, r.get("spec_accepted", -1)) for r in records)
+    report["spec_enabled"] = any(
+        r.get("spec_proposed", -1) >= 0 for r in records)
+    report["spec_proposed"] = spec_prop
+    report["spec_accepted"] = spec_acc
+    report["acceptance_rate"] = (spec_acc / spec_prop if spec_prop
+                                 else 0.0)
     if not records:
         return report
     t0 = records[0]["ts"] - records[0]["busy_ms"] / 1e3
@@ -90,7 +104,8 @@ def timeline_report(records: List[Dict[str, Any]], buckets: int = 40,
     rows: List[Dict[str, Any]] = [
         {"t_s": round(b * width, 6), "iters": 0, "busy_ms": 0.0,
          "prefill_toks": 0, "decode_toks": 0, "live_sum": 0, "live_max": 0,
-         "queue_max": 0, "queue_age_ms_max": 0.0, "shared_max": -1}
+         "queue_max": 0, "queue_age_ms_max": 0.0, "shared_max": -1,
+         "spec_proposed": 0, "spec_accepted": 0}
         for b in range(n_buckets)]
     for r in records:
         b = min(n_buckets - 1, int((r["ts"] - t0) / width))
@@ -106,12 +121,17 @@ def timeline_report(records: List[Dict[str, Any]], buckets: int = 40,
                                       r["queue_age_ms"])
         row["shared_max"] = max(row["shared_max"],
                                 r.get("pool_shared", -1))
+        row["spec_proposed"] += max(0, r.get("spec_proposed", -1))
+        row["spec_accepted"] += max(0, r.get("spec_accepted", -1))
     for row in rows:
         row["busy_frac"] = min(1.0, row["busy_ms"] / (width * 1e3))
         row["live_mean"] = (row["live_sum"] / row["iters"]
                             if row["iters"] else 0.0)
         toks = row["prefill_toks"] + row["decode_toks"]
         row["prefill_share"] = row["prefill_toks"] / toks if toks else 0.0
+        row["acceptance_rate"] = (row["spec_accepted"]
+                                  / row["spec_proposed"]
+                                  if row["spec_proposed"] else 0.0)
         del row["live_sum"]
     report["buckets"] = rows
     return report
@@ -141,6 +161,11 @@ def render(report: Dict[str, Any], name: str = "") -> str:
         f"{report['peak_live']}"
         + (f"; peak shared KV blocks {report['peak_shared']}"
            if report.get("peak_shared", -1) >= 0 else ""))
+    if report.get("spec_enabled"):
+        lines.append(
+            f"speculation: {report['spec_proposed']} drafts verified, "
+            f"{report['spec_accepted']} accepted "
+            f"({report['acceptance_rate']:.1%} acceptance)")
     if report["gaps"]:
         worst = ", ".join(f"{g['gap_ms']:.1f}ms@{g['t_s']:.3f}s"
                           for g in report["gaps"])
@@ -153,11 +178,19 @@ def render(report: Dict[str, Any], name: str = "") -> str:
                      f"(scale: '{_BARS[0]}'=0 .. '{_BARS[-1]}'=1, "
                      f"{report['wall_s'] / len(report['buckets']):.3f}s "
                      f"per column)")
+        has_spec = report.get("spec_enabled", False)
+        if has_spec:
+            # acceptance over time: a fading strip is the drafter losing
+            # the tail (e.g. traffic left its repetitive regime)
+            acc = "".join(_bar(b["acceptance_rate"])
+                          for b in report["buckets"])
+            lines.append(f"acceptance    |{acc}|")
         has_shared = report.get("peak_shared", -1) >= 0
         lines.append(f"{'t_s':>8} {'iters':>6} {'busy':>6} {'live':>6} "
                      f"{'qmax':>5} {'qage_ms':>8} {'prefill':>8} "
                      f"{'decode':>8}"
-                     + (f" {'shared':>7}" if has_shared else ""))
+                     + (f" {'shared':>7}" if has_shared else "")
+                     + (f" {'accept':>7}" if has_spec else ""))
         for b in report["buckets"]:
             if not b["iters"]:
                 continue
@@ -168,6 +201,8 @@ def render(report: Dict[str, Any], name: str = "") -> str:
                 f"{b['decode_toks']:8d}")
             if has_shared:
                 line += f" {max(0, b.get('shared_max', 0)):7d}"
+            if has_spec:
+                line += f" {b['acceptance_rate']:7.1%}"
             lines.append(line)
     return "\n".join(lines)
 

