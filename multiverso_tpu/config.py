@@ -336,6 +336,25 @@ define_float("slo_itl_ms", 0.0,
 define_float("slo_lat_ms", 0.0,
              "serving SLO: p99 enqueue-to-reply latency target per "
              "micro-batched model; 0 = no SLO registered")
+define_bool("obs_plane", False,
+            "fleet observability plane: run a per-node ObsAgent shipping "
+            "bounded delta reports (changed Dashboard rows + interval "
+            "deltas, log-bucketed histogram exports, per-engine "
+            "stats/health/watchdog/flight summaries, tail-kept spans) "
+            "over the p2p wire to the rank-0 ObsCollector, which sums "
+            "counters exactly, merges histograms into fleet percentiles, "
+            "computes fleet SLO burn, flags silent nodes DEGRADED, and "
+            "assembles cross-process traces into one Perfetto doc "
+            "(docs/OBSERVABILITY.md 'Fleet plane'). Single-process "
+            "sessions run agent+collector in loopback")
+define_int("obs_report_ms", 1000,
+           "fleet plane: per-node report interval; a node silent for 2 "
+           "report intervals is flagged DEGRADED by the collector")
+define_string("obs_jsonl", "",
+              "fleet plane: additionally append every shipped report as "
+              "one JSON line here (multi-process sessions suffix .<rank>) "
+              "— the offline archive tools/opscenter.py renders the "
+              "fleet table / merged Prometheus / merged Perfetto from")
 define_bool("lockwatch", False,
             "runtime lock-order witness: record per-thread acquisition "
             "order of every framework lock into a global DAG; a cycle "

@@ -16,6 +16,7 @@ not just async dispatch.
 from __future__ import annotations
 
 import json
+import math
 import re
 import threading
 from .analysis import lockwatch
@@ -82,6 +83,96 @@ class Monitor:
                 f"[{self.name}] count = {self.count} total = {self.total_ms:.3f} ms "
                 f"avg = {avg:.3f} ms"
             )
+
+
+# -- mergeable log-bucket export ---------------------------------------------
+#
+# Exact sample windows cannot be merged across processes (shipping 65536
+# floats per histogram per report interval would BE the fleet's traffic), so
+# the fleet observability plane ships log-bucketed digests instead
+# (DDSketch/Prometheus-native-histogram shape): bucket i holds samples in
+# (BUCKET_BASE**i, BUCKET_BASE**(i+1)], merge = per-index count addition,
+# and any percentile read off merged counts returns the containing bucket's
+# geometric midpoint BUCKET_BASE**(i + 0.5).
+#
+# Error bound: a sample in bucket i is within a factor of BUCKET_BASE**0.5
+# of that midpoint, so every percentile-from-buckets value is within
+# BUCKET_REL_ERROR (= BUCKET_BASE**0.5 - 1, ~9.05% at base 2**0.25) of the
+# exact nearest-rank percentile over the pooled samples — bucketing is
+# monotone, so the rank-r sample of the pooled window lands in exactly the
+# bucket the merged cumulative walk stops in (tests assert the bound on
+# randomized multi-node splits). Values <= 0 land in a dedicated "zero"
+# bucket that sorts below every indexed one and reads back as 0.0.
+
+BUCKET_BASE = 2 ** 0.25
+BUCKET_REL_ERROR = BUCKET_BASE ** 0.5 - 1
+_BUCKET_LOG = math.log(BUCKET_BASE)
+
+
+def bucket_index(value_ms: float) -> Optional[int]:
+    """Log-bucket index for one sample (None = the zero bucket)."""
+    if value_ms <= 0.0:
+        return None
+    return math.floor(math.log(value_ms) / _BUCKET_LOG)
+
+
+def bucket_value(index: int) -> float:
+    """The bucket's representative: the geometric midpoint of its edges."""
+    return BUCKET_BASE ** (index + 0.5)
+
+
+def merge_buckets(exports: List[Optional[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Sum per-index counts across node exports (:meth:`Histogram.buckets`
+    dicts; ``None`` entries — nodes without that histogram — are skipped).
+    Counts key as strings because the exports ride JSON wire records."""
+    counts: Dict[str, int] = {}
+    zero = 0
+    count = 0
+    for ex in exports:
+        if not ex:
+            continue
+        zero += int(ex.get("zero", 0))
+        count += int(ex.get("count", 0))
+        for k, n in ex.get("counts", {}).items():
+            counts[str(k)] = counts.get(str(k), 0) + int(n)
+    return {"base": BUCKET_BASE, "count": count, "zero": zero,
+            "counts": counts}
+
+
+def bucket_percentile(export: Dict[str, Any], p: float) -> float:
+    """Nearest-rank percentile over a (possibly merged) bucket export —
+    same rank formula as :meth:`Histogram._rank`, walked over cumulative
+    bucket counts, returning the containing bucket's midpoint (so the
+    result is within :data:`BUCKET_REL_ERROR` of the pooled-sample
+    truth)."""
+    counts = export.get("counts", {})
+    zero = int(export.get("zero", 0))
+    n = zero + sum(int(v) for v in counts.values())
+    if n == 0:
+        return 0.0
+    rank = min(n - 1, max(0, int(round(p / 100.0 * (n - 1)))))
+    if rank < zero:
+        return 0.0
+    seen = zero
+    for idx in sorted(int(k) for k in counts):
+        seen += int(counts[str(idx)])
+        if rank < seen:
+            return bucket_value(idx)
+    return bucket_value(max(int(k) for k in counts))   # pragma: no cover
+
+
+def bucket_breach_frac(export: Dict[str, Any], threshold_ms: float) -> float:
+    """Fraction of the bucketed window above ``threshold_ms`` (the fleet
+    SLO burn numerator). Bucket-granular: a bucket counts as breaching
+    when its representative midpoint exceeds the threshold, so the
+    answer is exact to within the one bucket straddling the target."""
+    counts = export.get("counts", {})
+    n = int(export.get("zero", 0)) + sum(int(v) for v in counts.values())
+    if n == 0:
+        return 0.0
+    over = sum(int(v) for k, v in counts.items()
+               if bucket_value(int(k)) > threshold_ms)
+    return over / n
 
 
 class Histogram:
@@ -201,6 +292,29 @@ class Histogram:
             "mean_ms": sum(data) / len(data),
             "max_ms": data[-1],
         }, data)
+
+    def buckets(self) -> Dict[str, Any]:
+        """Log-bucket export of the retained window (the mergeable form
+        the fleet observability plane ships): ``{"base", "count"
+        (lifetime), "n" (window), "zero", "counts": {str(index):
+        count}}``. One window copy, no sort; see the module-level
+        bucket notes for the merge rule and the documented
+        :data:`BUCKET_REL_ERROR` percentile bound."""
+        with self._lock:
+            count = self.count
+            data = (list(self._buf) if self._n == len(self._buf)
+                    else self._buf[: self._n])
+        counts: Dict[str, int] = {}
+        zero = 0
+        for v in data:
+            idx = bucket_index(v)
+            if idx is None:
+                zero += 1
+            else:
+                key = str(idx)
+                counts[key] = counts.get(key, 0) + 1
+        return {"base": BUCKET_BASE, "count": count, "n": len(data),
+                "zero": zero, "counts": counts}
 
     def info_string(self) -> str:
         s = self.summary()
@@ -591,6 +705,43 @@ _MONOTONE_STATS = frozenset({
 })
 
 
+def snapshot_deltas(prev: Optional[Dict[str, Dict[str, Any]]],
+                    snap: Dict[str, Dict[str, Any]],
+                    dt: Optional[float]) -> Dict[str, Dict[str, float]]:
+    """Interval deltas of the monotonic stats between two snapshots —
+    THE delta semantics, shared by :class:`MetricsExporter` and the
+    fleet observability plane's per-node reports
+    (``serving/obs_plane.py``), so the JSONL reporter and the wire can
+    never drift on what counts as a rate.
+
+    Covers the ``_MONOTONE_STATS`` fields only. An instrument whose
+    monotonic stats went BACKWARDS (reset mid-interval) reports no
+    delta rather than a negative rate; an instrument absent from
+    ``prev`` (or whose type changed) is skipped for this interval and
+    picked up on the next one."""
+    if prev is None or not dt or dt <= 0:
+        return {}
+    deltas: Dict[str, Dict[str, float]] = {}
+    for name, row in snap.items():
+        last = prev.get(name)
+        if last is None or last.get("type") != row.get("type"):
+            continue
+        kind = row.get("type")
+        d: Dict[str, float] = {}
+        for field, value in row.items():
+            if (kind, field) not in _MONOTONE_STATS:
+                continue
+            diff = value - last.get(field, 0)
+            if diff < 0:
+                d = {}
+                break               # instrument was reset mid-interval
+            d[field] = diff
+            d[f"{field}_per_s"] = diff / dt
+        if d:
+            deltas[name] = d
+    return deltas
+
+
 def _prom_split(name: str):
     """``SERVE_TTFT[lm]`` -> (``serve_ttft``, ``lm``); plain names pass
     through with no instance label. The bracket convention is how every
@@ -615,8 +766,8 @@ def _prom_format(value: Any) -> str:
     return repr(float(value)) if isinstance(value, float) else str(value)
 
 
-def render_prometheus(snapshot: Optional[Dict[str, Dict[str, Any]]] = None
-                      ) -> str:
+def render_prometheus(snapshot: Optional[Dict[str, Dict[str, Any]]] = None,
+                      labels: Optional[Dict[str, str]] = None) -> str:
     """Prometheus text exposition of a :meth:`Dashboard.snapshot`.
 
     One sample per (instrument, stat field): the histogram
@@ -626,9 +777,14 @@ def render_prometheus(snapshot: Optional[Dict[str, Dict[str, Any]]] = None
     round-trip test can reconstruct the snapshot from the text).
     Monotonic stats (counter values, monitor/histogram counts,
     monitor total_ms) carry ``# TYPE counter``; everything else is a
-    gauge.
+    gauge. ``labels`` appends fixed extra labels to every sample — the
+    fleet plane renders each node's registry with ``{"node": "<rank>"}``
+    so one scrape surface covers the whole fleet without name
+    collisions (``parse_prometheus`` tolerates the extra labels).
     """
     snap = Dashboard.snapshot() if snapshot is None else snapshot
+    extra = "".join(f',{k}="{_prom_escape(str(v))}"'
+                    for k, v in sorted((labels or {}).items()))
     families: Dict[str, List[str]] = {}
     family_type: Dict[str, str] = {}
     for name in sorted(snap):
@@ -637,16 +793,20 @@ def render_prometheus(snapshot: Optional[Dict[str, Dict[str, Any]]] = None
         metric, instance = _prom_split(name)
         for field in sorted(row):
             value = row[field]
+            if not isinstance(value, (int, float)) or isinstance(value,
+                                                                 bool):
+                continue            # wire-merged rows may carry strings
             full = (f"mv_{metric}" if field == "value"
                     else f"mv_{metric}_{field}")
             monotone = (kind, field) in _MONOTONE_STATS
-            labels = f'name="{_prom_escape(name)}"'
+            sample_labels = f'name="{_prom_escape(name)}"'
             if instance is not None:
-                labels += f',instance="{_prom_escape(instance)}"'
+                sample_labels += f',instance="{_prom_escape(instance)}"'
+            sample_labels += extra
             family_type.setdefault(full,
                                    "counter" if monotone else "gauge")
             families.setdefault(full, []).append(
-                f"{full}{{{labels}}} {_prom_format(value)}")
+                f"{full}{{{sample_labels}}} {_prom_format(value)}")
     lines: List[str] = []
     for full in sorted(families):
         lines.append(f"# TYPE {full} {family_type[full]}")
@@ -722,27 +882,9 @@ class MetricsExporter:
     # -- one report ---------------------------------------------------------
     def _deltas(self, snap: Dict[str, Dict[str, Any]],
                 dt: Optional[float]) -> Dict[str, Dict[str, float]]:
-        if self._last is None or not dt or dt <= 0:
-            return {}
-        deltas: Dict[str, Dict[str, float]] = {}
-        for name, row in snap.items():
-            prev = self._last.get(name)
-            if prev is None or prev.get("type") != row.get("type"):
-                continue
-            kind = row.get("type")
-            d: Dict[str, float] = {}
-            for field, value in row.items():
-                if (kind, field) not in self._MONOTONE:
-                    continue
-                diff = value - prev.get(field, 0)
-                if diff < 0:
-                    d = {}
-                    break               # instrument was reset mid-interval
-                d[field] = diff
-                d[f"{field}_per_s"] = diff / dt
-            if d:
-                deltas[name] = d
-        return deltas
+        # the shared helper IS the semantics; this wrapper only binds the
+        # exporter's last-snapshot state
+        return snapshot_deltas(self._last, snap, dt)
 
     def report_once(self) -> dict:
         """Take one snapshot, compute interval deltas, write one line.

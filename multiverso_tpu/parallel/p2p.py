@@ -84,7 +84,8 @@ class P2PTransport:
     def __init__(self, rank: int, size: int, client,
                  label: str = "mvps", connect_timeout_s: float = 60.0,
                  initial_resume: Optional[Dict[int, int]] = None,
-                 on_dead=None) -> None:
+                 on_dead=None,
+                 subscribe_to: Optional[List[int]] = None) -> None:
         self._rank = rank
         self._size = size
         self._client = client
@@ -125,7 +126,15 @@ class P2PTransport:
         client.key_value_set(f"{label}/ep/{rank}",
                              f"{_local_host()}:{port}", allow_overwrite=True)
         self._spawn(self._accept_loop, "p2p-accept")
-        for r in self._in:
+        # records flow publisher -> subscriber, so which streams exist
+        # is the SUBSCRIBER's choice: the default (None) is the bus's
+        # full mesh, while a hub-topology plane (the obs collector is
+        # the only consumer) subscribes each rank to exactly the peers
+        # it reads — an empty list publishes only, and no redundant
+        # copy of any record ever crosses the wire
+        subs = list(self._in) if subscribe_to is None else [
+            r for r in subscribe_to if r in self._in]
+        for r in subs:
             self._spawn(self._subscribe, f"p2p-sub-{r}", r,
                         connect_timeout_s)
 

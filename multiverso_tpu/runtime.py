@@ -42,6 +42,7 @@ class Session:
         self.async_bus: Optional[Any] = None  # cross-process async PS plane
         self.failure_detector: Optional[Any] = None  # -failure_timeout_s
         self.metrics_exporter: Optional[Any] = None  # -metrics_jsonl
+        self.obs_agent: Optional[Any] = None  # -obs_plane fleet agent
         # stop() handshake: the claiming caller's completion event +
         # thread id, so a concurrent stop() can wait for the teardown
         # to finish without wedging the Session lock behind it
@@ -135,6 +136,24 @@ class Session:
                     timeout,
                     self.async_bus.mark_dead
                     if self.async_bus is not None else None)
+            if config.get_flag("obs_plane") and self.obs_agent is None:
+                # the fleet observability plane: one agent per node
+                # (rank 0 doubles as collector); single-process sessions
+                # run it in loopback — same reports, no sockets
+                from .serving.obs_plane import ObsAgent
+
+                client = None
+                if self.size > 1:
+                    from jax._src import distributed
+
+                    client = distributed.global_state.client
+                sink = config.get_flag("obs_jsonl")
+                if sink and self.size > 1:
+                    sink = f"{sink}.{self.rank}"
+                self.obs_agent = ObsAgent(
+                    rank=self.rank, size=self.size, client=client,
+                    report_ms=int(config.get_flag("obs_report_ms")),
+                    sink=sink)
             Log.info(
                 "multiverso-tpu initialised: rank %d/%d, mesh %s, mode %s",
                 self.rank, self.size, dict(self.topo.mesh.shape),
@@ -177,18 +196,27 @@ class Session:
                 detector, self.failure_detector = self.failure_detector, None
                 bus, self.async_bus = self.async_bus, None
                 exporter, self.metrics_exporter = self.metrics_exporter, None
+                obs, self.obs_agent = self.obs_agent, None
         if not claimed:
             if wait:
                 done.wait()
             return
         try:
-            self._teardown(topo, servers, tables, detector, bus, exporter)
+            self._teardown(topo, servers, tables, detector, bus, exporter,
+                           obs)
         finally:
             done.set()
 
     def _teardown(self, topo, servers, tables, detector, bus,
-                  exporter) -> None:
-        # serving drains first: in-flight replies read tables, so the
+                  exporter, obs=None) -> None:
+        # the obs agent ships its FINAL report first, while the engines
+        # it summarizes are still alive to be read
+        if obs is not None:
+            try:
+                obs.stop(final_report=True)
+            except Exception as exc:
+                Log.error("obs plane shutdown failed: %s", exc)
+        # serving drains next: in-flight replies read tables, so the
         # inference plane must quiesce before any table is torn down
         for srv in servers:
             try:

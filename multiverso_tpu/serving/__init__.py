@@ -37,6 +37,7 @@ from .block_pool import (BlockPool, blocks_for_bytes, chain_hashes,
                          kv_bytes_per_block)
 from .decode_engine import DecodeEngine, DecodeEngineConfig
 from .flight_recorder import FlightRecorder
+from .obs_plane import ObsAgent, ObsCollector
 from .server import InferenceServer
 from .snapshot import Snapshot, SnapshotManager
 from .watchdog import EngineWatchdog, WatchdogConfig
@@ -49,5 +50,5 @@ __all__ = [
     "EmbeddingNeighbors", "FTRLPredict", "LMGreedyDecode", "LogRegPredict",
     "DecodeEngine", "DecodeEngineConfig", "BlockPool", "blocks_for_bytes",
     "chain_hashes", "kv_bytes_per_block", "FlightRecorder",
-    "EngineWatchdog", "WatchdogConfig",
+    "EngineWatchdog", "WatchdogConfig", "ObsAgent", "ObsCollector",
 ]

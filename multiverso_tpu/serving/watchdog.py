@@ -108,6 +108,10 @@ class EngineWatchdog:
         # true total
         self.trips: Deque[Tuple[str, str, Optional[str]]] = (
             collections.deque(maxlen=64))
+        # sequence-stamped twin of `trips` for the fleet plane's
+        # exactly-once forwarding (`trips_since`); same bound
+        self._trip_log: Deque[Tuple[int, str, str, Optional[str]]] = (
+            collections.deque(maxlen=64))
         self._trips_total = 0
         self.bundles = 0
         self.checks = 0
@@ -124,6 +128,31 @@ class EngineWatchdog:
     @property
     def trip_count(self) -> int:
         return self._trips_total
+
+    def trips_since(self, cursor: int):
+        """``(new_cursor, trips newer than cursor)`` — the fleet plane's
+        incremental read: the per-node ``ObsAgent`` forwards every trip
+        to the ``ObsCollector`` exactly once by passing back the cursor
+        a previous call returned (start at 0). Trips come back oldest
+        first as ``(kind, reason, bundle_dir)`` tuples. Each trip is
+        sequence-stamped AT APPEND (``_trip_log``), so the read never
+        double-reports a trip that lands mid-call; the log keeps only
+        the newest 64 — a cursor further back than that gets the
+        retained suffix while ``trip_count`` carries the true total."""
+        log: List[Tuple[int, str, str, Optional[str]]] = []
+        for _ in range(8):
+            try:
+                log = list(self._trip_log)
+                break
+            except RuntimeError:
+                # the watchdog thread appended mid-copy (deque iterators
+                # detect concurrent mutation); retry — the next copy
+                # simply includes the new trip
+                continue
+        new = [(k, r, b) for seq, k, r, b in log if seq > cursor]
+        if new:
+            cursor = log[-1][0]      # same copy the filter saw
+        return cursor, new
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "EngineWatchdog":
@@ -249,6 +278,7 @@ class EngineWatchdog:
                           self.engine.name, exc)
         self.trip_counter.inc()
         self.trips.append((kind, reason, bundle))
+        self._trip_log.append((self._trips_total, kind, reason, bundle))
         Log.error("watchdog[%s] TRIPPED (%s): %s — bundle: %s",
                   self.engine.name, kind, reason,
                   bundle or "none (-debug_dump_dir unset)")

@@ -55,7 +55,7 @@ __all__ = [
     "Span", "SpanContext", "TailConfig", "TraceCollector", "collector",
     "enabled", "enable", "disable", "resume", "start_span", "span",
     "record_span", "current_span", "current_context", "export_chrome",
-    "validate_chrome_events",
+    "span_from_dict", "validate_chrome_events",
 ]
 
 # Span/trace ids: process-unique, allocation-cheap. itertools.count is
@@ -151,6 +151,18 @@ class Span:
     def duration_ms(self) -> float:
         return ((self.t1 if self.t1 is not None else time.monotonic())
                 - self.t0) * 1e3
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Wire form for cross-process shipping (the fleet observability
+        plane's report records): plain JSON-serializable fields,
+        timestamps still in the RECORDING process's monotonic clock —
+        the shipper sends its clock anchor alongside
+        (:meth:`TraceCollector.anchor`) so the collector rebases each
+        node to the shared epoch-µs export timebase."""
+        return {"name": self.name, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "t0": self.t0, "t1": self.t1, "thread": self.thread,
+                "attrs": dict(self.attrs)}
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (f"Span({self.name!r}, trace={self.trace_id:x}, "
@@ -356,6 +368,37 @@ class TraceCollector:
                 out = self._buf[self._pos:] + self._buf[: self._pos]
         return [s for s in out if s is not None]
 
+    def drain_since(self, cursor: int):
+        """``(new_cursor, spans recorded after cursor, missed)`` — the
+        fleet plane's incremental read. ``cursor`` is a previous call's
+        return (start at 0); spans come back oldest first. When more
+        spans were recorded since the cursor than the ring retains, the
+        overwritten ones are gone — ``missed`` counts them so the
+        shipper can report the loss instead of silently thinning the
+        fleet trace. ``start()``/``clear()`` reset ``recorded``, so a
+        stale cursor larger than it simply rebases to the new stream."""
+        with self._lock:
+            recorded = self.recorded
+            if cursor > recorded:
+                cursor = 0                     # ring was reset; rebase
+            n_new = recorded - cursor
+            if n_new <= 0:
+                return recorded, [], 0
+            take = min(n_new, self._n)
+            start = (self._pos - take) % self.capacity
+            if start < self._pos or take == 0:
+                out = self._buf[start: self._pos]
+            else:
+                out = self._buf[start:] + self._buf[: self._pos]
+        return recorded, [s for s in out if s is not None], n_new - take
+
+    def anchor(self):
+        """``(epoch s, monotonic s)`` captured at :meth:`start` — ships
+        with serialized spans so a collector in another process can
+        rebase them onto the shared epoch-µs export timebase."""
+        with self._lock:
+            return self._anchor_wall, self._anchor_mono
+
     def to_epoch_us(self, t_mono: float) -> float:
         """Rebase a monotonic timestamp to epoch microseconds (the
         export timebase, mergeable with device captures by range)."""
@@ -555,6 +598,16 @@ def record_span(name: str, parent: Optional[SpanContext], t0: float,
 
 def export_chrome(path: Optional[str] = None) -> dict:
     return _COLLECTOR.export_chrome(path)
+
+
+def span_from_dict(d: Dict[str, Any]) -> Span:
+    """Inverse of :meth:`Span.to_dict` (collector-side tests and any
+    consumer that wants Span objects back from wire records)."""
+    sp = Span(d["name"], int(d["trace_id"]), int(d["span_id"]),
+              d.get("parent_id"), float(d["t0"]), dict(d.get("attrs") or {}))
+    sp.t1 = d.get("t1")
+    sp.thread = d.get("thread", sp.thread)
+    return sp
 
 
 # -- validation (shared by the CI smoke test and tools) ----------------------

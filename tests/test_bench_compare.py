@@ -47,6 +47,13 @@ def test_metric_direction_rules():
     assert metric_direction("accepted_per_step") == 1
     assert metric_direction("speedup_spec") == 1
     assert metric_direction("acceptance_rate_info") == 0
+    # fleet plane (obs_plane A/B): dropped reports ride the
+    # zero-baseline rule — the plane's reports are bounded by design,
+    # so a drop on an idle loopback collector is a bug; its tok/s
+    # columns are noise-floor _info
+    assert metric_direction("obs_dropped_reports") == -1
+    assert metric_direction("tokens_per_s_obs_on_info") == 0
+    assert metric_direction("obs_reports_info") == 0
     # the _info suffix overrides every pattern rule: measured-but-noisy
     # columns ride the archive without flapping the gate
     assert metric_direction("tokens_per_s_info") == 0

@@ -203,3 +203,73 @@ def test_engine_records_iterations_without_new_traces(mv_session):
     # ring timestamps are monotonic, busy fits inside the gap walls
     ts = [r["ts"] for r in recs]
     assert ts == sorted(ts)
+
+
+# -- fleet merge (--merge) ----------------------------------------------------
+
+def _write_dump(path, name, n, t_start, anchor_epoch=None):
+    """Hand-written JSONL dump: anchored (epoch rebase) or legacy (no
+    anchor fields — the pre-fleet-plane format the merge must tolerate
+    per the PR 8/11 old-dump pattern)."""
+    meta = {"name": name, "capacity": 64, "total": n, "retained": n,
+            "fields": list(FIELDS)}
+    if anchor_epoch is not None:
+        meta["anchor_epoch_s"] = anchor_epoch
+        meta["anchor_mono_s"] = 0.0
+    with open(path, "w") as f:
+        f.write(json.dumps({"flight_recorder": meta}) + "\n")
+        for i in range(n):
+            rec = dict(zip(FIELDS, _rec(i + 1, t_start + i * 0.01,
+                                        busy=5.0, step=4.0, live=2,
+                                        decode=2)))
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_merge_aligns_replicas_on_shared_timebase(tmp_path):
+    """tools/engine_timeline.py --merge: two anchored replica dumps
+    align by EPOCH time (node1 started 100 ms later, so its busy strip
+    starts further right), a legacy no-anchor dump still renders
+    (origin-aligned, flagged '~'), and each node's digest row carries
+    its own totals."""
+    from tools.engine_timeline import merge_report, render_merge
+
+    _write_dump(tmp_path / "r0.jsonl", "node0", 20, 0.0,
+                anchor_epoch=1000.0)
+    _write_dump(tmp_path / "r1.jsonl", "node1", 10, 0.1,
+                anchor_epoch=1000.0)
+    _write_dump(tmp_path / "rold.jsonl", "old", 10, 50.0)  # legacy
+    dumps = [load_ring(str(tmp_path / p))
+             for p in ("r0.jsonl", "r1.jsonl", "rold.jsonl")]
+    report = merge_report(dumps, buckets=20)
+    assert [n["name"] for n in report["nodes"]] == ["node0", "node1",
+                                                    "old"]
+    n0, n1, old = report["nodes"]
+    assert n0["aligned"] == n1["aligned"] == "epoch"
+    assert old["aligned"] == "origin"
+    # the shared window opens at node0's first work start (epoch 1000)
+    assert report["t0_epoch_s"] == pytest.approx(1000.0 - 0.005)
+    # node1 began 100 ms in: its first busy bucket sits right of
+    # node0's, and both strips end inside the shared window
+    first_busy = [next(i for i, f in enumerate(n["strip"]) if f > 0)
+                  for n in (n0, n1)]
+    assert first_busy[1] > first_busy[0]
+    # the legacy dump origin-aligns: its strip starts at column 0
+    # (its own monotonic clock says 50 s, which would otherwise land
+    # far outside the window)
+    assert old["strip"][0] > 0
+    assert n0["decode_tokens"] == 40 and n1["decode_tokens"] == 20
+    text = render_merge(report)
+    assert "node0 |" in text and "old~|" in text
+    assert "3 node(s)" in text
+
+
+def test_merge_cli(tmp_path):
+    _write_dump(tmp_path / "a.jsonl", "a", 5, 0.0, anchor_epoch=10.0)
+    _write_dump(tmp_path / "b.jsonl", "b", 5, 0.0, anchor_epoch=10.1)
+    assert main(["--merge", str(tmp_path / "a.jsonl"),
+                 str(tmp_path / "b.jsonl")]) == 0
+    # multiple dumps without --merge is a usage error, loudly
+    with pytest.raises(SystemExit):
+        main([str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")])
+    # single-dump path unchanged
+    assert main([str(tmp_path / "a.jsonl"), "--buckets", "4"]) == 0

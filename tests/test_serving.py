@@ -528,3 +528,33 @@ def test_register_decoder_losing_race_to_stop_stops_the_engine(
     assert result and "stopped during" in result[0]
     assert stopped == ["lm"], "racing engine was never stopped"
     assert "lm" not in srv._models
+
+
+@pytest.mark.slow
+def test_obs_plane_ab_zero_dropped_reports(mv_session):
+    """The serving_bench obs-plane A/B: no agents vs a real two-rank
+    wire plane (publisher sockets + collector drain/ack) on the warm
+    engine. The gated number is the publisher's obs_dropped_reports —
+    with a live, acking collector the bounded publish window must
+    never fill, so a drop means the ack/release machinery broke; tok/s
+    columns archive as noise-floor _info."""
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import InferenceServer
+    from tools.serving_bench import _obs_plane_ab, _play_decode_trace
+
+    srv = InferenceServer("t")
+    cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                            n_layers=2, d_ff=256, max_seq=80)
+    engine = srv.register_decoder(
+        "lm_obs", TransformerLM(cfg), slots=8, max_prompt=8, max_new=64,
+        max_queue=64, prompt_buckets=(8,))
+    engine.warmup()
+    _play_decode_trace(srv, "lm_obs",
+                       [(0.0, np.ones(4, np.int32), 2)] * 4, True)
+    row = _obs_plane_ab(srv, quick=True)
+    assert row["obs_dropped_reports"] == 0
+    assert row["obs_reports_info"] > 0
+    assert row["obs_collector_nodes_info"] == 2   # the wire rank landed
+    assert row["tokens_per_s_obs_off_info"] > 0
+    assert row["tokens_per_s_obs_on_info"] > 0

@@ -63,12 +63,17 @@ from typing import Dict, List, Tuple
 # bought — a candidate whose drafter stops matching (or whose verify
 # window shrinks) regresses DOWN. acceptance_rate itself archives as
 # _info: it depends on the trace's repetitiveness, not on the code.
+# dropped_reports (the obs_plane A/B's obs_dropped_reports) rides the
+# zero-baseline rule like watchdog_trips: the fleet plane's reports
+# are bounded BY DESIGN — a report dropped on an idle loopback
+# collector means the bound machinery broke, a bug, not noise.
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
                   "capacity_seqs", "prefill_tokens_saved",
                   "prefix_hit_rate", "accepted_per_step")
 _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
                  "kv_bytes_per_device", "decode_step_retraces",
-                 "watchdog_trips", "lock_order_violations")
+                 "watchdog_trips", "lock_order_violations",
+                 "dropped_reports")
 
 
 def metric_direction(name: str) -> int:

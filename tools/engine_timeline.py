@@ -22,6 +22,18 @@ Usage::
 
     python tools/engine_timeline.py RING.jsonl [--buckets 40]
         [--top-gaps 5]
+    python tools/engine_timeline.py --merge RING0.jsonl RING1.jsonl ...
+        [--buckets 60]
+
+``--merge`` takes one ring dump per replica and renders their
+utilization strips ALIGNED on a shared timebase: each dump's monotonic
+timestamps rebase to epoch through the anchor its meta line carries
+(``anchor_epoch_s``/``anchor_mono_s``), so a stall on node 1 lines up
+column-for-column with the admission wave on node 0 that caused it —
+the fleet-level "where did the wall go" view the obs plane's collector
+feeds on. Dumps predating the anchor fields still render (aligned at
+their own window start, flagged ``~`` for approximate) — the PR 8/11
+old-dump tolerance pattern.
 
 Pure host-side (no jax): loadable against a dump from any run,
 including one scraped out of a dead replica's watchdog bundle.
@@ -137,6 +149,89 @@ def timeline_report(records: List[Dict[str, Any]], buckets: int = 40,
     return report
 
 
+def merge_report(dumps, buckets: int = 60):
+    """Digest N ``(meta, records)`` dumps onto ONE shared timebase.
+
+    Anchored dumps (meta carries ``anchor_epoch_s``/``anchor_mono_s``)
+    rebase record timestamps to epoch seconds, so replicas align by
+    wall time; un-anchored (old) dumps can't — they align at the shared
+    window's origin and are marked ``aligned: "origin"`` so the render
+    flags them approximate instead of crashing or silently lying.
+
+    Returns ``{"wall_s", "t0_epoch_s", "nodes": [{"name", "aligned",
+    "iterations", "busy_frac", "prefill_tokens", "decode_tokens",
+    "peak_live", "strip": [busy_frac per bucket]}]}``.
+    """
+    rebased = []
+    for meta, records in dumps:
+        name = meta.get("name", "") or f"engine{len(rebased)}"
+        wall = meta.get("anchor_epoch_s")
+        mono = meta.get("anchor_mono_s")
+        anchored = isinstance(wall, (int, float)) and isinstance(
+            mono, (int, float))
+        recs = [dict(r) for r in records]
+        if anchored:
+            for r in recs:
+                r["ts"] = wall + (r["ts"] - mono)
+        rebased.append((name, anchored, recs))
+    # shared window: earliest work start to latest record, over the
+    # ANCHORED dumps; origin-aligned dumps shift to start at t0
+    starts = [r[0]["ts"] - r[0]["busy_ms"] / 1e3
+              for _, anchored, r in rebased if anchored and r]
+    t0 = min(starts) if starts else 0.0
+    for name, anchored, recs in rebased:
+        if not anchored and recs:
+            off = t0 - (recs[0]["ts"] - recs[0]["busy_ms"] / 1e3)
+            for r in recs:
+                r["ts"] += off
+    end = max((r[-1]["ts"] for _, _, r in rebased if r), default=t0)
+    wall = max(end - t0, 1e-9)
+    n_buckets = max(1, int(buckets))
+    width = wall / n_buckets
+    nodes = []
+    for name, anchored, recs in rebased:
+        strip = [0.0] * n_buckets
+        for r in recs:
+            b = min(n_buckets - 1, max(0, int((r["ts"] - t0) / width)))
+            strip[b] += r["busy_ms"]
+        digest = window_digest(recs)
+        nodes.append({
+            "name": name,
+            "aligned": "epoch" if anchored else "origin",
+            "iterations": len(recs),
+            "busy_frac": digest["busy_frac"],
+            "prefill_tokens": digest["prefill_tokens"],
+            "decode_tokens": digest["decode_tokens"],
+            "peak_live": digest["peak_live"],
+            "strip": [min(1.0, s / (width * 1e3)) for s in strip],
+        })
+    return {"wall_s": wall, "t0_epoch_s": t0, "buckets": n_buckets,
+            "nodes": nodes}
+
+
+def render_merge(report) -> str:
+    """Aligned per-node utilization strips + a per-node summary table."""
+    lines = [
+        f"fleet timeline: {len(report['nodes'])} node(s) over "
+        f"{report['wall_s']:.3f}s shared window "
+        f"({report['wall_s'] / report['buckets']:.3f}s per column; "
+        f"scale '{_BARS[0]}'=0 .. '{_BARS[-1]}'=1; '~' = old dump, "
+        f"origin-aligned)"]
+    width = max((len(n["name"]) for n in report["nodes"]), default=4)
+    for n in report["nodes"]:
+        strip = "".join(_bar(f) for f in n["strip"])
+        flag = " " if n["aligned"] == "epoch" else "~"
+        lines.append(f"{n['name']:>{width}}{flag}|{strip}|")
+    lines.append(f"{'node':>{width}} {'iters':>7} {'busy':>6} "
+                 f"{'prefill':>8} {'decode':>8} {'peak':>5}")
+    for n in report["nodes"]:
+        lines.append(
+            f"{n['name']:>{width}} {n['iterations']:>7} "
+            f"{n['busy_frac']:>6.1%} {n['prefill_tokens']:>8} "
+            f"{n['decode_tokens']:>8} {n['peak_live']:>5}")
+    return "\n".join(lines)
+
+
 _BARS = " .:-=+*#%@"
 
 
@@ -210,23 +305,41 @@ def render(report: Dict[str, Any], name: str = "") -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="utilization/bubble report from a flight-recorder dump")
-    ap.add_argument("ring", help="flight-recorder JSONL (engine."
-                                 "recorder.export_jsonl / watchdog bundle "
-                                 "ring.jsonl)")
-    ap.add_argument("--buckets", type=int, default=40,
-                    help="timeline columns (default 40)")
+    ap.add_argument("ring", nargs="+",
+                    help="flight-recorder JSONL (engine."
+                         "recorder.export_jsonl / watchdog bundle "
+                         "ring.jsonl); several with --merge")
+    ap.add_argument("--merge", action="store_true",
+                    help="render the dumps (one per replica) as aligned "
+                         "per-node utilization strips on a shared "
+                         "timebase")
+    ap.add_argument("--buckets", type=int, default=None,
+                    help="timeline columns (default 40; 60 with --merge)")
     ap.add_argument("--top-gaps", type=int, default=5,
                     help="largest idle bubbles to list (default 5)")
     args = ap.parse_args(argv)
+    if len(args.ring) > 1 and not args.merge:
+        ap.error("multiple dumps need --merge")
+    buckets = args.buckets if args.buckets is not None else (
+        60 if args.merge else 40)
     try:
-        meta, records = load_ring(args.ring)
+        dumps = [load_ring(path) for path in args.ring]
     except (OSError, json.JSONDecodeError) as exc:
         print(f"engine_timeline: {exc}", file=sys.stderr)
         return 2
+    if args.merge:
+        dumps = [(m, r) for m, r in dumps if r]
+        if not dumps:
+            print("engine_timeline: no dump holds records",
+                  file=sys.stderr)
+            return 2
+        print(render_merge(merge_report(dumps, buckets)))
+        return 0
+    meta, records = dumps[0]
     if not records:
         print("engine_timeline: dump holds no records", file=sys.stderr)
         return 2
-    report = timeline_report(records, args.buckets, args.top_gaps)
+    report = timeline_report(records, buckets, args.top_gaps)
     print(render(report, meta.get("name", "")))
     return 0
 

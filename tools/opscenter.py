@@ -1,0 +1,130 @@
+"""Fleet ops center: render ObsCollector state from agent report archives.
+
+The fleet observability plane (``multiverso_tpu/serving/obs_plane.py``,
+``-obs_plane``) aggregates live inside the rank-0 collector; this tool
+is the OFFLINE half — point it at the per-node report archives the
+agents write (``-obs_jsonl=PATH`` appends one JSON line per shipped
+report, suffixed ``.<rank>`` in multi-process sessions), and it replays
+them through a fresh :class:`ObsCollector` to answer the fleet
+questions after the fact:
+
+* **default** — the fleet table: one row per node (liveness, reports,
+  tok/s, live sequences, watchdog trips, worst SLO burn), fleet-merged
+  histogram percentiles (log-bucketed, documented ±9.05% bound), and
+  fleet SLO burn. A node whose last report wall-timestamp trails the
+  fleet's newest by more than ``--silent-after`` (default 2x the median
+  report interval) renders **SILENT** — the offline analogue of the
+  live collector's DEGRADED flag.
+* ``--prom`` — the merged registry as one Prometheus text exposition,
+  every sample carrying a ``node`` label.
+* ``--trace OUT.json`` — the merged cross-process Perfetto document:
+  one process track per node, every node's tail-kept spans rebased onto
+  the shared epoch-µs timebase (open next to an xprof capture).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/opscenter.py reports.jsonl.0 \
+        reports.jsonl.1 reports.jsonl.2 [--prom] [--trace merged.json]
+        [--silent-after 2.5]
+
+Reading the table: docs/OBSERVABILITY.md "Fleet plane".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def load_reports(paths: List[str]) -> Tuple[List[dict], List[float]]:
+    """All reports from every archive, sorted by sender wall timestamp
+    (replay order must respect time so "latest row wins" holds), plus
+    the observed report intervals (the silent-threshold default)."""
+    reports: List[dict] = []
+    intervals: List[float] = []
+    for path in paths:
+        with open(path) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rep = json.loads(line)
+                except json.JSONDecodeError as exc:
+                    print(f"opscenter: {path}:{i + 1}: {exc}",
+                          file=sys.stderr)
+                    continue
+                if not isinstance(rep, dict) or "node" not in rep:
+                    continue
+                reports.append(rep)
+                dt = rep.get("interval_s")
+                if isinstance(dt, (int, float)) and dt > 0:
+                    intervals.append(float(dt))
+    reports.sort(key=lambda r: r.get("ts", 0.0))
+    return reports, intervals
+
+
+def build_collector(reports: List[dict]):
+    from multiverso_tpu.serving.obs_plane import ObsCollector
+
+    col = ObsCollector(name="opscenter")
+    for rep in reports:
+        col.ingest(int(rep["node"]), rep)
+    return col
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fleet table / merged Prometheus / merged Perfetto "
+                    "from obs-plane report archives (-obs_jsonl)")
+    ap.add_argument("reports", nargs="+",
+                    help="per-node report JSONL archives (one per node; "
+                         "-obs_jsonl writes them)")
+    ap.add_argument("--prom", action="store_true",
+                    help="print the merged registry as Prometheus text "
+                         "(node label per sample) instead of the table")
+    ap.add_argument("--trace", default="",
+                    help="write the merged cross-process Perfetto doc "
+                         "here (one process track per node)")
+    ap.add_argument("--silent-after", type=float, default=0.0,
+                    help="flag a node SILENT when its last report trails "
+                         "the fleet's newest by this many seconds "
+                         "(0 = 2x the median observed report interval)")
+    args = ap.parse_args(argv)
+    try:
+        reports, intervals = load_reports(args.reports)
+    except OSError as exc:
+        print(f"opscenter: {exc}", file=sys.stderr)
+        return 2
+    if not reports:
+        print("opscenter: no reports found in the archive(s)",
+              file=sys.stderr)
+        return 2
+    col = build_collector(reports)
+    silent_after = args.silent_after
+    if silent_after <= 0:
+        med = sorted(intervals)[len(intervals) // 2] if intervals else 1.0
+        silent_after = 2.0 * med
+    if args.trace:
+        from multiverso_tpu.trace import validate_chrome_events
+
+        doc = col.export_chrome(args.trace)
+        summary = validate_chrome_events(doc["traceEvents"])
+        print(f"merged trace: {args.trace} — {summary['spans']} span(s), "
+              f"{summary['traces']} trace(s) across "
+              f"{doc['otherData']['nodes']} node(s)")
+    if args.prom:
+        sys.stdout.write(col.prometheus())
+    else:
+        print(col.table(silent_after_s=silent_after))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

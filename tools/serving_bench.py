@@ -74,6 +74,15 @@ trace served with tracing disabled vs tail-sampled tracing on, both
 archived as gate-exempt ``_info`` columns — on the 2-CPU container the
 delta must sit inside the scheduling-noise floor.
 
+An ``obs_plane`` A/B prices the FLEET observability plane the same
+way: the warm engine serves the same trace with no agents vs a REAL
+two-rank plane at 100 ms reports — a wire publisher shipping full
+reports over localhost p2p sockets to a collector rank that drains,
+acks and merges. tok/s columns are ``_info``; the publisher's
+``obs_dropped_reports`` rides the bench_compare zero-baseline gate — a
+drop with a live, acking collector means the bounded-window/ack
+machinery broke, a bug.
+
 The JSON line also archives the FULL ``Dashboard.snapshot()`` (every
 Monitor/Histogram/Gauge/Counter/SLO), so a bench run preserves the
 complete instrument state — not just the hand-picked fields above —
@@ -849,6 +858,102 @@ def _lockwatch_ab(server, quick: bool):
     }
 
 
+class _ObsBenchKV:
+    """The three client calls the plane uses, backed by a local dict —
+    lets the A/B run the REAL two-rank wire (sockets, acks, retained
+    window) inside one bench process."""
+
+    def __init__(self):
+        import threading as _threading
+
+        self._d = {}
+        self._cv = _threading.Condition()
+
+    def key_value_set(self, key, val, allow_overwrite=False):
+        with self._cv:
+            self._d[key] = val
+            self._cv.notify_all()
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            while key not in self._d:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(f"NOT_FOUND: {key}")
+                self._cv.wait(left)
+            return self._d[key]
+
+    def key_value_try_get(self, key):
+        with self._cv:
+            if key not in self._d:
+                raise KeyError(f"NOT_FOUND: {key}")
+            return self._d[key]
+
+
+def _obs_plane_ab(server, quick: bool) -> dict:
+    """Prices the fleet observability plane (``-obs_plane``): the SAME
+    warm engine (``lm_obs``, registered by the observability A/B)
+    serves the same mixed-length trace with no agents vs a REAL
+    two-rank plane reporting every 100 ms — rank 1 builds full reports
+    (snapshot diff, shared-helper deltas, bucket exports, engine
+    stats/health/watchdog/flight summaries, span drain) and ships them
+    over actual localhost p2p sockets; rank 0 runs the collector,
+    draining/acking the stream and folding its own loopback reports.
+    Best-of-2 alternating passes; both tok/s columns are ``_info`` —
+    on the 2-CPU container the delta sits inside the scheduling-noise
+    floor — while ``obs_dropped_reports`` (the WIRE publisher's drop
+    counter) rides the zero-baseline gate: with a live, acking
+    collector the bounded publish window must never fill, so any drop
+    means the ack/release machinery broke — a bug, not noise.
+    """
+    from multiverso_tpu.serving.obs_plane import ObsAgent
+
+    # quick keeps the full 48-request trace (the lockwatch A/B's
+    # rationale: a shorter window puts one ~50 ms scheduler hiccup at
+    # >15% of the measurement and the off/on delta becomes a coin flip)
+    max_prompt, cap = 8, 64
+    n = 48
+    tr = _decode_trace(n, seed=43, max_prompt=max_prompt, max_new_cap=cap,
+                       mean_gap_s=0.0005, vocab=256, min_new=8)
+    useful = sum(n_new for _, _, n_new in tr)
+    tps = {"off": 0.0, "on": 0.0}
+    agent_stats = {}
+    collector_nodes = 0
+    for leg in range(2):
+        for label, on in (("off", False), ("on", True)):
+            agents = []
+            if on:
+                kv = _ObsBenchKV()
+                # rank 0 = collector (+ its own loopback reports),
+                # rank 1 = the wire publisher whose drop counter gates
+                agents = [ObsAgent(rank=r, size=2, client=kv,
+                                   report_ms=100,
+                                   label=f"bench_obs{leg}")
+                          for r in range(2)]
+            try:
+                _, elapsed = _play_decode_trace(server, "lm_obs", tr, True)
+            finally:
+                for a in reversed(agents):   # publisher flushes first
+                    a.stop(final_report=True)
+            tps[label] = max(tps[label], round(useful / elapsed, 1))
+            if agents:
+                agent_stats = agents[1].stats()
+                collector_nodes = len(agents[0].collector.nodes())
+    return {
+        "requests": n,
+        "useful_tokens": useful,
+        "tokens_per_s_obs_off_info": tps["off"],
+        "tokens_per_s_obs_on_info": tps["on"],
+        "obs_overhead_frac_info": (
+            round(1.0 - tps["on"] / tps["off"], 4) if tps["off"] else 0.0),
+        "obs_reports_info": agent_stats.get("reports", 0),
+        "obs_spans_shipped_info": agent_stats.get("spans_shipped", 0),
+        "obs_collector_nodes_info": collector_nodes,
+        "obs_dropped_reports": agent_stats.get("dropped_reports", 0),
+    }
+
+
 def _warm(workload, snap_mgr, buckets) -> None:
     """Compile every bucket outside the timed loop (and outside the
     latency histogram)."""
@@ -966,6 +1071,11 @@ def run(duration_s: float = 2.0, clients: int = 32,
     # tok/s (both _info — the delta lives under the noise floor) plus
     # the zero-baseline lock_order_violations gate
     out["workloads"]["lockwatch"] = _lockwatch_ab(server, quick)
+    # obs-plane A/B rides the same warm engine: no agents vs a real
+    # two-rank wire plane (publisher sockets + collector drain/ack) at
+    # 100 ms reports — tok/s _info, the publisher's 0 dropped reports
+    # gated (zero-baseline, like watchdog_trips)
+    out["workloads"]["obs_plane"] = _obs_plane_ab(server, quick)
     for name, (workload, knobs, n_clients, payload_fn) in specs.items():
         server.register(name, workload, **knobs)
         server.register(f"{name}_b1", workload, max_batch=1,

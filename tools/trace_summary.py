@@ -113,6 +113,10 @@ def load_host_spans(path: str):
                 "dur": float(e.get("ts", 0.0)) - float(b.get("ts", 0.0)),
                 "trace_id": args.get("trace_id"),
                 "parent_id": args.get("parent_id"),
+                # the recording process: os.getpid() in a single-node
+                # export, the node rank in the obs-plane collector's
+                # merged fleet doc — the report's grouping key half
+                "node": e.get("pid"),
                 "args": args,
             })
     return spans
@@ -134,16 +138,24 @@ def request_report(spans, device_events=None):
     """Per-request rows from host spans (+ optional device-time merge).
 
     A request = one root span (no parent_id) and every span sharing its
-    trace id. Device events (xprof, ``device_duration_ps``) are merged
-    BY TIME RANGE: the two timelines are aligned on their first events,
-    then device-op time inside a request's window is attributed to it
-    (overlapping requests both count a shared interval — attribution,
-    not accounting).
+    **(node, trace id)** — node being the recording pid (the node rank
+    in an obs-plane merged fleet doc). Grouping by trace id alone broke
+    on multi-process documents: two nodes' trace ids can collide (the
+    rows silently vanished under the != 1 roots guard), and a
+    cross-process ``bus.publish``/``bus.apply`` pair SHARES one trace id
+    by design — per-node grouping keeps each node's half its own row,
+    and the ``node`` column says which replica served what. Device
+    events (xprof, ``device_duration_ps``) are merged BY TIME RANGE:
+    the two timelines are aligned on their first events, then device-op
+    time inside a request's window is attributed to it (overlapping
+    requests both count a shared interval — attribution, not
+    accounting).
     """
     by_trace: dict = {}
     for sp in spans:
         if sp["trace_id"] is not None:
-            by_trace.setdefault(sp["trace_id"], []).append(sp)
+            by_trace.setdefault((sp.get("node"), sp["trace_id"]),
+                                []).append(sp)
     device = []
     offset = 0.0
     if device_events:
@@ -157,13 +169,14 @@ def request_report(spans, device_events=None):
                    float(e["ts"]) + offset + float(e.get("dur", 0.0)),
                    int(e["args"]["device_duration_ps"]) / 1e9) for e in xs]
     rows = []
-    for trace_id, group in by_trace.items():
+    for (node, trace_id), group in by_trace.items():
         roots = [s for s in group if s["parent_id"] is None]
         if len(roots) != 1:
             continue            # cross-process fragments / partial capture
         root = roots[0]
         row = {
             "trace_id": trace_id,
+            "node": node,
             "name": root["name"],
             "model": root["args"].get("model", ""),
             "total_ms": root["dur"] / 1e3,
@@ -215,6 +228,10 @@ def print_request_report(rows, top: int, sort: str,
     has_prefix = any("prefix_hit_blocks" in r for r in rows)
     has_tp = any("decode_tp" in r for r in rows)
     has_keep = any(r.get("keep") for r in rows)
+    # the node column ships as soon as the doc holds more than one
+    # recording process (an obs-plane merged fleet trace); single-node
+    # reports keep their classic layout
+    has_node = len({r.get("node") for r in rows}) > 1
     breaches = (sum(r["total_ms"] > slo_ms for r in rows) if slo_ms > 0
                 else 0)
     head = f"{len(rows)} request(s); slowest by {key}"
@@ -224,6 +241,8 @@ def print_request_report(rows, top: int, sort: str,
     print(head + ":")
     hdr = (f"{'total':>9} {'queue':>8} {'admit':>8} {'prefill':>8} "
            f"{'exec':>8} {'decode':>8} {'iters':>6}")
+    if has_node:
+        hdr += f" {'node':>6}"
     if has_blocks:
         hdr += f" {'blocks':>7} {'pfree':>6}"
     if has_prefix:
@@ -241,6 +260,8 @@ def print_request_report(rows, top: int, sort: str,
                 f"{r['admit_ms']:8.3f} {r.get('prefill_ms', 0.0):8.3f} "
                 f"{r['exec_ms']:8.3f} "
                 f"{r['decode_ms']:8.3f} {r['iters']:6d}")
+        if has_node:
+            line += f" {str(r.get('node', '-')):>6}"
         if has_blocks:
             line += (f" {str(r.get('blocks', '-')):>7} "
                      f"{str(r.get('pool_free', '-')):>6}")
