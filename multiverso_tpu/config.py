@@ -355,6 +355,46 @@ define_string("obs_jsonl", "",
               "one JSON line here (multi-process sessions suffix .<rank>) "
               "— the offline archive tools/opscenter.py renders the "
               "fleet table / merged Prometheus / merged Perfetto from")
+define_int("fleet_heartbeat_ms", 100,
+           "serving fleet: replica heartbeat interval — each replica "
+           "publishes its engine.health() over the mvserve wire at this "
+           "period, and the router flags a replica DEAD after "
+           "-fleet_dead_after_s (default 2 heartbeat intervals) of "
+           "silence")
+define_float("fleet_dead_after_s", 0.0,
+             "serving fleet: heartbeat silence before the router marks a "
+             "replica DEAD, drains its in-flight requests into the retry "
+             "queue, and stops dispatching to it; 0 = 2 heartbeat "
+             "intervals")
+define_int("fleet_retry_max", 3,
+           "serving fleet: per-request re-dispatch budget — a request "
+           "whose replica died (or shed it) is replayed from the prompt "
+           "on a survivor at most this many times before its future "
+           "fails")
+define_float("fleet_backoff_ms", 20.0,
+             "serving fleet: base retry backoff — re-dispatch attempt n "
+             "waits min(cap, base * 2^(n-1)) with jitter before "
+             "re-queueing (docs/SERVING.md 'Serving fleet')")
+define_float("fleet_backoff_cap_ms", 1000.0,
+             "serving fleet: retry backoff cap")
+define_int("fleet_shed_depth", 256,
+           "serving fleet: aggregate router queue cap (pending + retry + "
+           "in-flight) — past it submit sheds OverloadedError("
+           "what='fleet') instead of queueing unboundedly")
+define_float("fleet_deadline_s", 30.0,
+             "serving fleet: default per-request deadline — a request "
+             "not completed by then fails with DeadlineExceededError "
+             "(per-submit override via deadline_s)")
+define_string("chaos", "",
+              "fault-injection plan for the serving fleet (serving/"
+              "faultinject.py): comma-separated directives, e.g. "
+              "'kill_at_request=5' / 'wedge_at_request=3:0.5' / "
+              "'wire_delay=0.05:0.5' / 'wire_drop=0.1' / "
+              "'slow_heartbeat=4'; empty = healthy")
+define_int("chaos_seed", 0,
+           "seed for the -chaos plan's probabilistic directives — a "
+           "given (spec, seed) pair replays the identical fault "
+           "schedule")
 define_bool("lockwatch", False,
             "runtime lock-order witness: record per-thread acquisition "
             "order of every framework lock into a global DAG; a cycle "

@@ -50,6 +50,7 @@ in-order inbox the bus's drain thread consumes). All daemon;
 from __future__ import annotations
 
 import collections
+import random
 import socket
 import struct
 import threading
@@ -62,6 +63,31 @@ from ..log import Log
 _FRAME = struct.Struct("<QI")   # seq, payload length
 _HELLO = struct.Struct("<IQ")   # subscriber rank, resume-from seq
 _HELLO_TIMEOUT_S = 5.0          # accept-loop budget for the 12-byte hello
+_BACKOFF_BASE_S = 0.05          # first reconnect delay
+_BACKOFF_CAP_S = 2.0            # reconnect delay ceiling
+
+
+def reconnect_backoff_s(attempt: int, base_s: float = _BACKOFF_BASE_S,
+                        cap_s: float = _BACKOFF_CAP_S,
+                        rng: Optional[random.Random] = None) -> float:
+    """Delay before reconnect ``attempt`` (0-based): the capped
+    exponential ceiling ``min(cap, base * 2**attempt)``, jittered into
+    ``[ceiling/2, ceiling]`` when ``rng`` is given. The old fixed
+    50 ms loop hammered a flapping peer's listener (and the KV
+    endpoint lookup in front of it) at 20 Hz per subscriber forever;
+    the schedule keeps the first retries prompt and the steady state
+    polite, and the jitter keeps a fleet's subscribers from re-landing
+    as one synchronized thundering herd."""
+    if attempt < 0:
+        raise ValueError(f"attempt is 0-based, got {attempt}")
+    # clamp the exponent: a peer down for ~35 min would push 2**attempt
+    # past float range and the OverflowError would kill the subscriber
+    # thread — permanently losing the subscription right when patience
+    # was the whole point
+    ceiling = min(cap_s, base_s * (2.0 ** min(attempt, 64)))
+    if rng is None:
+        return ceiling
+    return ceiling * (0.5 + 0.5 * rng.random())
 
 
 def _local_host() -> str:
@@ -115,6 +141,9 @@ class P2PTransport:
         self._dead: set = set()
         self._threads: List[threading.Thread] = []
         self._conns: set = set()
+        # reconnect jitter stream (rank-seeded: deterministic per
+        # process, decorrelated across the mesh)
+        self._backoff_rng = random.Random(0x9B2C ^ rank)
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -326,8 +355,12 @@ class P2PTransport:
         ``timeout_s`` (a peer that never comes up fails the bus
         handshake anyway); reconnects retry indefinitely — transient
         breaks are the transport's job, permanent death is the
-        FailureDetector's (`mark_dead` ends the retries)."""
+        FailureDetector's (`mark_dead` ends the retries). Failed
+        attempts back off on the capped-exponential-with-jitter
+        schedule (:func:`reconnect_backoff_s`); a successful connect
+        resets it."""
         deadline = time.monotonic() + timeout_s
+        attempt = 0
         while not self._stop.is_set() and publisher not in self._dead:
             try:
                 # re-fetch each attempt: a restarted publisher
@@ -339,7 +372,9 @@ class P2PTransport:
                     Log.error("p2p: no endpoint from rank %d within "
                               "%.0f s: %s", publisher, timeout_s, exc)
                     return None
-                time.sleep(0.05)
+                time.sleep(reconnect_backoff_s(attempt,
+                                               rng=self._backoff_rng))
+                attempt += 1
                 continue
             # create_connection leaves its 5 s connect timeout on the
             # socket; a publisher idle longer than that (jit compile,
@@ -352,7 +387,9 @@ class P2PTransport:
                 conn.sendall(_HELLO.pack(self._rank, resume))
             except OSError:
                 self._close(conn)
-                time.sleep(0.05)
+                time.sleep(reconnect_backoff_s(attempt,
+                                               rng=self._backoff_rng))
+                attempt += 1
                 continue
             self._track(conn)
             return conn
@@ -360,7 +397,7 @@ class P2PTransport:
 
     def _subscribe(self, publisher: int, timeout_s: float) -> None:
         first = True
-        backoff = 0.05
+        fails = 0
         while not self._stop.is_set() and publisher not in self._dead:
             conn = self._connect(publisher, first, timeout_s)
             if conn is None:
@@ -397,9 +434,10 @@ class P2PTransport:
                 self._close(conn)
             # a stream the publisher keeps closing without delivering
             # anything (out-of-contract reject) backs off instead of
-            # spinning the accept loop at ~20 Hz
-            backoff = 0.05 if delivered else min(backoff * 2, 2.0)
-            time.sleep(backoff)
+            # spinning the accept loop at ~20 Hz; a delivering stream
+            # resets the schedule — its next break reconnects promptly
+            fails = 0 if delivered else fails + 1
+            time.sleep(reconnect_backoff_s(fails, rng=self._backoff_rng))
 
     def pop_ready(self, publisher: int, expected_seq: int
                   ) -> Optional[bytes]:

@@ -29,10 +29,23 @@ The inference half of the train/serve stack (docs/SERVING.md). Pieces:
   (stall/leak/queue-age self-diagnosis; trips dump a diagnostic bundle
   to ``-debug_dump_dir`` and count in ``WATCHDOG_TRIPS``), so a wedged
   or leaking engine produces evidence instead of silence.
+* the serving fleet — :class:`FleetRouter` (failure-aware front door:
+  least-loaded dispatch with session affinity, per-request deadlines,
+  bounded retry with backoff+jitter, heartbeat-observed replica
+  liveness with half-open readmission, ``OverloadedError(
+  what="fleet")`` shedding) over N :class:`ReplicaServer` decode
+  replicas on the ``mvserve`` p2p wire; a killed replica's in-flight
+  requests replay bit-identically on survivors, and
+  :class:`FaultPlan` (``-chaos``) stages the failures that prove it
+  (docs/SERVING.md "Serving fleet").
 """
 
 from .batcher import (BatcherConfig, MicroBatcher, OverloadedError,
                       bucket_for, shape_buckets)
+from .faultinject import FaultPlan
+from .replica import ReplicaServer, serve_replica
+from .router import (DeadlineExceededError, FleetConfig, FleetError,
+                     FleetRouter, retry_backoff_s)
 from .block_pool import (BlockPool, blocks_for_bytes, chain_hashes,
                          kv_bytes_per_block)
 from .decode_engine import DecodeEngine, DecodeEngineConfig
@@ -51,4 +64,7 @@ __all__ = [
     "DecodeEngine", "DecodeEngineConfig", "BlockPool", "blocks_for_bytes",
     "chain_hashes", "kv_bytes_per_block", "FlightRecorder",
     "EngineWatchdog", "WatchdogConfig", "ObsAgent", "ObsCollector",
+    "FaultPlan", "ReplicaServer", "serve_replica", "FleetRouter",
+    "FleetConfig", "FleetError", "DeadlineExceededError",
+    "retry_backoff_s",
 ]

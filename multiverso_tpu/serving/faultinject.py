@@ -1,0 +1,171 @@
+"""Deterministic, seedable fault injection for the serving fleet.
+
+A fault-tolerance claim that was never exercised is a comment, not a
+property. This module is the exercise plane: a :class:`FaultPlan` is a
+parsed, *seeded* schedule of named failure points that the replica and
+its wire publisher consult at well-defined places — the same plan drives
+the chaos unit tests, the 3-process acceptance test, and the
+``serving_bench`` ``lm_fleet_chaos`` A/B, so "recovery works" is a
+number (``requests_lost == 0``, ``recovery_time_s``) the perf gate
+watches, not a belief.
+
+Named failure points (the ``-chaos`` spec grammar; directives are
+comma-separated, all optional)::
+
+    kill_at_request=K        exit the replica process (exit code 43) the
+                             moment it dequeues its K-th targeted
+                             request (1-based) — mid-trace, before the
+                             reply exists
+    wedge_at_request=K:T     sleep T seconds before executing request K
+                             (a wedged engine step: the process stays
+                             alive and heartbeating while making no
+                             request progress)
+    wire_delay=T:P           before each outbound wire record, sleep T
+                             seconds with probability P (seeded)
+    wire_drop=P              suppress each outbound NON-ESSENTIAL wire
+                             record (heartbeats) with probability P
+                             (seeded); request/response records are
+                             never dropped — TCP already owns payload
+                             integrity, the interesting failure is the
+                             *liveness signal* going quiet
+    slow_heartbeat=X         multiply the replica's heartbeat interval
+                             by X (a replica that looks dead without
+                             being dead — the router must not lose its
+                             requests when it flags it)
+
+Determinism: every probabilistic decision draws from one
+``random.Random(seed)`` stream in consultation order, so a given
+``(spec, seed)`` pair replays the identical fault schedule — a flaky
+chaos test is a real bug, not an unlucky roll. Kills go through
+``kill_fn`` so in-process fleets (the bench, the unit tests) can
+substitute an abrupt in-process death for ``os._exit``; subprocess
+replicas get the real thing.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Any, Callable, Dict, Optional
+
+from ..log import Log
+
+#: replica exit code for an injected kill — distinguishable from a crash
+KILL_EXIT = 43
+
+
+def _default_kill() -> None:    # pragma: no cover - subprocess-only path
+    # os._exit, not sys.exit: the point is an ABRUPT death (no atexit,
+    # no transport drain, no engine stop) — the failure mode the fleet
+    # must survive, not a graceful shutdown it could negotiate with
+    os._exit(KILL_EXIT)
+
+
+class FaultPlan:
+    """One parsed ``-chaos`` spec: the schedule a replica consults.
+
+    All methods are cheap and safe to call with no faults configured
+    (``FaultPlan("")`` is the always-healthy plan); ``counts`` records
+    every fault actually fired, and rides ``ReplicaServer.stats()`` so
+    a chaos run's report says what the plan *did*, not just what it
+    said.
+    """
+
+    def __init__(self, spec: str = "", seed: int = 0,
+                 kill_fn: Optional[Callable[[], None]] = None) -> None:
+        self.spec = spec or ""
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._kill_fn = kill_fn or _default_kill
+        self.kill_at: int = 0                 # 0 = never
+        self.wedge_at: int = 0
+        self.wedge_s: float = 0.0
+        self.delay_s: float = 0.0
+        self.delay_p: float = 0.0
+        self.drop_p: float = 0.0
+        self.heartbeat_scale: float = 1.0
+        self.counts: Dict[str, int] = {
+            "kills": 0, "wedges": 0, "wire_delays": 0, "wire_drops": 0}
+        for directive in filter(None,
+                                (d.strip() for d in self.spec.split(","))):
+            key, _, val = directive.partition("=")
+            if not val:
+                raise ValueError(f"chaos directive {directive!r} needs "
+                                 f"KEY=VALUE")
+            try:
+                self._apply(key.strip(), val.strip())
+            except (TypeError, ValueError) as exc:
+                raise ValueError(
+                    f"bad chaos directive {directive!r}: {exc}") from None
+
+    def _apply(self, key: str, val: str) -> None:
+        if key == "kill_at_request":
+            self.kill_at = int(val)
+        elif key == "wedge_at_request":
+            k, _, t = val.partition(":")
+            self.wedge_at, self.wedge_s = int(k), float(t or 0.0)
+        elif key == "wire_delay":
+            t, _, p = val.partition(":")
+            self.delay_s = float(t)
+            self.delay_p = float(p) if p else 1.0
+        elif key == "wire_drop":
+            self.drop_p = float(val)
+        elif key == "slow_heartbeat":
+            self.heartbeat_scale = float(val)
+            if self.heartbeat_scale < 1.0:
+                raise ValueError("slow_heartbeat scale must be >= 1")
+        else:
+            raise ValueError(f"unknown failure point {key!r}")
+
+    @classmethod
+    def from_flags(cls, kill_fn: Optional[Callable[[], None]] = None
+                   ) -> "FaultPlan":
+        """The ``-chaos`` / ``-chaos_seed`` flag pair as a plan."""
+        from .. import config
+
+        return cls(config.get_flag("chaos"),
+                   seed=int(config.get_flag("chaos_seed")),
+                   kill_fn=kill_fn)
+
+    # -- failure points ------------------------------------------------------
+    def on_request(self, k: int) -> float:
+        """Consulted as the replica dequeues its ``k``-th (1-based)
+        targeted request. Fires the kill (does not return) or returns
+        the seconds to wedge before executing (0.0 = healthy)."""
+        if self.kill_at and k == self.kill_at:
+            self.counts["kills"] += 1
+            Log.error("chaos: killing replica at request %d "
+                      "(kill_at_request)", k)
+            self._kill_fn()
+            return 0.0          # in-process kill_fn substitutes may return
+        if self.wedge_at and k == self.wedge_at and self.wedge_s > 0:
+            self.counts["wedges"] += 1
+            Log.error("chaos: wedging request %d for %.3f s", k,
+                      self.wedge_s)
+            return self.wedge_s
+        return 0.0
+
+    def wire_delay_s(self) -> float:
+        """Consulted before each outbound wire record: seconds to stall
+        the send (0.0 = send now)."""
+        if self.delay_s > 0 and self._rng.random() < self.delay_p:
+            self.counts["wire_delays"] += 1
+            return self.delay_s
+        return 0.0
+
+    def drop_heartbeat(self) -> bool:
+        """Consulted per heartbeat: True = suppress this one."""
+        if self.drop_p > 0 and self._rng.random() < self.drop_p:
+            self.counts["wire_drops"] += 1
+            return True
+        return False
+
+    def active(self) -> bool:
+        return bool(self.kill_at or self.wedge_at or self.delay_s
+                    or self.drop_p or self.heartbeat_scale != 1.0)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"spec": self.spec, "seed": self.seed, **self.counts}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"FaultPlan({self.spec!r}, seed={self.seed})"

@@ -468,6 +468,16 @@ class ObsCollector:
                 f"{node:>6} {status:<9} {age:>7.2f} {reports:>8} "
                 f"{seq:>6} {tok:>9.1f} {live:>5} {trips:>6} "
                 f"{burn:>6.2f} {spans:>6}")
+        replica_rows = self.replica_rows()
+        if replica_rows:
+            lines.append(
+                f"{'replica':>12} {'state':<11} {'inflight':>9} "
+                f"{'hb_age_ms':>10} {'node':>5}")
+            for row in replica_rows:
+                lines.append(
+                    f"{row['replica']:>12} {row['state']:<11} "
+                    f"{row['inflight']:>9} {row['hb_age_ms']:>10.1f} "
+                    f"{row['node']:>5}")
         for name, h in sorted(fl["histograms"].items()):
             lines.append(
                 f"fleet {name}: p50 {h['p50_ms']:.3f} / p95 "
@@ -481,6 +491,37 @@ class ObsCollector:
                 f"{s['value_ms']:.3f} ms vs {s['target_ms']:.3f} ms, "
                 f"burn {s['burn']:.2f} ({state})")
         return "\n".join(lines)
+
+    def replica_rows(self) -> List[Dict[str, Any]]:
+        """Serving-fleet replica rows assembled from the router's
+        per-replica gauges (``FLEET_REPLICA_STATE[name.rank]`` +
+        ``FLEET_INFLIGHT``/``FLEET_HB_AGE_MS``) wherever a node's
+        shipped registry carries them — the :class:`FleetRouter`'s
+        state machine rendered into the fleet table (state, in-flight,
+        heartbeat age), live or from ``tools/opscenter.py`` archives."""
+        from .router import STATE_NAMES
+
+        with self._lock:
+            per_node = [(node, dict(st["rows"]))
+                        for node, st in sorted(self._nodes.items())]
+        out: List[Dict[str, Any]] = []
+        for node, rows in per_node:
+            for name, row in sorted(rows.items()):
+                if not (name.startswith("FLEET_REPLICA_STATE[")
+                        and name.endswith("]")
+                        and row.get("type") == "gauge"):
+                    continue
+                key = name[len("FLEET_REPLICA_STATE["):-1]
+                state = STATE_NAMES.get(int(row.get("value", 0)),
+                                        f"?{row.get('value')}")
+                inflight = int(rows.get(f"FLEET_INFLIGHT[{key}]",
+                                        {}).get("value", 0))
+                hb_age = float(rows.get(f"FLEET_HB_AGE_MS[{key}]",
+                                        {}).get("value", 0.0))
+                out.append({"replica": key, "state": state,
+                            "inflight": inflight, "hb_age_ms": hb_age,
+                            "node": node})
+        return out
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

@@ -1,0 +1,769 @@
+"""Failure-aware fleet router: N decode replicas, one front door.
+
+The :class:`FleetRouter` is the serving fleet's front-end (rank 0 on
+the ``mvserve`` wire): it owns the request queue, dispatches to the
+least-loaded UP replica with session affinity (a multi-turn session
+sticks to the replica holding its prefix-cache blocks), enforces
+per-request deadlines, and — the point of the module — keeps every
+accepted request alive across replica failures:
+
+* **liveness is observed**: a replica is UP because its heartbeats say
+  so; silence past ``-fleet_dead_after_s`` (default 2 heartbeat
+  intervals) or a wire-declared death (``P2PTransport.on_dead``) flags
+  it DEAD. The verdict is edge-triggered: one transition, one drain.
+* **death drains, never drops**: the dead replica's in-flight set moves
+  into the retry queue with exponential backoff + jitter
+  (:func:`retry_backoff_s`, bounded by ``-fleet_retry_max``). Requests
+  carry idempotent ids and decode is deterministic greedy (the PR 11
+  invariant), so the replay executes the same prompt from scratch on a
+  survivor and produces **bit-identical output** — late duplicate
+  replies are deduped by id, and a duplicate whose payload differs
+  increments ``fleet_redispatch_output_mismatches`` (gated at zero by
+  the bench: determinism is an invariant, not a hope).
+* **readmission is half-open**: a DEAD replica that heartbeats again
+  (restarted process, healed partition) is PROBED — one ``ping`` must
+  round-trip on the wire before any real request is dispatched to it.
+* **overload degrades loudly**: past ``-fleet_shed_depth`` aggregate
+  queue depth (pending + retry + in-flight) ``submit`` raises
+  :class:`~.batcher.OverloadedError` ``(what="fleet")`` instead of
+  queueing unboundedly; with N-1 replicas up the fleet keeps serving at
+  reduced capacity rather than failing.
+
+Observability: ``FLEET_DISPATCH``/``FLEET_RETRIES``/``FLEET_REDISPATCH``
+/``FLEET_SHED`` counters, per-replica ``FLEET_REPLICA_STATE``/
+``FLEET_INFLIGHT``/``FLEET_HB_AGE_MS`` gauges (the obs plane ships them
+and ``tools/opscenter.py`` renders replica rows), and a
+``route.dispatch`` span per attempt whose context rides the wire — the
+replica's spans join the request's trace across the process boundary
+(docs/SERVING.md "Serving fleet").
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import threading
+from ..analysis import lockwatch
+import time
+import uuid
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import config, trace
+from ..dashboard import Dashboard
+from ..log import Log
+from ..parallel.p2p import reconnect_backoff_s
+from .batcher import OverloadedError
+from .replica import (LABEL, MSG_ERR, MSG_HB, MSG_PING, MSG_PONG, MSG_REQ,
+                      MSG_RSP, ROUTER_RANK, decode_msg, encode_msg)
+
+# replica lifecycle states; the numeric codes are the
+# FLEET_REPLICA_STATE gauge values (ordered by serviceability)
+DEAD, CONNECTING, PROBING, UP = 0, 1, 2, 3
+STATE_NAMES = {DEAD: "DEAD", CONNECTING: "CONNECTING",
+               PROBING: "PROBING", UP: "UP"}
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline passed before a replica completed it."""
+
+
+class FleetError(RuntimeError):
+    """The request exhausted its re-dispatch budget (every attempt hit
+    a dying or shedding replica)."""
+
+
+def retry_backoff_s(attempt: int, base_s: float, cap_s: float,
+                    rng: Optional[random.Random] = None) -> float:
+    """Delay before re-dispatch ``attempt`` (1-based): the capped
+    exponential ceiling ``min(cap, base * 2**(attempt-1))``, jittered
+    into ``[ceiling/2, ceiling]`` when ``rng`` is given (equal-jitter —
+    a burst of redispatches from one death must not re-land as one
+    synchronized burst). ``rng=None`` returns the deterministic
+    ceiling (the unit-testable schedule). One schedule, one
+    implementation: this is the transport's reconnect schedule
+    (:func:`~multiverso_tpu.parallel.p2p.reconnect_backoff_s`) with
+    1-based indexing."""
+    if attempt < 1:
+        raise ValueError(f"attempt is 1-based, got {attempt}")
+    return reconnect_backoff_s(attempt - 1, base_s, cap_s, rng)
+
+
+@dataclass
+class FleetConfig:
+    """Router knobs; ``None`` falls back to the ``-fleet_*`` flags."""
+
+    heartbeat_ms: Optional[int] = None
+    dead_after_s: Optional[float] = None      # 0/None -> 2 heartbeats
+    retry_max: Optional[int] = None
+    backoff_ms: Optional[float] = None
+    backoff_cap_ms: Optional[float] = None
+    shed_depth: Optional[int] = None
+    deadline_s: Optional[float] = None
+
+    def resolved(self) -> "FleetConfig":
+        def flag(field, name):
+            v = getattr(self, field)
+            return config.get_flag(name) if v is None else v
+
+        hb_ms = int(flag("heartbeat_ms", "fleet_heartbeat_ms"))
+        dead = float(flag("dead_after_s", "fleet_dead_after_s"))
+        if dead <= 0:
+            dead = 2.0 * hb_ms / 1000.0
+        return FleetConfig(
+            heartbeat_ms=hb_ms, dead_after_s=dead,
+            retry_max=int(flag("retry_max", "fleet_retry_max")),
+            backoff_ms=float(flag("backoff_ms", "fleet_backoff_ms")),
+            backoff_cap_ms=float(flag("backoff_cap_ms",
+                                      "fleet_backoff_cap_ms")),
+            shed_depth=int(flag("shed_depth", "fleet_shed_depth")),
+            deadline_s=float(flag("deadline_s", "fleet_deadline_s")))
+
+
+class _FleetRequest:
+    __slots__ = ("rid", "prompt", "max_new", "session", "deadline",
+                 "attempts", "future", "replica", "t_enq", "root",
+                 "dispatch_span", "redispatched", "exclude")
+
+    def __init__(self, prompt: np.ndarray, max_new: Optional[int],
+                 session: Optional[str], deadline: float, root) -> None:
+        self.rid = uuid.uuid4().hex[:16]
+        self.prompt = np.asarray(prompt, np.int32).ravel()
+        self.max_new = max_new
+        self.session = session
+        self.deadline = deadline
+        self.attempts = 0
+        self.future: Future = Future()
+        self.replica: Optional[int] = None
+        self.t_enq = time.monotonic()
+        self.root = root
+        self.dispatch_span = None
+        self.redispatched = False
+        self.exclude: Optional[int] = None   # rank that just failed it
+
+
+class _Replica:
+    __slots__ = ("rank", "state", "last_hb", "health", "inflight",
+                 "wire_dead", "probe_rid", "deaths", "readmissions",
+                 "state_gauge", "inflight_gauge", "hb_age_gauge")
+
+    def __init__(self, rank: int, router_name: str) -> None:
+        self.rank = rank
+        self.state = CONNECTING
+        self.last_hb: Optional[float] = None
+        self.health: Dict[str, Any] = {}
+        self.inflight: set = set()          # rids currently assigned here
+        self.wire_dead = False              # transport-declared: terminal
+        self.probe_rid: Optional[str] = None
+        self.deaths = 0
+        self.readmissions = 0
+        self.state_gauge = Dashboard.get_or_create_gauge(
+            f"FLEET_REPLICA_STATE[{router_name}.{rank}]")
+        self.inflight_gauge = Dashboard.get_or_create_gauge(
+            f"FLEET_INFLIGHT[{router_name}.{rank}]")
+        self.hb_age_gauge = Dashboard.get_or_create_gauge(
+            f"FLEET_HB_AGE_MS[{router_name}.{rank}]")
+        self.state_gauge.set(CONNECTING)
+
+
+class FleetRouter:
+    """Front door for a replicated decode fleet (``mvserve`` rank 0)."""
+
+    def __init__(self, size: int, client: Any, label: str = LABEL,
+                 fleet_config: Optional[FleetConfig] = None,
+                 name: str = "fleet") -> None:
+        from ..parallel.p2p import P2PTransport
+
+        if size < 2:
+            raise ValueError(f"fleet size {size} needs >= 1 replica")
+        self.name = name
+        self.size = int(size)
+        self._client = client
+        self._label = label
+        self.config = (fleet_config or FleetConfig()).resolved()
+        self._lock = lockwatch.lock("serving.FleetRouter._lock")
+        self._replicas: Dict[int, _Replica] = {
+            r: _Replica(r, name) for r in range(1, size)}
+        self._pending: collections.deque = collections.deque()
+        self._retry: List[Tuple[float, _FleetRequest]] = []
+        self._inflight: Dict[str, _FleetRequest] = {}
+        self._affinity: Dict[str, int] = {}
+        # completed rids -> result digest, bounded: dedupes the late
+        # duplicate replies the replay path makes legitimate, and is
+        # what lets a duplicate's payload be CHECKED for bit-identity
+        self._done: "collections.OrderedDict[str, Optional[int]]" = \
+            collections.OrderedDict()
+        self._done_cap = 4096
+        self._expect: Dict[int, int] = {r: 0 for r in self._replicas}
+        self._acked: Dict[int, int] = {r: 0 for r in self._replicas}
+        self._seq = 0
+        self._released = 0
+        self._head_published = -1       # last head value written to KV
+        self._next_ack_poll = 0.0       # ack reads run at hb cadence
+        self._probe_n = 0
+        self._rng = random.Random(0x466C3374)   # retry jitter stream
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.shed = 0
+        self.deadline_failures = 0
+        self.duplicate_replies = 0
+        self.output_mismatches = 0
+        self._last_death: Optional[float] = None
+        self._last_recovery: Optional[float] = None
+        self._dispatch_counter = Dashboard.get_or_create_counter(
+            "FLEET_DISPATCH")
+        self._retries_counter = Dashboard.get_or_create_counter(
+            "FLEET_RETRIES")
+        self._redispatch_counter = Dashboard.get_or_create_counter(
+            "FLEET_REDISPATCH")
+        self._shed_counter = Dashboard.get_or_create_counter("FLEET_SHED")
+        self._transport = P2PTransport(
+            ROUTER_RANK, self.size, client, label=label,
+            subscribe_to=sorted(self._replicas),
+            on_dead=self._on_wire_dead)
+        self._publish_head()
+        self._stop = threading.Event()
+        # one loop owns all routing state transitions: drain, liveness,
+        # retries, deadlines, dispatch — ticked fast enough that the
+        # DEAD verdict lands well inside the 2-heartbeat contract
+        self._tick_s = max(0.005, self.config.heartbeat_ms / 4000.0)
+        self._thread = threading.Thread(
+            target=self._loop, name=f"mvserve-router", daemon=True)
+        self._thread.start()
+        Log.info("fleet: router up over %d replica(s) (hb %d ms, dead "
+                 "after %.3f s, retry_max %d, shed at %d)",
+                 size - 1, self.config.heartbeat_ms,
+                 self.config.dead_after_s, self.config.retry_max,
+                 self.config.shed_depth)
+
+    # -- submit path ---------------------------------------------------------
+    def submit(self, prompt: np.ndarray, max_new: Optional[int] = None,
+               session: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> Future:
+        """Enqueue one prompt for the fleet; resolves to the reply dict
+        ``{"result", "snapshot_version", "staleness_s", "replica"}``.
+        ``session`` keys affinity (multi-turn conversations hit the
+        same replica's prefix cache while it stays UP); ``deadline_s``
+        overrides ``-fleet_deadline_s``. Sheds ``OverloadedError(
+        what="fleet")`` past the aggregate queue cap."""
+        root = trace.start_span("serve.request", root=True,
+                                model=self.name, fleet=True)
+        deadline = time.monotonic() + float(
+            self.config.deadline_s if deadline_s is None else deadline_s)
+        req = _FleetRequest(prompt, max_new, session, deadline, root)
+        with self._lock:
+            stopped = self._stop.is_set()
+            depth = -1
+            if not stopped:
+                depth = (len(self._pending) + len(self._retry)
+                         + len(self._inflight))
+                if depth >= self.config.shed_depth:
+                    self.shed += 1
+                else:
+                    self.submitted += 1
+                    self._pending.append(req)
+                    depth = -1
+        if stopped:
+            # the root span still closes on the reject path — a raise
+            # must never leave an open span in the collector
+            root.end(error="stopped")
+            raise RuntimeError(f"fleet router {self.name!r} is stopped")
+        if depth >= 0:
+            self._shed_counter.inc()
+            root.end(error="OverloadedError")
+            raise OverloadedError(self.name, depth,
+                                  self.config.shed_depth, what="fleet")
+        if root is not trace.NULL_SPAN:
+            req.future.add_done_callback(lambda f, sp=root: sp.end(
+                ok=(not f.cancelled()) and f.exception() is None))
+        return req.future
+
+    def predict(self, prompt: np.ndarray, max_new: Optional[int] = None,
+                session: Optional[str] = None,
+                timeout_s: float = 60.0) -> dict:
+        return self.submit(prompt, max_new, session).result(
+            timeout=timeout_s)
+
+    # -- wire death hook -----------------------------------------------------
+    def _on_wire_dead(self, ranks) -> None:
+        """Transport-declared deaths (out-of-contract resume): terminal
+        for the rank — the wire itself refuses its streams now, so
+        there is no readmission path. Runs on a transport thread,
+        outside every router lock."""
+        resolutions: List[Tuple[_FleetRequest, Any]] = []
+        with self._lock:
+            for r in ranks:
+                rep = self._replicas.get(int(r))
+                if rep is None:
+                    continue
+                rep.wire_dead = True
+                if rep.state != DEAD:
+                    self._mark_dead_locked(rep, "wire on_dead",
+                                           resolutions)
+        self._apply_resolutions(resolutions)
+
+    # -- the routing loop ----------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self._tick_s):
+            try:
+                self.tick()
+            except Exception as exc:    # pragma: no cover - defensive
+                Log.error("fleet router: tick failed: %s", exc)
+
+    def tick(self) -> None:
+        """One routing pass (the loop calls it every few ms; tests call
+        it directly). All state mutation happens under ``_lock``;
+        future resolutions and wire sends are collected and fired
+        OUTSIDE it (locklint LK202/LK203 — a future's done-callbacks
+        are user code, and the send path blocks on chaos delays)."""
+        now = time.monotonic()
+        inbound = self._drain_wire()
+        resolutions: List[Tuple[_FleetRequest, Any]] = []
+        sends: List[Dict[str, Any]] = []
+        with self._lock:
+            for node, msg in inbound:
+                self._handle_locked(node, msg, now, resolutions)
+            self._check_liveness_locked(now, resolutions, sends)
+            self._run_retries_locked(now)
+            self._check_deadlines_locked(now, resolutions)
+            self._dispatch_locked(now, sends)
+            for rep in self._replicas.values():
+                rep.inflight_gauge.set(len(rep.inflight))
+                if rep.last_hb is not None:
+                    rep.hb_age_gauge.set((now - rep.last_hb) * 1e3)
+        self._apply_resolutions(resolutions)
+        for msg in sends:
+            self._publish(msg)
+        self._ack_and_release()
+
+    # -- inbound -------------------------------------------------------------
+    def _drain_wire(self) -> List[Tuple[int, Dict[str, Any]]]:
+        out: List[Tuple[int, Dict[str, Any]]] = []
+        for r in sorted(self._replicas):
+            while True:
+                payload = self._transport.pop_ready(r, self._expect[r])
+                if payload is None:
+                    break
+                self._expect[r] += 1
+                try:
+                    out.append((r, decode_msg(payload)))
+                except ValueError:
+                    Log.error("fleet: undecodable record from replica "
+                              "%d (seq %d)", r, self._expect[r] - 1)
+        return out
+
+    def _handle_locked(self, node: int, msg: Dict[str, Any], now: float,
+                       resolutions) -> None:
+        rep = self._replicas[node]
+        kind = msg.get("t")
+        if kind == MSG_HB:
+            rep.last_hb = now
+            rep.health = msg.get("health") or {}
+            if rep.state == CONNECTING:
+                self._set_state_locked(rep, UP)
+            return
+        if kind == MSG_PONG:
+            if rep.state == PROBING and msg.get("rid") == rep.probe_rid:
+                rep.probe_rid = None
+                rep.readmissions += 1
+                self._set_state_locked(rep, UP)
+                Log.info("fleet: replica %d readmitted (probe %s "
+                         "round-tripped)", node, msg.get("rid"))
+            return
+        if kind not in (MSG_RSP, MSG_ERR):
+            return
+        rid = msg.get("rid")
+        req = self._inflight.get(rid)
+        if req is None:
+            # late duplicate (the replay path makes these legitimate):
+            # dedupe by rid, and CHECK the payload against the first
+            # completion — greedy decode is deterministic, so a
+            # mismatch is a real invariant break, counted and gated
+            if rid in self._done:
+                self.duplicate_replies += 1
+                if kind == MSG_RSP:
+                    digest = self._digest(msg.get("result"))
+                    first = self._done[rid]
+                    if first is not None and digest != first:
+                        self.output_mismatches += 1
+                        Log.error("fleet: duplicate reply for %s from "
+                                  "replica %d DIFFERS from the first "
+                                  "completion (determinism break)",
+                                  rid, node)
+            return
+        # the reply may come from a previous assignee (re-dispatch
+        # raced a slow-but-alive replica): accept it — the output is
+        # deterministic — and release both assignments
+        for holder in self._replicas.values():
+            holder.inflight.discard(rid)
+        del self._inflight[rid]
+        if kind == MSG_ERR:
+            if msg.get("kind") == "overloaded":
+                self._requeue_locked(req, f"replica {node} shed",
+                                     resolutions)
+            else:
+                self.failed += 1
+                self._finish_done_locked(rid, None)
+                resolutions.append((req, RuntimeError(
+                    f"fleet request {rid} failed on replica {node}: "
+                    f"{msg.get('what')}: {msg.get('msg')}")))
+            return
+        reply = {
+            "result": np.asarray(msg.get("result"), np.int32),
+            "snapshot_version": msg.get("snapshot_version"),
+            "staleness_s": msg.get("staleness_s", 0.0),
+            "replica": node,
+        }
+        self.completed += 1
+        if req.redispatched:
+            self._last_recovery = now
+        self._finish_done_locked(rid, self._digest(msg.get("result")))
+        resolutions.append((req, reply))
+
+    @staticmethod
+    def _digest(result) -> int:
+        return hash(tuple(result or ()))
+
+    def _finish_done_locked(self, rid: str, digest: Optional[int]) -> None:
+        self._done[rid] = digest
+        while len(self._done) > self._done_cap:
+            self._done.popitem(last=False)
+
+    # -- liveness ------------------------------------------------------------
+    def _set_state_locked(self, rep: _Replica, state: int) -> None:
+        rep.state = state
+        rep.state_gauge.set(state)
+
+    def _mark_dead_locked(self, rep: _Replica, why: str,
+                          resolutions) -> None:
+        """One death transition: flag, drain the in-flight set into the
+        retry queue (bounded re-dispatch), drop affinity pins."""
+        self._set_state_locked(rep, DEAD)
+        rep.deaths += 1
+        self._last_death = time.monotonic()
+        drained = [self._inflight[rid] for rid in sorted(rep.inflight)
+                   if rid in self._inflight]
+        rep.inflight.clear()
+        for session, r in list(self._affinity.items()):
+            if r == rep.rank:
+                del self._affinity[session]
+        Log.error("fleet: replica %d DEAD (%s); re-dispatching %d "
+                  "in-flight request(s)", rep.rank, why, len(drained))
+        for req in drained:
+            req.redispatched = True
+            self._redispatch_counter.inc()
+            self._requeue_locked(req, why, resolutions)
+
+    def _requeue_locked(self, req: _FleetRequest, why: str,
+                        resolutions) -> None:
+        """Push one in-flight request back through the bounded
+        retry/backoff path (or fail it once the budget is spent)."""
+        sp = req.dispatch_span
+        if sp is not None:
+            sp.end(error=why)
+            req.dispatch_span = None
+        self._inflight.pop(req.rid, None)
+        req.exclude = req.replica        # prefer a DIFFERENT survivor
+        req.replica = None
+        if req.attempts > self.config.retry_max:
+            self.failed += 1
+            self._finish_done_locked(req.rid, None)
+            resolutions.append((req, FleetError(
+                f"fleet request {req.rid} exhausted "
+                f"{self.config.retry_max} re-dispatch attempt(s): {why}")))
+            return
+        self._retries_counter.inc()
+        delay = retry_backoff_s(req.attempts,
+                                self.config.backoff_ms / 1000.0,
+                                self.config.backoff_cap_ms / 1000.0,
+                                self._rng)
+        self._retry.append((time.monotonic() + delay, req))
+
+    def _check_liveness_locked(self, now: float, resolutions,
+                               sends) -> None:
+        for rep in self._replicas.values():
+            age = None if rep.last_hb is None else now - rep.last_hb
+            if rep.state == UP:
+                if age is not None and age > self.config.dead_after_s:
+                    self._mark_dead_locked(
+                        rep, f"heartbeat age {age:.3f}s", resolutions)
+            elif rep.state == PROBING:
+                if age is not None and age > self.config.dead_after_s:
+                    # went silent again mid-probe: back to DEAD (no
+                    # in-flight to drain — PROBING never dispatches)
+                    rep.probe_rid = None
+                    self._mark_dead_locked(
+                        rep, f"silent during probe ({age:.3f}s)",
+                        resolutions)
+            elif rep.state == DEAD and not rep.wire_dead:
+                if age is not None and age <= self.config.dead_after_s:
+                    # heartbeats resumed: half-open — ONE probe must
+                    # round-trip before any real request lands here
+                    self._probe_n += 1
+                    rep.probe_rid = f"probe-{rep.rank}-{self._probe_n}"
+                    self._set_state_locked(rep, PROBING)
+                    Log.info("fleet: replica %d heartbeating again; "
+                             "probing (%s)", rep.rank, rep.probe_rid)
+                    sends.append({"t": MSG_PING, "target": rep.rank,
+                                  "rid": rep.probe_rid})
+
+    # -- retries / deadlines -------------------------------------------------
+    def _run_retries_locked(self, now: float) -> None:
+        due = [req for t, req in self._retry if t <= now]
+        if due:
+            self._retry = [(t, req) for t, req in self._retry if t > now]
+            # retries go to the FRONT: they are the oldest requests
+            self._pending.extendleft(reversed(due))
+
+    def _check_deadlines_locked(self, now: float, resolutions) -> None:
+        def expire(req: _FleetRequest) -> None:
+            self.deadline_failures += 1
+            self.failed += 1
+            sp = req.dispatch_span
+            if sp is not None:
+                sp.end(error="deadline")
+                req.dispatch_span = None
+            self._finish_done_locked(req.rid, None)
+            resolutions.append((req, DeadlineExceededError(
+                f"fleet request {req.rid} missed its deadline "
+                f"({(now - req.t_enq):.3f}s since submit)")))
+
+        expired = [r for r in self._pending if r.deadline <= now]
+        if expired:
+            self._pending = collections.deque(
+                r for r in self._pending if r.deadline > now)
+        for t, req in list(self._retry):
+            if req.deadline <= now:
+                expired.append(req)
+        self._retry = [(t, r) for t, r in self._retry
+                       if r.deadline > now]
+        for rid, req in list(self._inflight.items()):
+            if req.deadline <= now:
+                del self._inflight[rid]
+                for rep in self._replicas.values():
+                    rep.inflight.discard(rid)
+                expired.append(req)
+        for req in expired:
+            expire(req)
+
+    # -- dispatch ------------------------------------------------------------
+    def _pick_locked(self, req: _FleetRequest) -> Optional[_Replica]:
+        up = [rep for rep in self._replicas.values() if rep.state == UP]
+        if not up:
+            return None
+        # a retried request prefers a DIFFERENT replica than the one
+        # that just died/shed it (when any other is up) — re-dispatch
+        # exists to escape the failure, not to re-queue behind it
+        if req.exclude is not None and len(up) > 1:
+            up = [rep for rep in up if rep.rank != req.exclude] or up
+        if req.session:
+            pin = self._affinity.get(req.session)
+            if pin is not None and pin != req.exclude:
+                rep = self._replicas.get(pin)
+                if rep is not None and rep.state == UP:
+                    return rep
+        def load(rep: _Replica) -> Tuple[int, int]:
+            return (len(rep.inflight)
+                    + int((rep.health or {}).get("queue_depth", 0)),
+                    rep.rank)
+        return min(up, key=load)
+
+    def _dispatch_locked(self, now: float, sends) -> None:
+        while self._pending:
+            req = self._pending[0]
+            rep = self._pick_locked(req)
+            if rep is None:
+                return                   # nobody UP: requests wait
+            self._pending.popleft()
+            req.attempts += 1
+            req.replica = rep.rank
+            rep.inflight.add(req.rid)
+            self._inflight[req.rid] = req
+            if req.session:
+                self._affinity[req.session] = rep.rank
+            self._dispatch_counter.inc()
+            sp = trace.start_span(
+                "route.dispatch",
+                parent=req.root.context if req.root is not trace.NULL_SPAN
+                else None,
+                replica=rep.rank, rid=req.rid, attempt=req.attempts)
+            req.dispatch_span = sp
+            wire_ctx = None
+            if sp is not trace.NULL_SPAN:
+                wire_ctx = [sp.trace_id, sp.span_id]
+            sends.append({
+                "t": MSG_REQ, "target": rep.rank, "rid": req.rid,
+                "session": req.session, "prompt": req.prompt.tolist(),
+                "max_new": req.max_new, "trace": wire_ctx})
+
+    # -- outbound ------------------------------------------------------------
+    def _publish(self, msg: Dict[str, Any]) -> None:
+        payload = encode_msg(msg)
+        with self._lock:
+            seq = self._seq
+            self._seq = seq + 1
+        self._transport.send(seq, payload)
+
+    def _publish_head(self) -> None:
+        # only when the head MOVED: an idle router must not rewrite an
+        # identical value into the coordination service every tick
+        if self._seq == self._head_published:
+            return
+        try:
+            self._client.key_value_set(f"{self._label}/head",
+                                       str(self._seq),
+                                       allow_overwrite=True)
+            self._head_published = self._seq
+        except Exception:               # pragma: no cover - kv trouble
+            pass
+
+    def _ack_and_release(self) -> None:
+        """Ack every replica stream we consumed, advance the request
+        stream's release frontier to the min ack over serviceable
+        replicas (DEAD ranks are excluded — a permanently silent
+        replica must not pin the retained window; its successor
+        resumes from the published head, not from its ack), and
+        re-publish the head for restart bootstraps. The ack READS run
+        at heartbeat cadence, not tick cadence: release latency is not
+        liveness, and a KV client whose only read is a blocking get
+        (the ``_read_ack`` fallback) must never stall the routing
+        thread once per replica per tick — that path flagged healthy
+        replicas DEAD at boot."""
+        for r, rep in self._replicas.items():
+            if self._expect[r] > self._acked[r]:
+                try:
+                    self._client.key_value_set(
+                        f"{self._label}/rack/{r}", str(self._expect[r]),
+                        allow_overwrite=True)
+                    self._acked[r] = self._expect[r]
+                except Exception:       # pragma: no cover - kv trouble
+                    pass
+        now = time.monotonic()
+        if now < self._next_ack_poll or self._released >= self._seq:
+            self._publish_head()
+            return
+        self._next_ack_poll = now + self.config.heartbeat_ms / 1000.0
+        live_acks = []
+        for r, rep in self._replicas.items():
+            if rep.state == DEAD or rep.last_hb is None:
+                # DEAD ranks and never-connected CONNECTING ranks (a
+                # replica that crashed at boot) must not pin the
+                # frontier at 0 forever — their (re)incarnations resume
+                # from the published head, not from their ack, so
+                # releasing past them is in contract
+                continue
+            live_acks.append(self._read_ack(r))
+        if live_acks:
+            frontier = min(live_acks)
+            while self._released < frontier:
+                self._transport.release(self._released)
+                self._released += 1
+        self._publish_head()
+
+    def _read_ack(self, r: int) -> int:
+        key = f"{self._label}/ack/{r}"
+        try:
+            if hasattr(self._client, "key_value_try_get"):
+                return int(str(self._client.key_value_try_get(key)))
+            return int(str(self._client.blocking_key_value_get(key, 100)))
+        except Exception:
+            return 0
+
+    def _apply_resolutions(self, resolutions) -> None:
+        """Fire future results/exceptions OUTSIDE every router lock —
+        done-callbacks are user code (locklint LK202)."""
+        for req, outcome in resolutions:
+            sp = req.dispatch_span
+            if sp is not None:
+                req.dispatch_span = None
+                sp.end(ok=not isinstance(outcome, Exception))
+            if not req.future.set_running_or_notify_cancel():
+                continue
+            if isinstance(outcome, Exception):
+                req.future.set_exception(outcome)
+            else:
+                req.future.set_result(outcome)
+
+    # -- introspection -------------------------------------------------------
+    def replica_rows(self) -> List[Dict[str, Any]]:
+        now = time.monotonic()
+        with self._lock:
+            return [{
+                "rank": rep.rank,
+                "state": STATE_NAMES[rep.state],
+                "inflight": len(rep.inflight),
+                "hb_age_ms": (None if rep.last_hb is None
+                              else round((now - rep.last_hb) * 1e3, 1)),
+                "deaths": rep.deaths,
+                "readmissions": rep.readmissions,
+                "queue_depth": (rep.health or {}).get("queue_depth", 0),
+            } for rep in sorted(self._replicas.values(),
+                                key=lambda x: x.rank)]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            pending = len(self._pending)
+            retrying = len(self._retry)
+            inflight = len(self._inflight)
+            recovery = None
+            if self._last_death is not None \
+                    and self._last_recovery is not None \
+                    and self._last_recovery >= self._last_death:
+                recovery = self._last_recovery - self._last_death
+            return {
+                "replicas": len(self._replicas),
+                "up": sum(1 for rep in self._replicas.values()
+                          if rep.state == UP),
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "shed": self.shed,
+                "deadline_failures": self.deadline_failures,
+                "pending": pending,
+                "retrying": retrying,
+                "inflight": inflight,
+                "requests_lost": (self.submitted - self.completed
+                                  - self.failed - pending - retrying
+                                  - inflight),
+                "duplicate_replies": self.duplicate_replies,
+                "output_mismatches": self.output_mismatches,
+                "deaths": sum(rep.deaths
+                              for rep in self._replicas.values()),
+                "readmissions": sum(rep.readmissions
+                                    for rep in self._replicas.values()),
+                "recovery_time_s": recovery,
+            }
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Block until every accepted request resolved (or timeout):
+        the bench/test barrier between "trace submitted" and "verdict
+        read"."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not (self._pending or self._retry or self._inflight):
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+        resolutions: List[Tuple[_FleetRequest, Any]] = []
+        with self._lock:
+            leftovers = (list(self._pending)
+                         + [r for _, r in self._retry]
+                         + list(self._inflight.values()))
+            self._pending.clear()
+            self._retry = []
+            self._inflight.clear()
+        for req in leftovers:
+            resolutions.append((req, RuntimeError(
+                f"fleet router {self.name!r} stopped with request "
+                f"{req.rid} unresolved")))
+        self._apply_resolutions(resolutions)
+        self._transport.stop()

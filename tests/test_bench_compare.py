@@ -210,3 +210,46 @@ def test_dropped_gated_metrics_surfaced():
     assert dropped == ["lm_sharded_decode.sharded.decode_step_retraces",
                        "lm_sharded_decode.sharded.kv_bytes_per_device"]
     assert dropped_gated_metrics(base, base) == []
+
+
+def test_fleet_chaos_metrics_gate():
+    """The serving-fleet recovery rows (lm_fleet_chaos A/B):
+    requests_lost and fleet_redispatch_output_mismatches ride the
+    zero-baseline hard gate (a healthy fleet loses nothing and replays
+    bit-identically — ANY loss/mismatch on the candidate is a bug),
+    recovery_time_s regresses UP, and the fault-free aggregate
+    fleet_tokens_per_s regresses DOWN."""
+    assert metric_direction("requests_lost") == -1
+    assert metric_direction("fleet_redispatch_output_mismatches") == -1
+    assert metric_direction("recovery_time_s") == -1
+    assert metric_direction("fleet_tokens_per_s") == 1
+    assert metric_direction("fleet_tokens_per_s_chaos_info") == 0
+    assert metric_direction("redispatched_info") == 0
+    base = _line(lm_fleet_chaos={
+        "requests_lost": 0, "fleet_redispatch_output_mismatches": 0,
+        "recovery_time_s": 0.3, "fleet_tokens_per_s": 1200.0})
+    lossy = _line(lm_fleet_chaos={
+        "requests_lost": 2, "fleet_redispatch_output_mismatches": 0,
+        "recovery_time_s": 0.3, "fleet_tokens_per_s": 1200.0})
+    regressions, _ = compare(base, lossy)
+    assert [r["metric"] for r in regressions] == [
+        "lm_fleet_chaos.requests_lost"]
+    mismatched = _line(lm_fleet_chaos={
+        "requests_lost": 0, "fleet_redispatch_output_mismatches": 1,
+        "recovery_time_s": 0.3, "fleet_tokens_per_s": 1200.0})
+    regressions, _ = compare(base, mismatched)
+    assert [r["metric"] for r in regressions] == [
+        "lm_fleet_chaos.fleet_redispatch_output_mismatches"]
+    slow_recovery = _line(lm_fleet_chaos={
+        "requests_lost": 0, "fleet_redispatch_output_mismatches": 0,
+        "recovery_time_s": 0.9, "fleet_tokens_per_s": 1200.0})
+    regressions, _ = compare(base, slow_recovery)
+    assert [r["metric"] for r in regressions] == [
+        "lm_fleet_chaos.recovery_time_s"]
+    slower_fleet = _line(lm_fleet_chaos={
+        "requests_lost": 0, "fleet_redispatch_output_mismatches": 0,
+        "recovery_time_s": 0.3, "fleet_tokens_per_s": 500.0})
+    regressions, _ = compare(base, slower_fleet)
+    assert [r["metric"] for r in regressions] == [
+        "lm_fleet_chaos.fleet_tokens_per_s"]
+    assert compare(base, base)[0] == []

@@ -329,3 +329,89 @@ def test_three_process_sigstop_transient_stall(tmp_path):
     for rank, (proc, out) in enumerate(zip(procs, outs)):
         assert proc.returncode == 0, f"rank {rank}:\n{out[-3000:]}"
         assert f"RANK{rank}_STALL_OK" in out
+
+
+def test_reconnect_backoff_schedule():
+    """The connect/reconnect retry path backs off on a capped
+    exponential schedule with jitter (a flapping peer used to be
+    hammered at a fixed 20 Hz forever): deterministic ceiling doubles
+    from the base and caps; the jittered draw stays in
+    [ceiling/2, ceiling] and actually varies."""
+    import random
+
+    from multiverso_tpu.parallel.p2p import reconnect_backoff_s
+
+    assert reconnect_backoff_s(0, 0.05, 2.0) == pytest.approx(0.05)
+    assert reconnect_backoff_s(1, 0.05, 2.0) == pytest.approx(0.10)
+    assert reconnect_backoff_s(4, 0.05, 2.0) == pytest.approx(0.80)
+    assert reconnect_backoff_s(9, 0.05, 2.0) == pytest.approx(2.0)  # cap
+    # a peer down for hours keeps the subscriber at the cap instead of
+    # overflowing the float exponent and killing the retry thread
+    assert reconnect_backoff_s(5000, 0.05, 2.0) == pytest.approx(2.0)
+    with pytest.raises(ValueError):
+        reconnect_backoff_s(-1, 0.05, 2.0)
+    rng = random.Random(7)
+    vals = [reconnect_backoff_s(3, 0.05, 2.0, rng) for _ in range(64)]
+    assert all(0.2 <= v <= 0.4 for v in vals)
+    assert len(set(vals)) > 1
+
+
+def test_flapping_endpoint_backs_off_then_resumes(monkeypatch):
+    """A subscriber retrying a vanished publisher sleeps the GROWING
+    backoff schedule (not the old fixed 50 ms), and once the publisher
+    is reachable again it resumes from its retained-window seq exactly
+    as before — the backoff changes WHEN the reconnect happens, never
+    WHAT it delivers. The flap is staged deterministically: the
+    endpoint lookup fails N times, then heals."""
+    kv = _FakeKV()
+    a = P2PTransport(0, 2, kv, label="flap")
+    b = P2PTransport(1, 2, kv, label="flap")
+    try:
+        payloads = [bytes([i]) * 256 for i in range(12)]
+        for i in range(6):
+            a.send(i, payloads[i])
+        assert _drain(b, 0, 0, 6) == payloads[:6]
+
+        sleeps = []
+        real_sleep = time.sleep
+        monkeypatch.setattr(
+            "multiverso_tpu.parallel.p2p.time.sleep",
+            lambda s: (sleeps.append(s), real_sleep(min(s, 0.02)))[1])
+        fails = {"left": 4}
+        orig_endpoint = b._endpoint
+
+        def flaky(publisher, timeout_ms):
+            if publisher == 0 and fails["left"] > 0:
+                fails["left"] -= 1
+                raise OSError("endpoint lookup down (staged flap)")
+            return orig_endpoint(publisher, timeout_ms)
+
+        monkeypatch.setattr(b, "_endpoint", flaky)
+        # cut b's subscription socket so it re-enters the connect path
+        with b._lock:
+            conns = list(b._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            c.close()
+        deadline = time.monotonic() + 20
+        while fails["left"] > 0:
+            assert time.monotonic() < deadline, (fails, sleeps)
+            real_sleep(0.01)
+        # the stream heals and resumes from the retained window
+        for i in range(6, 12):
+            a.send(i, payloads[i])
+        assert _drain(b, 0, 6, 6, timeout=30) == payloads[6:]
+        # the four staged failures slept the capped-exponential
+        # schedule (jittered draws of ceilings 0.05/0.1/0.2/0.4): the
+        # delays GROW well past the old fixed 50 ms — the last one is
+        # at least 4x the first — while the first stays prompt
+        retry_sleeps = [s for s in sleeps if s >= 0.025]
+        assert len(retry_sleeps) >= 4, sleeps
+        assert min(retry_sleeps) <= 0.05
+        assert max(retry_sleeps) > 0.2, retry_sleeps
+    finally:
+        a.stop()
+        b.stop()

@@ -558,3 +558,23 @@ def test_obs_plane_ab_zero_dropped_reports(mv_session):
     assert row["obs_collector_nodes_info"] == 2   # the wire rank landed
     assert row["tokens_per_s_obs_off_info"] > 0
     assert row["tokens_per_s_obs_on_info"] > 0
+
+
+@pytest.mark.slow
+def test_fleet_chaos_ab_recovery_face(mv_session):
+    """The serving_bench fleet-chaos A/B face: a 3-replica fleet under
+    a seeded mid-trace replica kill must lose NOTHING — requests_lost
+    and fleet_redispatch_output_mismatches gate at zero (replayed
+    outputs are bit-identical to the fault-free leg), the death is
+    observed (recovery_time_s > 0), and both fleet throughput columns
+    are live numbers."""
+    from tools.serving_bench import _fleet_chaos_ab
+
+    row = _fleet_chaos_ab(quick=True)
+    assert row["requests_lost"] == 0
+    assert row["fleet_redispatch_output_mismatches"] == 0
+    assert row["deaths_info"] == 1
+    assert row["recovery_time_s"] > 0
+    assert row["fleet_tokens_per_s"] > 0
+    assert row["fleet_tokens_per_s_chaos_info"] > 0
+    assert row["chaos_completed_info"] == row["requests"]

@@ -67,13 +67,22 @@ from typing import Dict, List, Tuple
 # zero-baseline rule like watchdog_trips: the fleet plane's reports
 # are bounded BY DESIGN — a report dropped on an idle loopback
 # collector means the bound machinery broke, a bug, not noise.
+# requests_lost / output_mismatches are the serving-fleet recovery
+# invariants (lm_fleet_chaos A/B): every request accepted by the
+# router must resolve, and a replayed request's output must be
+# bit-identical to the first completion (deterministic greedy decode)
+# — both have a zero baseline by construction, so ANY loss or
+# mismatch on the candidate side gates hard. recovery_time_s (death
+# flagged -> first re-dispatched completion) regresses UP like a
+# latency; fleet_tokens_per_s rides the tokens_per_s rule.
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
                   "capacity_seqs", "prefill_tokens_saved",
                   "prefix_hit_rate", "accepted_per_step")
 _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
                  "kv_bytes_per_device", "decode_step_retraces",
                  "watchdog_trips", "lock_order_violations",
-                 "dropped_reports")
+                 "dropped_reports", "requests_lost",
+                 "output_mismatches", "recovery_time_s")
 
 
 def metric_direction(name: str) -> int:

@@ -14,7 +14,12 @@ questions after the fact:
   fleet SLO burn. A node whose last report wall-timestamp trails the
   fleet's newest by more than ``--silent-after`` (default 2x the median
   report interval) renders **SILENT** — the offline analogue of the
-  live collector's DEGRADED flag.
+  live collector's DEGRADED flag. When a node's registry carries the
+  serving-fleet router's per-replica gauges
+  (``FLEET_REPLICA_STATE/FLEET_INFLIGHT/FLEET_HB_AGE_MS``), the table
+  additionally renders one row per decode REPLICA — lifecycle state
+  (UP/PROBING/DEAD), in-flight count, heartbeat age
+  (docs/SERVING.md "Serving fleet").
 * ``--prom`` — the merged registry as one Prometheus text exposition,
   every sample carrying a ``node`` label.
 * ``--trace OUT.json`` — the merged cross-process Perfetto document:

@@ -83,6 +83,17 @@ acks and merges. tok/s columns are ``_info``; the publisher's
 drop with a live, acking collector means the bounded-window/ack
 machinery broke, a bug.
 
+An ``lm_fleet_chaos`` A/B prices FAILURE RECOVERY: a 3-replica fleet
+(real decode engines on the real ``mvserve`` wire behind the
+``FleetRouter``) serves one mixed-length trace fault-free, then again
+under a seeded ``kill_at_request`` chaos plan that drops one replica
+mid-trace. Gated: ``requests_lost`` and
+``fleet_redispatch_output_mismatches`` at ZERO (every accepted request
+resolves, and replayed outputs are bit-identical to the fault-free
+run — deterministic greedy decode), ``recovery_time_s`` (death
+flagged -> first replayed completion, lower-better), and the
+fault-free aggregate ``fleet_tokens_per_s``.
+
 The JSON line also archives the FULL ``Dashboard.snapshot()`` (every
 Monitor/Histogram/Gauge/Counter/SLO), so a bench run preserves the
 complete instrument state — not just the hand-picked fields above —
@@ -954,6 +965,122 @@ def _obs_plane_ab(server, quick: bool) -> dict:
     }
 
 
+def _fleet_chaos_ab(quick: bool) -> dict:
+    """The serving-fleet recovery A/B (``lm_fleet_chaos``): a 3-replica
+    fleet behind the :class:`FleetRouter` serves one mixed-length trace
+    twice over the real ``mvserve`` wire — fault-free, then with a
+    seeded ``kill_at_request`` chaos plan that drops one replica
+    mid-trace (abrupt in-process death: heartbeats stop, the wire
+    breaks, its in-flight requests are drained into the retry queue and
+    replayed on the survivors). The gated numbers are the recovery
+    INVARIANTS, not the wall clock: ``requests_lost`` must be 0 (every
+    accepted request resolves), ``fleet_redispatch_output_mismatches``
+    must be 0 (deterministic greedy decode means a replay is
+    bit-identical to the fault-free run — checked request by request),
+    ``recovery_time_s`` (death flagged -> first replayed completion)
+    regresses UP, and the fault-free aggregate ``fleet_tokens_per_s``
+    regresses DOWN. Engines are built once and re-wrapped per leg; the
+    chaos leg runs SECOND so the comparison outputs already exist."""
+    from multiverso_tpu.dashboard import Dashboard
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import FaultPlan, FleetConfig, FleetRouter
+    from multiverso_tpu.serving.decode_engine import (DecodeEngine,
+                                                      DecodeEngineConfig)
+    from multiverso_tpu.serving.replica import ReplicaServer
+
+    n_replicas = 3
+    max_prompt, cap = 8, 24
+    cfg = TransformerConfig(vocab_size=256, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, max_seq=32)
+    engines = []
+    for r in range(1, n_replicas + 1):
+        # SAME config (same param seed) on every replica: the fleet's
+        # replay-determinism contract needs replicas to be replicas
+        engine = DecodeEngine(f"fleet_r{r}", TransformerLM(cfg),
+                              DecodeEngineConfig(
+                                  slots=4, max_prompt=max_prompt,
+                                  max_new=cap, max_queue=64,
+                                  prompt_buckets=(max_prompt,),
+                                  watchdog=False))
+        engine.warmup()
+        engines.append(engine)
+    n = 24 if quick else 48
+    trace = _decode_trace(n, seed=47, max_prompt=max_prompt,
+                          max_new_cap=cap, mean_gap_s=0.002, vocab=256,
+                          min_new=6)
+    useful = sum(n_new for _, _, n_new in trace)
+    kill_at = 3                   # the victim's 3rd dequeue: mid-trace
+    legs: dict = {}
+    try:
+        for label, chaos in (("off", ""),
+                             ("on", f"kill_at_request={kill_at}")):
+            kv = _ObsBenchKV()
+            router = FleetRouter(
+                n_replicas + 1, kv, label=f"bench_fleet_{label}",
+                fleet_config=FleetConfig(heartbeat_ms=100,
+                                         deadline_s=120.0))
+            replicas = []
+            try:
+                for i, engine in enumerate(engines):
+                    rep = ReplicaServer(i + 1, n_replicas + 1, kv,
+                                        engine,
+                                        label=f"bench_fleet_{label}",
+                                        heartbeat_ms=100)
+                    if chaos and i == 0:
+                        rep.chaos = FaultPlan(chaos, kill_fn=rep.die)
+                    replicas.append(rep)
+                t0 = time.monotonic()
+                deadline = t0 + 60
+                while router.stats()["up"] < n_replicas:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"fleet never came up: "
+                                           f"{router.replica_rows()}")
+                    time.sleep(0.01)
+                futs = []
+                t0 = time.monotonic()
+                for i, (at, prompt, n_new) in enumerate(trace):
+                    delay = at - (time.monotonic() - t0)
+                    if delay > 0:
+                        time.sleep(delay)
+                    futs.append(router.submit(prompt, n_new,
+                                              session=f"s{i % 6}"))
+                outs = [np.asarray(f.result(timeout=300)["result"],
+                                   np.int32) for f in futs]
+                elapsed = time.monotonic() - t0
+                legs[label] = {"outs": outs, "elapsed": elapsed,
+                               "stats": router.stats()}
+            finally:
+                # a failed leg must not leave router/replica threads
+                # ticking (and holding sockets) under later workloads
+                router.stop()
+                for rep in replicas:
+                    rep.stop(stop_engine=False)
+    finally:
+        for engine in engines:
+            engine.stop()
+    mismatches = sum(
+        1 for a, b in zip(legs["off"]["outs"], legs["on"]["outs"])
+        if a.shape != b.shape or not np.array_equal(a, b))
+    chaos_stats = legs["on"]["stats"]
+    return {
+        "replicas": n_replicas,
+        "requests": n,
+        "useful_tokens": useful,
+        "fleet_tokens_per_s": round(useful / legs["off"]["elapsed"], 1),
+        "fleet_tokens_per_s_chaos_info": round(
+            useful / legs["on"]["elapsed"], 1),
+        "requests_lost": chaos_stats["requests_lost"],
+        "fleet_redispatch_output_mismatches": mismatches
+        + chaos_stats["output_mismatches"],
+        "recovery_time_s": round(chaos_stats["recovery_time_s"] or 0.0, 4),
+        "deaths_info": chaos_stats["deaths"],
+        "redispatched_info": int(Dashboard.get_or_create_counter(
+            "FLEET_REDISPATCH").get()),
+        "chaos_completed_info": chaos_stats["completed"],
+    }
+
+
 def _warm(workload, snap_mgr, buckets) -> None:
     """Compile every bucket outside the timed loop (and outside the
     latency histogram)."""
@@ -1076,6 +1203,10 @@ def run(duration_s: float = 2.0, clients: int = 32,
     # 100 ms reports — tok/s _info, the publisher's 0 dropped reports
     # gated (zero-baseline, like watchdog_trips)
     out["workloads"]["obs_plane"] = _obs_plane_ab(server, quick)
+    # fleet-chaos A/B before the closed-loop phase: its gated numbers
+    # are recovery invariants (counts), but recovery_time_s is a wall
+    # clock that should not absorb 32 saturating client threads
+    out["workloads"]["lm_fleet_chaos"] = _fleet_chaos_ab(quick)
     for name, (workload, knobs, n_clients, payload_fn) in specs.items():
         server.register(name, workload, **knobs)
         server.register(f"{name}_b1", workload, max_batch=1,
