@@ -274,6 +274,33 @@ define_int("spec_k", 0,
            "emitting up to spec_k + 1 tokens per iteration with outputs "
            "token-identical to plain greedy decode. 0 = off (today's "
            "one-token path, bit-for-bit). Needs kv_block_size > 0")
+define_bool("wal", False,
+            "durable online learning: append every acknowledged LOCAL "
+            "table apply to a per-rank write-ahead delta journal "
+            "(io/wal.py) under -wal_dir; a restarted trainer replays "
+            "records past the newest checkpoint's version watermark to "
+            "recover the exact pre-crash table state "
+            "(docs/DISTRIBUTED.md 'Durability')")
+define_string("wal_dir", "",
+              "write-ahead delta journal directory (required when "
+              "-wal=true); segments rotate at -wal_segment_mb and are "
+              "reaped once a completed checkpoint's watermark covers "
+              "them")
+define_bool("wal_fsync", False,
+            "fsync the journal after every appended record: survives "
+            "machine/power failure, not just process death (a killed "
+            "process's written-but-unfsynced records already survive "
+            "in the page cache); costs one fsync per acknowledged add")
+define_int("wal_segment_mb", 64,
+           "journal segment rotation size in MB — bounded replay reaps "
+           "whole segments older than the newest complete checkpoint")
+define_float("params_stale_after_s", 0.0,
+             "staleness-aware serving: when the params publish stream "
+             "has been silent (no source version move observed) for "
+             "this long, replicas keep serving but flag STALE in "
+             "health() and the SERVE_PARAMS_AGE gauge; recovery is "
+             "automatic when a fenced trainer restart republishes. "
+             "0 disables the verdict (the age is still reported)")
 define_string("log_file", "", "optional log sink file")
 define_string("log_level", "info", "debug|info|error|fatal")
 define_bool("trace", False,

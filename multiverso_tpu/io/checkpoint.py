@@ -40,12 +40,21 @@ _MANIFEST = "manifest.json"
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 
 
-def save(directory: str, session: Optional[Session] = None) -> None:
-    """Store every registered table under ``directory``."""
+def save(directory: str, session: Optional[Session] = None
+         ) -> Optional[dict]:
+    """Store every registered table under ``directory``.
+
+    The manifest records each table's version WATERMARK — the version
+    of the exact bytes stored, captured atomically with the copy
+    (``table.store`` returns it) — which is what bounds WAL replay:
+    ``restore`` re-installs the watermark and ``restore_latest``
+    replays only journal records past it. Returns the manifest on
+    rank 0 (None elsewhere)."""
     sess = session or Session.get()
     if not sess.started:
         Log.fatal("save() requires an initialised session")
     sess.barrier()
+    manifest = None
     if sess.rank == 0:
         if not is_remote(directory):
             os.makedirs(directory, exist_ok=True)
@@ -53,17 +62,20 @@ def save(directory: str, session: Optional[Session] = None) -> None:
         for table in sess.tables:
             name = f"table_{table.table_id}.bin"
             with open_stream(_join(directory, name), "wb") as stream:
-                table.store(stream)
+                watermark = table.store(stream)
             manifest["tables"].append({
                 "id": table.table_id,
                 "type": type(table).__name__,
                 "name": getattr(table, "name", ""),
                 "file": name,
+                "version": (int(watermark) if watermark is not None
+                            else None),
             })
         with open_stream(_join(directory, _MANIFEST), "wb") as f:
             f.write(json.dumps(manifest, indent=2).encode("utf-8"))
         Log.info("checkpoint saved: %d table(s) -> %s", len(sess.tables), directory)
     sess.barrier()
+    return manifest
 
 
 def restore(directory: str, session: Optional[Session] = None) -> None:
@@ -89,6 +101,13 @@ def restore(directory: str, session: Optional[Session] = None) -> None:
                 f"session has {type(table).__name__}")
         with open_stream(_join(directory, entry["file"]), "rb") as stream:
             table.load(stream)
+        if entry.get("version") is not None:
+            # install the manifest's version WATERMARK: load() bumped
+            # the local counter, but these bytes ARE the watermarked
+            # state — WAL replay targets version > watermark and must
+            # land on the exact pre-crash version
+            with table._lock:
+                table.version = int(entry["version"])
     Log.info("checkpoint restored: %d table(s) <- %s", len(sess.tables), directory)
 
 
@@ -213,22 +232,93 @@ def list_steps(root: str) -> List[int]:
     return [step for step, _ in _step_dirs(root)]
 
 
-def restore_latest(root: str, session: Optional[Session] = None
-                   ) -> Optional[int]:
-    """Restore the newest complete checkpoint under ``root``.
-
-    Returns the restored step, or None if no checkpoint exists (fresh
-    start). The failure-recovery entry point the reference never wired up
-    (SURVEY §5.3: crash recovery = checkpoint/resume driven by the app): a
-    restarted job calls this before training and resumes from wherever the
-    autosaver last landed.
-    """
-    dirs = _step_dirs(root)
-    if not dirs:
+def verify_step(directory: str) -> Optional[str]:
+    """None when ``directory`` holds a complete restorable checkpoint;
+    else a short reason (missing/unreadable manifest, missing or
+    truncated table file). Local-path checkpoints only — object-store
+    checkpoints commit by manifest-last write order and are trusted."""
+    if is_remote(directory):
         return None
-    step, name = dirs[-1]
-    restore(_join(root, name), session)
-    return step
+    manifest_path = _join(directory, _MANIFEST)
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError) as exc:
+        return f"manifest unreadable ({exc})"
+    from .stream import validate_record_stream
+
+    for entry in manifest.get("tables", []):
+        name = entry.get("file")
+        if name is None:
+            continue              # orbax-storage entries verify on load
+        path = os.path.join(directory, name)
+        if not os.path.exists(path):
+            return f"missing table file {name}"
+        problem = validate_record_stream(path)
+        if problem:
+            return f"{name}: {problem}"
+    return None
+
+
+#: stats of the most recent restore_latest WAL replay (None = no replay
+#: ran) — benches/tests read it next to the returned step
+LAST_WAL_REPLAY: Optional[dict] = None
+
+
+def restore_latest(root: str, session: Optional[Session] = None,
+                   wal_dir: Optional[str] = None,
+                   wal_rank: Optional[int] = None) -> Optional[int]:
+    """Restore the newest COMPLETE checkpoint under ``root``, then
+    replay the write-ahead journal past its version watermarks.
+
+    A torn/incomplete step dir (missing manifest, truncated or missing
+    table file — a crash mid-save on a filesystem without atomic
+    rename, or a partially-copied archive) is detected BEFORE any table
+    is touched and skipped loudly, falling back to the newest complete
+    step instead of failing or half-loading.
+
+    WAL replay: with ``wal_dir`` given (or the ``-wal``/``-wal_dir``
+    flags set), journal records with version > the restored watermark
+    are replayed in version order, reaching the exact pre-crash table
+    state; the replay stats land in :data:`LAST_WAL_REPLAY`. Reaped
+    segments (``Autosaver`` reaps those a completed checkpoint covers)
+    are gone from disk, so replay work is bounded by one checkpoint
+    interval.
+
+    Returns the restored step, or None if no restorable checkpoint
+    exists (fresh start — the journal, if any, still replays from
+    version 0, so a pre-first-checkpoint crash loses nothing).
+    """
+    global LAST_WAL_REPLAY
+    from .. import config
+
+    LAST_WAL_REPLAY = None
+    sess = session or Session.get()
+    dirs = _step_dirs(root)
+    restored = None
+    for step, name in reversed(dirs):
+        path = _join(root, name)
+        problem = verify_step(path)
+        if problem is not None:
+            Log.error("checkpoint %s is torn/incomplete (%s); falling "
+                      "back to the previous complete step", path,
+                      problem)
+            continue
+        restore(path, sess)
+        restored = step
+        break
+    if restored is None and dirs:
+        Log.error("no restorable checkpoint under %s (%d torn step "
+                  "dir(s) skipped)", root, len(dirs))
+    if wal_dir is None and config.get_flag("wal"):
+        wal_dir = config.get_flag("wal_dir")
+    if wal_dir:
+        from . import wal as _wal
+
+        rank = (wal_rank if wal_rank is not None
+                else (sess.rank if sess.started else 0))
+        LAST_WAL_REPLAY = _wal.replay(wal_dir, rank, session=sess)
+    return restored
 
 
 class Autosaver:
@@ -292,21 +382,48 @@ class Autosaver:
                 # written LAST by save() and _step_dirs only counts
                 # manifest-bearing dirs, so manifest-commit is the atomic
                 # point
-                save(final, sess)
+                manifest = save(final, sess)
                 if sess.rank == 0:
                     self._prune()
             else:
                 tmp = final + ".tmp"
                 if os.path.isdir(tmp):
                     shutil.rmtree(tmp)
-                save(tmp, sess)
+                manifest = save(tmp, sess)
                 if sess.rank == 0:
                     if os.path.isdir(final):
                         shutil.rmtree(final)
                     os.replace(tmp, final)
                     self._prune()
             sess.barrier()
+            self._reap_wal(sess, manifest)
             self._last_time = time.monotonic()
+
+    @staticmethod
+    def _reap_wal(sess, manifest: Optional[dict]) -> None:
+        """Bounded replay: once a checkpoint is COMPLETE (renamed into
+        place, barrier passed), journal segments every record of which
+        the checkpoint's version watermarks cover are dead weight —
+        replay starts past the watermark — so reap them. Rank 0 reaps
+        by the manifest it wrote; other ranks (``save`` returns None
+        there) reap their per-rank journal by their OWN table versions
+        as of the post-save barrier — their local records up to that
+        point are superseded by the checkpoint a restart restores, and
+        an unreaped journal would otherwise grow without bound on every
+        rank but 0."""
+        wal = getattr(sess, "wal", None)
+        if wal is None:
+            return
+        if manifest is not None:
+            watermarks = {entry["id"]: int(entry["version"])
+                          for entry in manifest.get("tables", [])
+                          if entry.get("version") is not None}
+        else:
+            watermarks = {t.table_id: int(t.version)
+                          for t in sess.tables
+                          if getattr(t, "version", None) is not None}
+        if watermarks:
+            wal.reap(watermarks)
 
     def _prune(self) -> None:
         old = _step_dirs(self._root)[:-self._keep]

@@ -138,20 +138,81 @@ def write_array(stream: BinaryIO, array: np.ndarray) -> None:
     stream.write(array.tobytes())
 
 
-def read_array(stream: BinaryIO) -> np.ndarray:
+def _read_record_header(stream: BinaryIO):
+    """``(dtype, shape)`` of the next ``write_array`` record, or None
+    at a clean EOF. THE one framing parser — ``read_array`` (load) and
+    ``validate_record_stream`` (torn-file detection) both ride it, so
+    the format cannot drift between them. Raises ValueError on a
+    malformed/truncated header."""
     magic = stream.read(4)
+    if not magic:
+        return None
     if magic != _MAGIC:
-        Log.fatal(f"bad table record magic {magic!r}")
-    (tag_len,) = struct.unpack("<B", stream.read(1))
-    tag = stream.read(tag_len).decode("ascii")
+        raise ValueError(f"bad table record magic {magic!r}")
+    head = stream.read(1)
+    if len(head) < 1:
+        raise ValueError("truncated record header")
+    (tag_len,) = struct.unpack("<B", head)
+    tag = stream.read(tag_len)
+    ndim_b = stream.read(1)
+    if len(tag) < tag_len or len(ndim_b) < 1:
+        raise ValueError("truncated record header")
+    (ndim,) = struct.unpack("<B", ndim_b)
+    dims = stream.read(8 * ndim)
+    if len(dims) < 8 * ndim:
+        raise ValueError("truncated record header")
+    shape = (tuple(struct.unpack(f"<{ndim}q", dims)) if ndim else ())
     try:
-        dtype = np.dtype(tag)
-    except TypeError:
-        import ml_dtypes   # extension dtype written by name
+        dtype = np.dtype(tag.decode("ascii"))
+    except (TypeError, UnicodeDecodeError):
+        try:
+            import ml_dtypes   # extension dtype written by name
 
-        dtype = np.dtype(getattr(ml_dtypes, tag))
-    (ndim,) = struct.unpack("<B", stream.read(1))
-    shape = tuple(struct.unpack("<q", stream.read(8))[0] for _ in range(ndim))
+            dtype = np.dtype(getattr(ml_dtypes,
+                                     tag.decode("ascii", "replace")))
+        except (AttributeError, ImportError, TypeError):
+            raise ValueError(f"unknown dtype tag {tag!r}") from None
+    return dtype, shape
+
+
+def validate_record_stream(path: str) -> Optional[str]:
+    """Cheap completeness check of a local ``write_array`` record file.
+
+    Walks the record headers (via the shared parser) and verifies every
+    payload fits inside the file WITHOUT loading the arrays — the
+    checkpoint layer's torn-file detector (a crash mid-``table.store``
+    leaves a truncated payload or header). Returns None when complete,
+    else a short reason."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            while True:
+                pos = f.tell()
+                try:
+                    header = _read_record_header(f)
+                except ValueError as exc:
+                    return f"{exc} at byte {pos}"
+                if header is None:
+                    return None                   # clean EOF
+                dtype, shape = header
+                count = int(np.prod(shape)) if shape else 1
+                need = count * dtype.itemsize
+                if size - f.tell() < need:
+                    return (f"truncated payload at byte {pos} "
+                            f"(record needs {need} bytes)")
+                f.seek(need, 1)
+    except OSError as exc:
+        return str(exc)
+
+
+def read_array(stream: BinaryIO) -> np.ndarray:
+    try:
+        header = _read_record_header(stream)
+    except ValueError as exc:
+        Log.fatal(f"bad table record: {exc}")
+    if header is None:
+        Log.fatal("bad table record: unexpected end of stream")
+    dtype, shape = header
     count = int(np.prod(shape)) if shape else 1
     buf = stream.read(count * dtype.itemsize)
     return np.frombuffer(buf, dtype=dtype).reshape(shape).copy()

@@ -86,15 +86,19 @@ from .. import config, trace
 from ..log import Log
 from ..quantization import SparseFilter
 
-# record kinds
-DENSE, KEYED, KV, PART = 0, 1, 2, 3
+# record kinds (STATE carries the ABSOLUTE table value — the fenced
+# restart's rebase record, installed via set-state, not folded via add)
+DENSE, KEYED, KV, PART, STATE = 0, 1, 2, 3, 4
 
-_HEADER = struct.Struct("<BBiiffffdQQ")  # kind, n_arrays, table_id,
+_HEADER = struct.Struct("<BBiiffffdQQIQ")  # kind, n_arrays, table_id,
 #                          worker_id, lr, momentum, rho, lam, send_ts,
 #                          trace_id, span_id (0,0 = untraced publish) —
 #                          the cross-process trace link: a consumer's
 #                          bus.apply span parents under the publisher's
-#                          bus.publish span by these two u64s
+#                          bus.publish span by these two u64s —
+#                          then epoch (u32; trainer incarnation, 0 =
+#                          unfenced) and version (u64; publisher-side
+#                          post-apply table version, 0 = unknown)
 _PART_HEADER = struct.Struct("<BII")   # kind=PART, part_index, n_parts
 
 # Publication/consumption counters survive init/shutdown cycles within one
@@ -111,7 +115,8 @@ _active_bus: Optional["AsyncDeltaBus"] = None
 
 
 def _serialize(kind: int, table_id: int, option, arrays: Sequence[np.ndarray],
-               ctx: Optional[trace.SpanContext] = None) -> bytes:
+               ctx: Optional[trace.SpanContext] = None, epoch: int = 0,
+               version: int = 0) -> bytes:
     tid, sid = (ctx.trace_id, ctx.span_id) if ctx is not None else (0, 0)
     buf = io.BytesIO()
     buf.write(_HEADER.pack(kind, len(arrays), table_id,
@@ -120,7 +125,8 @@ def _serialize(kind: int, table_id: int, option, arrays: Sequence[np.ndarray],
                            float(getattr(option, "momentum", 0.0)),
                            float(getattr(option, "rho", 0.0)),
                            float(getattr(option, "lam", 0.0)),
-                           time.time(), tid, sid))
+                           time.time(), tid, sid, int(epoch),
+                           int(version)))
     from ..io.stream import write_array
 
     for arr in arrays:
@@ -135,12 +141,100 @@ def _deserialize(data: bytes):
 
     buf = io.BytesIO(data)
     (kind, n_arrays, table_id, wid, lr, mom, rho, lam, ts, trace_id,
-     span_id) = _HEADER.unpack(buf.read(_HEADER.size))
+     span_id, epoch, version) = _HEADER.unpack(buf.read(_HEADER.size))
     arrays = [read_array(buf) for _ in range(n_arrays)]
     option = AddOption(worker_id=wid, learning_rate=lr, momentum=mom,
                        rho=rho, lam=lam)
     ctx = trace.SpanContext(trace_id, span_id) if trace_id else None
-    return kind, table_id, option, arrays, ts, ctx
+    return kind, table_id, option, arrays, ts, ctx, epoch, version
+
+
+def _kv_get_int(client, key: str, default: int = 0) -> int:
+    """Best-effort int read covering both KV client generations:
+    ``key_value_try_get`` is absent on jax<=0.4.x's
+    DistributedRuntimeClient (PR 12 finding), so fall back to a short
+    blocking get."""
+    try:
+        if hasattr(client, "key_value_try_get"):
+            return int(str(client.key_value_try_get(key)))
+        return int(str(client.blocking_key_value_get(key, 200)))
+    except Exception:
+        return default
+
+
+def claim_epoch(client, key: str = "mvps/epoch") -> int:
+    """Claim the next trainer incarnation epoch in the coordination KV.
+
+    The monotonic fencing token of the restart contract: every publish
+    of the claiming incarnation is stamped with it, appliers track the
+    highest epoch seen and reject lower-epoch records, so a
+    paused-then-resumed zombie trainer cannot fold stale deltas into a
+    converged fleet (Parameter Server's fenced server recovery,
+    OSDI '14). One trainer restarts at a time by deployment contract —
+    concurrent claimants are a split-brain the fence then resolves in
+    favor of whichever claimed LAST.
+
+    A fencing-token read must FAIL LOUDLY on transport errors: silently
+    defaulting to 0 would rewind the key and turn the legitimately
+    restarted trainer into a permanent zombie (every publish below the
+    fleet's fence). Only a genuinely ABSENT key reads as 0."""
+    if hasattr(client, "key_value_try_get"):
+        try:
+            cur = int(str(client.key_value_try_get(key)))
+        except Exception as exc:
+            if "NOT_FOUND" not in str(exc) \
+                    and not isinstance(exc, KeyError):
+                Log.fatal(f"claim_epoch: cannot read fence key {key!r} "
+                          f"({exc}) — claiming blindly could regress "
+                          f"the epoch and fence out this trainer")
+            cur = 0
+    else:
+        # jax<=0.4.x clients: no try_get — a short blocking get whose
+        # timeout means "absent" (the first claim). The real
+        # DistributedRuntimeClient raises XlaRuntimeError
+        # ("DEADLINE_EXCEEDED...") rather than TimeoutError, so match
+        # the timeout by MESSAGE too; anything else still fails loudly.
+        try:
+            cur = int(str(client.blocking_key_value_get(key, 2_000)))
+        except Exception as exc:
+            msg = str(exc)
+            if (isinstance(exc, TimeoutError) or "DEADLINE" in msg
+                    or "NOT_FOUND" in msg):
+                cur = 0
+            else:
+                Log.fatal(f"claim_epoch: cannot read fence key {key!r} "
+                          f"({exc}) — claiming blindly could regress "
+                          f"the epoch and fence out this trainer")
+    nxt = cur + 1
+    client.key_value_set(key, str(nxt), allow_overwrite=True)
+    return nxt
+
+
+class EpochFence:
+    """Highest-epoch-wins admission check for fenced publishes.
+
+    ``admit(epoch)`` returns False for records from a lower incarnation
+    than the highest ever seen (and counts the rejection); epoch 0
+    (unfenced legacy records) always passes and never advances the
+    fence. GIL-atomic int state: callers are single applier threads."""
+
+    def __init__(self, name: str = "fence") -> None:
+        from ..dashboard import Dashboard
+
+        self.epoch = 0
+        self.rejections = 0
+        self._counter = Dashboard.get_or_create_counter(
+            f"EPOCH_FENCE_REJECTIONS[{name}]")
+
+    def admit(self, epoch: int) -> bool:
+        if not epoch:
+            return True
+        if epoch < self.epoch:
+            self.rejections += 1
+            self._counter.inc()
+            return False
+        self.epoch = epoch
+        return True
 
 
 class AsyncDeltaBus:
@@ -227,6 +321,12 @@ class AsyncDeltaBus:
         self._t0 = time.perf_counter()
         self.pub_bytes = 0
         self.apply_bytes = 0
+        # trainer incarnation epoch: 0 = unfenced (the default); a
+        # restarted trainer claims one (claim_epoch) and every publish
+        # carries it. The applier-side fence is highest-epoch-wins, so
+        # a zombie incarnation's late records are rejected, not folded.
+        self.epoch = 0
+        self._fence = EpochFence(f"bus.r{self._rank}")
         self._mon_pub = Dashboard.get_or_create("ASYNC_BUS[PUBLISH]")
         self._mon_apply = Dashboard.get_or_create("ASYNC_BUS[APPLY]")
         self._mon_lat = Dashboard.get_or_create("ASYNC_BUS[LATENCY]")
@@ -408,6 +508,11 @@ class AsyncDeltaBus:
             f = self._filters[dtype] = SparseFilter(clip=0.0, dtype=dtype)
         return f
 
+    def set_epoch(self, epoch: int) -> None:
+        """Stamp subsequent publishes with a claimed incarnation epoch
+        (:func:`claim_epoch`); appliers fence on it."""
+        self.epoch = int(epoch)
+
     def publish_dense(self, table_id: int, delta: np.ndarray, option) -> None:
         delta = np.ascontiguousarray(delta)
         # bus.publish span: its context rides the wire header, so every
@@ -416,7 +521,8 @@ class AsyncDeltaBus:
         sp = trace.start_span("bus.publish", table_id=table_id,
                               wire="dense")
         blobs = self._filter_for(delta.dtype).filter_in([delta.ravel()])
-        payload = _serialize(DENSE, table_id, option, blobs, sp.context)
+        payload = _serialize(DENSE, table_id, option, blobs, sp.context,
+                             epoch=self.epoch)
         self._publish(payload)
         sp.end(bytes=len(payload))
 
@@ -425,9 +531,23 @@ class AsyncDeltaBus:
         sp = trace.start_span("bus.publish", table_id=table_id,
                               wire="keyed")
         payload = _serialize(KEYED, table_id, option, [ids, vals],
-                             sp.context)
+                             sp.context, epoch=self.epoch)
         self._publish(payload)
         sp.end(bytes=len(payload), rows=int(ids.shape[0]))
+
+    def publish_state(self, table) -> None:
+        """Publish the ABSOLUTE table value (the fenced restart's rebase
+        record): consumers install it via set-state + exact version
+        rather than folding a delta, so a replica that missed the dead
+        incarnation's tail re-converges in one record."""
+        arrays, version = table._state_arrays()
+        sp = trace.start_span("bus.publish", table_id=table.table_id,
+                              wire="state")
+        payload = _serialize(STATE, table.table_id, None, arrays,
+                             sp.context, epoch=self.epoch,
+                             version=version)
+        self._publish(payload)
+        sp.end(bytes=len(payload), version=version)
 
     def publish_delta(self, table, delta: np.ndarray, option) -> None:
         """Publish a whole-table delta in its cheapest sound representation.
@@ -540,12 +660,22 @@ class AsyncDeltaBus:
                     Log.error("async PS drain error: %s", exc)
 
     def _apply(self, data: bytes) -> None:
-        kind, table_id, option, arrays, send_ts, ctx = _deserialize(data)
+        (kind, table_id, option, arrays, send_ts, ctx, epoch,
+         version) = _deserialize(data)
         # the carried context makes this apply a CHILD of the remote
         # publish span: one trace id covers the cross-process hop, so a
         # merged view shows publish->apply as one causal chain
         sp = (trace.start_span("bus.apply", parent=ctx, table_id=table_id)
               if ctx is not None else trace.NULL_SPAN)
+        if not self._fence.admit(epoch):
+            # a lower-incarnation (zombie) trainer's record: folding it
+            # would walk a converged replica backwards — reject, count,
+            # and keep the stream position (the record IS consumed)
+            Log.error("async PS: rejected epoch-%d record for table %d "
+                      "(fence at epoch %d)", epoch, table_id,
+                      self._fence.epoch)
+            sp.end(error="epoch_fenced", epoch=epoch)
+            return
         self._mon_apply.begin()
         table = self._sess.table(table_id)
         if kind == DENSE:
@@ -557,6 +687,10 @@ class AsyncDeltaBus:
             table._apply_remote_keyed(arrays[0], arrays[1], option)
         elif kind == KV:
             table._apply_remote_kv(arrays[0], arrays[1])
+        elif kind == STATE:
+            # fenced-restart rebase: install the absolute value at the
+            # publisher's exact (version, epoch)
+            table._install_state_arrays(arrays, version, epoch)
         else:
             Log.error("async PS: unknown record kind %d", kind)
         self._mon_apply.end()
@@ -578,6 +712,9 @@ class AsyncDeltaBus:
             "apply_mb_s": self.apply_bytes / 1e6 / dt,
             "inflight_bytes": self._inflight_bytes,
             "apply_lat_avg_ms": self._mon_lat.average_ms(),
+            "epoch": self.epoch,
+            "fence_epoch": self._fence.epoch,
+            "fence_rejections": self._fence.rejections,
         }
 
     # -- failure handling --------------------------------------------------

@@ -478,6 +478,19 @@ class P2PTransport:
 
     def stop(self) -> None:
         self._stop.set()
+        # close() alone does not reliably interrupt a thread blocked in
+        # accept(); on a busy mesh a peer's reconnect attempt wakes it
+        # by accident, but a QUIET topology (param plane, a stopped
+        # fleet) left the accept thread parked until the joiner's 5-s
+        # timeout expired — one self-connect wakes it deterministically
+        # (the dummy conn closes immediately, so the hello read fails
+        # fast and the loop observes the stop flag)
+        try:
+            port = self._listener.getsockname()[1]
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.2).close()
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:

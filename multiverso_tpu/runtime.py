@@ -40,6 +40,7 @@ class Session:
         self.role: int = _ROLE_ALL
         self.started = False
         self.async_bus: Optional[Any] = None  # cross-process async PS plane
+        self.wal: Optional[Any] = None  # -wal write-ahead delta journal
         self.failure_detector: Optional[Any] = None  # -failure_timeout_s
         self.metrics_exporter: Optional[Any] = None  # -metrics_jsonl
         self.obs_agent: Optional[Any] = None  # -obs_plane fleet agent
@@ -119,6 +120,20 @@ class Session:
                 self.metrics_exporter = MetricsExporter(
                     interval_s=float(config.get_flag("metrics_interval_s")),
                     sink=metrics_path).start()
+            if config.get_flag("wal") and self.wal is None:
+                wal_dir = config.get_flag("wal_dir")
+                if not wal_dir:
+                    Log.fatal("-wal=true requires -wal_dir=PATH (the "
+                              "journal must land somewhere durable)")
+                from .io.wal import DeltaWAL
+
+                # construction runs torn-tail recovery and opens a
+                # fresh segment for this incarnation
+                self.wal = DeltaWAL(
+                    wal_dir, rank=self.topo.rank,
+                    segment_bytes=int(
+                        config.get_flag("wal_segment_mb")) << 20,
+                    fsync=config.get_flag("wal_fsync"))
             topology.barrier("mv_init")
             from .parallel.async_ps import AsyncDeltaBus
 
@@ -195,6 +210,7 @@ class Session:
                 tables, self.tables = self.tables, []
                 detector, self.failure_detector = self.failure_detector, None
                 bus, self.async_bus = self.async_bus, None
+                wal, self.wal = self.wal, None
                 exporter, self.metrics_exporter = self.metrics_exporter, None
                 obs, self.obs_agent = self.obs_agent, None
         if not claimed:
@@ -203,12 +219,12 @@ class Session:
             return
         try:
             self._teardown(topo, servers, tables, detector, bus, exporter,
-                           obs)
+                           obs, wal)
         finally:
             done.set()
 
     def _teardown(self, topo, servers, tables, detector, bus,
-                  exporter, obs=None) -> None:
+                  exporter, obs=None, wal=None) -> None:
         # the obs agent ships its FINAL report first, while the engines
         # it summarizes are still alive to be read
         if obs is not None:
@@ -272,6 +288,10 @@ class Session:
             flush = getattr(table, "flush", None)
             if flush is not None:
                 flush()
+        if wal is not None:
+            # after the table flushes: no apply path can append anymore
+            # (the registry was emptied when the state was claimed)
+            wal.close()
         if exporter is not None:
             # final report: the shutdown snapshot lands in the JSONL
             # archive even when the session dies mid-interval

@@ -38,11 +38,20 @@ The inference half of the train/serve stack (docs/SERVING.md). Pieces:
   requests replay bit-identically on survivors, and
   :class:`FaultPlan` (``-chaos``) stages the failures that prove it
   (docs/SERVING.md "Serving fleet").
+* the durable train half — :class:`ParamPublisher` /
+  :class:`ParamSubscriber` (``mvparam`` wire): the trainer's fenced
+  parameter publish stream into serving replicas. Each trainer
+  incarnation claims a monotonic epoch, rebases subscribers with one
+  STATE record on restart, and lower-epoch (zombie) records are
+  rejected by the epoch fence; subscribers flag STALE past
+  ``-params_stale_after_s`` when the stream goes silent and recover
+  automatically (docs/DISTRIBUTED.md "Durability").
 """
 
 from .batcher import (BatcherConfig, MicroBatcher, OverloadedError,
                       bucket_for, shape_buckets)
 from .faultinject import FaultPlan
+from .param_plane import ParamPublisher, ParamSubscriber
 from .replica import ReplicaServer, serve_replica
 from .router import (DeadlineExceededError, FleetConfig, FleetError,
                      FleetRouter, retry_backoff_s)
@@ -66,5 +75,5 @@ __all__ = [
     "EngineWatchdog", "WatchdogConfig", "ObsAgent", "ObsCollector",
     "FaultPlan", "ReplicaServer", "serve_replica", "FleetRouter",
     "FleetConfig", "FleetError", "DeadlineExceededError",
-    "retry_backoff_s",
+    "retry_backoff_s", "ParamPublisher", "ParamSubscriber",
 ]

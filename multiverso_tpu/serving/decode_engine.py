@@ -672,6 +672,12 @@ class DecodeEngine:
             f"SERVE_ITL[{name}]")
         self.tps_gauge = Dashboard.get_or_create_gauge(f"DECODE_TPS[{name}]")
         self.occ_gauge = Dashboard.get_or_create_gauge(f"SLOT_OCC[{name}]")
+        # staleness-aware serving: seconds since the served source last
+        # moved (SnapshotManager.params_age_s), refreshed on health()
+        # polls — the publish-stream-went-silent signal the obs plane
+        # ships and -params_stale_after_s turns into a STALE verdict
+        self.params_age_gauge = Dashboard.get_or_create_gauge(
+            f"SERVE_PARAMS_AGE[{name}]")
         self.shed_counter = Dashboard.get_or_create_counter(
             f"SERVE_SHED[{name}]")
         self.steps_counter = Dashboard.get_or_create_counter(
@@ -828,9 +834,26 @@ class DecodeEngine:
         with self._lock:
             depth = len(self._q)
             age = (now - self._q[0].t_enq) if self._q else 0.0
+            pinned = self._pinned_version
+            snap = self._snap
+        from .. import config
+
+        # params staleness: how long since the SERVED source last moved
+        # (the trainer's publish stream going silent). The verdict is
+        # advisory — the engine keeps serving its frozen snapshot — and
+        # clears automatically when a fenced restart republishes.
+        params_age = self._manager.params_age_s()
+        stale_after = float(config.get_flag("params_stale_after_s"))
+        self.params_age_gauge.set(params_age)
         return {
             "iters_total": self.iters_total,
             "last_iter_age_s": now - self._last_progress,
+            "snapshot_version": (-1 if pinned is None else int(pinned)),
+            "snapshot_epoch": (0 if snap is None
+                               else int(getattr(snap, "epoch", 0))),
+            "params_age_s": round(params_age, 4),
+            "params_stale": self._manager.params_stale(
+                stale_after, age_s=params_age),
             # a monolithic admission in flight counts as live: its
             # requests are already popped from the queue (queue_age_s
             # reads 0) and no slot is active yet, so without it a

@@ -33,6 +33,27 @@ comma-separated, all optional)::
                              being dead — the router must not lose its
                              requests when it flags it)
 
+Trainer-side failure points (PR 14 — the durability pipeline's chaos):
+
+    kill_trainer_at_publish=K   exit the trainer (exit code 43) at its
+                             K-th parameter publish (1-based), BEFORE
+                             the record hits the wire — the
+                             acknowledged-and-journaled update whose
+                             publish never happened is exactly what
+                             checkpoint+WAL recovery must not lose
+    wal_torn_tail            at the kill, tear the journal's LAST
+                             record in half (the crash caught the
+                             append mid-write) — recovery must
+                             truncate it deterministically
+    wal_bad_crc              at the kill, flip a payload bit in the
+                             journal's last record — same recovery
+                             path, different corruption
+    zombie_epoch=K:E         from the K-th publish on, stamp records
+                             with stale epoch E — the
+                             paused-then-resumed zombie trainer whose
+                             publishes the fleet's epoch fence must
+                             reject
+
 Determinism: every probabilistic decision draws from one
 ``random.Random(seed)`` stream in consultation order, so a given
 ``(spec, seed)`` pair replays the identical fault schedule — a flaky
@@ -84,11 +105,20 @@ class FaultPlan:
         self.delay_p: float = 0.0
         self.drop_p: float = 0.0
         self.heartbeat_scale: float = 1.0
+        self.kill_trainer_at: int = 0         # 0 = never
+        self.wal_fault: str = ""              # "", torn_tail, bad_crc
+        self.zombie_at: int = 0               # 0 = never
+        self.zombie_epoch: int = 0
+        self._wal = None                      # attach_wal() target
         self.counts: Dict[str, int] = {
-            "kills": 0, "wedges": 0, "wire_delays": 0, "wire_drops": 0}
+            "kills": 0, "wedges": 0, "wire_delays": 0, "wire_drops": 0,
+            "trainer_kills": 0, "wal_faults": 0, "zombie_publishes": 0}
         for directive in filter(None,
                                 (d.strip() for d in self.spec.split(","))):
             key, _, val = directive.partition("=")
+            if not val and key.strip() in ("wal_torn_tail",
+                                           "wal_bad_crc"):
+                val = "1"       # valueless flag directives, as documented
             if not val:
                 raise ValueError(f"chaos directive {directive!r} needs "
                                  f"KEY=VALUE")
@@ -114,6 +144,21 @@ class FaultPlan:
             self.heartbeat_scale = float(val)
             if self.heartbeat_scale < 1.0:
                 raise ValueError("slow_heartbeat scale must be >= 1")
+        elif key == "kill_trainer_at_publish":
+            self.kill_trainer_at = int(val)
+        elif key == "wal_torn_tail":
+            if val not in ("1", "true"):
+                raise ValueError("wal_torn_tail takes =1")
+            self.wal_fault = "torn_tail"
+        elif key == "wal_bad_crc":
+            if val not in ("1", "true"):
+                raise ValueError("wal_bad_crc takes =1")
+            self.wal_fault = "bad_crc"
+        elif key == "zombie_epoch":
+            k, _, e = val.partition(":")
+            self.zombie_at, self.zombie_epoch = int(k), int(e or 0)
+            if self.zombie_at < 1:
+                raise ValueError("zombie_epoch needs K >= 1 (K:E)")
         else:
             raise ValueError(f"unknown failure point {key!r}")
 
@@ -145,6 +190,38 @@ class FaultPlan:
             return self.wedge_s
         return 0.0
 
+    def attach_wal(self, wal) -> None:
+        """Point the WAL-corruption faults at a journal (anything with
+        ``corrupt_tail(kind)``); the trainer bootstrap wires the
+        session's :class:`~multiverso_tpu.io.wal.DeltaWAL` here."""
+        self._wal = wal
+
+    def on_trainer_publish(self, k: int) -> None:
+        """Consulted as the trainer issues its ``k``-th (1-based)
+        parameter publish, BEFORE the record hits the wire. Fires the
+        trainer kill (does not return) — first staging the armed WAL
+        corruption, so the crash leaves exactly the torn/bad tail the
+        recovery path must truncate."""
+        if self.kill_trainer_at and k == self.kill_trainer_at:
+            if self.wal_fault and self._wal is not None:
+                self.counts["wal_faults"] += 1
+                Log.error("chaos: corrupting WAL tail (%s) before the "
+                          "trainer kill", self.wal_fault)
+                self._wal.corrupt_tail(self.wal_fault)
+            self.counts["trainer_kills"] += 1
+            Log.error("chaos: killing trainer at publish %d "
+                      "(kill_trainer_at_publish)", k)
+            self._kill_fn()
+
+    def publish_epoch(self, k: int, epoch: int) -> int:
+        """Epoch to stamp the ``k``-th publish with: the claimed
+        ``epoch``, or the stale zombie epoch once ``zombie_epoch=K:E``
+        is in effect (the fence-rejection the acceptance test counts)."""
+        if self.zombie_at and k >= self.zombie_at:
+            self.counts["zombie_publishes"] += 1
+            return self.zombie_epoch
+        return epoch
+
     def wire_delay_s(self) -> float:
         """Consulted before each outbound wire record: seconds to stall
         the send (0.0 = send now)."""
@@ -162,7 +239,9 @@ class FaultPlan:
 
     def active(self) -> bool:
         return bool(self.kill_at or self.wedge_at or self.delay_s
-                    or self.drop_p or self.heartbeat_scale != 1.0)
+                    or self.drop_p or self.heartbeat_scale != 1.0
+                    or self.kill_trainer_at or self.wal_fault
+                    or self.zombie_at)
 
     def stats(self) -> Dict[str, Any]:
         return {"spec": self.spec, "seed": self.seed, **self.counts}

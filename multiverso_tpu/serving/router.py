@@ -148,7 +148,8 @@ class _FleetRequest:
 class _Replica:
     __slots__ = ("rank", "state", "last_hb", "health", "inflight",
                  "wire_dead", "probe_rid", "deaths", "readmissions",
-                 "state_gauge", "inflight_gauge", "hb_age_gauge")
+                 "state_gauge", "inflight_gauge", "hb_age_gauge",
+                 "snap_gauge")
 
     def __init__(self, rank: int, router_name: str) -> None:
         self.rank = rank
@@ -166,6 +167,11 @@ class _Replica:
             f"FLEET_INFLIGHT[{router_name}.{rank}]")
         self.hb_age_gauge = Dashboard.get_or_create_gauge(
             f"FLEET_HB_AGE_MS[{router_name}.{rank}]")
+        # the replica's SERVED snapshot version (from its heartbeat
+        # health): a fleet serving divergent or frozen versions is
+        # visible at a glance in the opscenter replica rows
+        self.snap_gauge = Dashboard.get_or_create_gauge(
+            f"FLEET_SNAPSHOT_VERSION[{router_name}.{rank}]")
         self.state_gauge.set(CONNECTING)
 
 
@@ -335,6 +341,8 @@ class FleetRouter:
                 rep.inflight_gauge.set(len(rep.inflight))
                 if rep.last_hb is not None:
                     rep.hb_age_gauge.set((now - rep.last_hb) * 1e3)
+                    rep.snap_gauge.set(float(
+                        (rep.health or {}).get("snapshot_version", -1)))
         self._apply_resolutions(resolutions)
         for msg in sends:
             self._publish(msg)
@@ -701,6 +709,10 @@ class FleetRouter:
                 "deaths": rep.deaths,
                 "readmissions": rep.readmissions,
                 "queue_depth": (rep.health or {}).get("queue_depth", 0),
+                "snapshot_version": (rep.health or {}).get(
+                    "snapshot_version", -1),
+                "params_stale": bool((rep.health or {}).get(
+                    "params_stale", False)),
             } for rep in sorted(self._replicas.values(),
                                 key=lambda x: x.rank)]
 

@@ -33,11 +33,15 @@ from ..log import Log
 
 @dataclass(frozen=True)
 class Snapshot:
-    """Immutable published view: a device pytree + its source version."""
+    """Immutable published view: a device pytree + its source version
+    (and, for fenced sources, the trainer incarnation epoch the state
+    derives from — pins carry (epoch, version) together so a serving
+    reply can be joined to the exact fenced publish that produced it)."""
 
     value: Any
     version: int
     published_at: float
+    epoch: int = 0
 
 
 class DerivedCache:
@@ -122,13 +126,22 @@ class SnapshotManager:
     """
 
     def __init__(self, read: Callable[[], Tuple[Any, int]],
-                 version_fn: Callable[[], int], name: str = "snapshot"):
+                 version_fn: Callable[[], int], name: str = "snapshot",
+                 epoch_fn: Optional[Callable[[], int]] = None):
         self._read = read
         self._version_fn = version_fn
+        self._epoch_fn = epoch_fn or (lambda: 0)
         self.name = name
         self._lock = lockwatch.lock("serving.SnapshotManager._lock")
         self._snap: Optional[Snapshot] = None
         self.publishes = 0      # copies actually taken (copy-on-publish)
+        # params-age tracking (staleness-aware serving): when the
+        # source version last MOVED, as observed by any probe through
+        # this manager. A silent publish stream shows up as a growing
+        # age; health surfaces flag STALE past -params_stale_after_s
+        # while replies keep flowing from the frozen snapshot.
+        self._seen_version = self._version_fn()
+        self._last_move = time.monotonic()
 
     @classmethod
     def of(cls, source: Any, name: Optional[str] = None) -> "SnapshotManager":
@@ -136,12 +149,13 @@ class SnapshotManager:
         (``snapshot_array``), a ``TransformerLM`` (``snapshot_params``),
         or a ``(read, version_fn)`` pair."""
         label = name or getattr(source, "name", type(source).__name__)
+        epoch_fn = (lambda: int(getattr(source, "epoch", 0)))
         if hasattr(source, "snapshot_array"):
             return cls(source.snapshot_array,
-                       lambda: source.version, label)
+                       lambda: source.version, label, epoch_fn=epoch_fn)
         if hasattr(source, "snapshot_params"):
             return cls(source.snapshot_params,
-                       lambda: source.version, label)
+                       lambda: source.version, label, epoch_fn=epoch_fn)
         if isinstance(source, tuple) and len(source) == 2:
             return cls(source[0], source[1], label)
         Log.fatal(f"SnapshotManager: {type(source).__name__} exposes "
@@ -151,7 +165,9 @@ class SnapshotManager:
         """Force a fresh copy (the copy-on-publish event)."""
         with self._lock:
             value, version = self._read()
-            self._snap = Snapshot(value, version, time.monotonic())
+            self._snap = Snapshot(value, version, time.monotonic(),
+                                  epoch=self._epoch_fn())
+            self._note_version_locked(version)
             self.publishes += 1
             return self._snap
 
@@ -177,3 +193,33 @@ class SnapshotManager:
         if snap.version == self._version_fn():
             return 0.0
         return time.monotonic() - snap.published_at
+
+    # -- params-staleness watchdog surface --------------------------------
+    def _note_version_locked(self, version: int) -> None:
+        if version != self._seen_version:
+            self._seen_version = version
+            self._last_move = time.monotonic()
+
+    def params_age_s(self) -> float:
+        """Seconds since the SOURCE version last moved (as observed):
+        the publish-stream-went-silent signal. Zero while training is
+        flowing; grows without bound when the trainer dies; snaps back
+        when a fenced restart republishes. Cheap — one version probe
+        (taken OUTSIDE the manager lock; it is caller-supplied code,
+        LK202)."""
+        version = self._version_fn()
+        with self._lock:
+            self._note_version_locked(version)
+            return time.monotonic() - self._last_move
+
+    def params_stale(self, stale_after_s: float,
+                     age_s: Optional[float] = None) -> bool:
+        """The serving degradation verdict: the source has been frozen
+        past the threshold. ``stale_after_s <= 0`` disables it (a
+        never-trained static model must not read as degraded).
+        ``age_s`` lets a caller that already probed
+        :meth:`params_age_s` reuse the sample — one verdict rule, one
+        implementation."""
+        if age_s is None:
+            age_s = self.params_age_s()
+        return stale_after_s > 0 and age_s > stale_after_s

@@ -107,6 +107,10 @@ class TableBase:
         # snapshot whose version equals the table's is bit-identical to
         # the live state (staleness 0 by definition).
         self.version = 0
+        # Trainer incarnation this state derives from (epoch fencing):
+        # 0 until a fenced publish/state install stamps it. Snapshot
+        # pins and serving health carry (epoch, version) together.
+        self.epoch = 0
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -286,11 +290,12 @@ class TableBase:
                           np.asarray(vals, accum.dtype))
             self._dispatch_keyed(ids, vals, option)
 
-    def _apply_dense(self, host: np.ndarray, option: AddOption) -> None:
+    def _apply_dense(self, host: np.ndarray, option: AddOption) -> int:
         """Fold a logical-shape host delta into the replica (jitted updater
-        step on the sharded state). Shared by local Adds and the async-PS
-        drain thread (``parallel.async_ps``) — the server-side
-        ``ProcessAdd`` path, ``src/server.cpp:48-60``."""
+        step on the sharded state). Shared by local Adds, the async-PS
+        drain thread (``parallel.async_ps``) and WAL replay — the
+        server-side ``ProcessAdd`` path, ``src/server.cpp:48-60``.
+        Returns the post-apply version (the WAL journals it)."""
         staged = jax.device_put(self._pad_host(host), self.sharding)
         with self._lock:
             mon = Dashboard.get_or_create(f"TABLE_ADD[{self.name}]")
@@ -306,8 +311,65 @@ class TableBase:
                 *_option_scalars(option, self.dtype),
             )
             self.version += 1
-            sp.end(version=self.version)
+            version = self.version
+            sp.end(version=version)
             mon.end()
+        return version
+
+    def _install_state(self, host: Any, version: int,
+                       epoch: int = 0) -> None:
+        """Install an ABSOLUTE state at an exact (version, epoch) — the
+        fenced restart's STATE-record rebase and the checkpoint
+        restore's watermark install. Unlike :meth:`set_array` the
+        version is assigned, not bumped, so the installed state IS the
+        publisher's state by version identity."""
+        host = np.asarray(host, dtype=self.dtype).reshape(self.shape)
+        staged = jax.device_put(self._pad_host(host), self.sharding)
+        with self._lock:
+            self._data = staged
+            self.version = int(version)
+            if epoch:
+                self.epoch = int(epoch)
+
+    # STATE-record wire protocol: a table's absolute state as a LIST of
+    # arrays (array tables ship one; KVTable ships keys+vals) so the
+    # publish/apply sides stay table-shape-agnostic
+    def _state_arrays(self) -> Tuple[list, int]:
+        host, version = self._snapshot_host()
+        return [host], version
+
+    def _install_state_arrays(self, arrays, version: int,
+                              epoch: int = 0) -> None:
+        self._install_state(arrays[0], version, epoch)
+
+    def _journal_local(self, kind: int, option, arrays,
+                       version: int) -> None:
+        """Journal one acknowledged LOCAL apply to the session WAL
+        (no-op without ``-wal``). Called AFTER the apply released the
+        table lock — the write/fsync must never run under it (LK203).
+
+        Exactness contract: replay re-applies the journaled deltas
+        against the restored DATA only — updater state (momentum/
+        AdaGrad slots) is neither checkpointed nor journaled, so a
+        stateful updater's replayed applies would silently diverge
+        from the acknowledged pre-crash bytes. Refuse loudly instead
+        (the online-learning deployment this protects runs the
+        stateless default/FTRL accumulators)."""
+        stateless = isinstance(self._ustate, tuple) \
+            and len(self._ustate) == 0
+        if not stateless and not getattr(self, "_wal_unsound_ok",
+                                         False):
+            Log.fatal(
+                f"-wal journaling on table {self.name!r} with the "
+                f"STATEFUL updater {self.updater.name!r}: replay "
+                f"cannot reproduce updater state, so recovery would "
+                f"silently diverge from the acknowledged pre-crash "
+                f"bytes — use a stateless updater (default/sgd) with "
+                f"-wal, or disable the journal")
+        from ..io.wal import journal_local
+
+        journal_local(self._sess, self.table_id, kind, option, arrays,
+                      version)
 
     # -- public ops --------------------------------------------------------
     def _add_handle(self) -> AsyncHandle:
@@ -329,7 +391,13 @@ class TableBase:
             # async PS: peers fold this delta via their drain threads; the
             # bus picks keyed touched-row or dense representation
             self._sess.async_bus.publish_delta(self, host, option)
-        self._apply_dense(host, option)
+        version = self._apply_dense(host, option)
+        if getattr(self._sess, "wal", None) is not None:
+            # journal BEFORE the caller gets its handle: once add()
+            # returns (the acknowledgment), the update is replayable
+            from ..parallel.async_ps import DENSE
+
+            self._journal_local(DENSE, option, [host], version)
         return self._add_handle()
 
     def add(self, delta: Any, option: Optional[AddOption] = None) -> None:
@@ -399,10 +467,24 @@ class TableBase:
                 jax.block_until_ready(self._data)
 
     # -- checkpoint (``Serializable``, ``table_interface.h:59-66``) --------
-    def store(self, stream) -> None:
+    def _snapshot_host(self) -> Tuple[np.ndarray, int]:
+        """``(logical host copy, version)`` captured atomically w.r.t.
+        the mutation lock — the pair a checkpoint watermark (and a
+        STATE rebase publish) needs: the version IS the version of
+        those bytes. Rides :meth:`snapshot_array` (the ONE sanctioned
+        copy-under-lock site)."""
+        snap, version = self.snapshot_array()
+        rows = self.shape[0] if self.shape else None
+        return np.asarray(snap)[:rows], version
+
+    def store(self, stream) -> int:
+        """Write the table record; returns the stored state's version
+        (the checkpoint manifest's per-table watermark)."""
         from ..io.stream import write_array
 
-        write_array(stream, self.get())
+        host, version = self._snapshot_host()
+        write_array(stream, host)
+        return version
 
     def load(self, stream) -> None:
         from ..io.stream import read_array

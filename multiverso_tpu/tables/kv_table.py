@@ -40,6 +40,11 @@ class KVTable:
         self._cache: Dict[Any, Any] = {}
         self._pending: Dict[Any, Any] = {}  # adds not yet merged cross-process
         self._lock = lockwatch.rlock("tables.KVTable._lock")
+        # mutation counter + incarnation epoch, mirroring TableBase's
+        # contract: the checkpoint manifest watermarks it and WAL replay
+        # targets version > watermark
+        self.version = 0
+        self.epoch = 0
 
     # -- worker API (kv_table.h:24-70) ------------------------------------
     def add(self, keys: Iterable, values: Iterable) -> None:
@@ -58,14 +63,25 @@ class KVTable:
                 self._store[k] = self._store.get(k, 0) + v
                 if bus is None:
                     self._pending[k] = self._pending.get(k, 0) + v
+            self.version += 1
+            version = self.version
+        if getattr(self._sess, "wal", None) is not None:
+            from ..io.wal import journal_local
+            from ..parallel.async_ps import KV
+
+            journal_local(self._sess, self.table_id, KV, None,
+                          [np.asarray(keys, np.int64),
+                           np.asarray(values, np.float64)], version)
 
     def _apply_remote_kv(self, keys: np.ndarray, values: np.ndarray) -> None:
-        """Drain-thread apply of a peer's adds (no re-publication)."""
+        """Drain-thread (and WAL-replay) apply of a peer's adds (no
+        re-publication)."""
         with self._lock:
             for k, v in zip(keys, values):
                 k = self.key_dtype.type(k).item()
                 v = self.value_dtype.type(v).item()
                 self._store[k] = self._store.get(k, 0) + v
+            self.version += 1
 
     def get(self, keys: Iterable) -> List:
         """Pull values into the local cache and return them in key order."""
@@ -116,17 +132,39 @@ class KVTable:
                 for k, v in zip(all_k[rank, :count], all_v[rank, :count]):
                     k = int(k)
                     self._store[k] = self._store.get(k, 0) + v
+            self.version += 1
+
+    # -- STATE-record wire protocol (fenced-restart rebase) ----------------
+    def _state_arrays(self):
+        with self._lock:
+            keys = np.array(sorted(self._store), dtype=np.int64)
+            vals = np.array([self._store[k] for k in sorted(self._store)],
+                            dtype=np.float64)
+            version = self.version
+        return [keys, vals], version
+
+    def _install_state_arrays(self, arrays, version: int,
+                              epoch: int = 0) -> None:
+        keys, vals = arrays
+        with self._lock:
+            self._store = {int(k): self.value_dtype.type(v).item()
+                           for k, v in zip(keys, vals)}
+            self.version = int(version)
+            if epoch:
+                self.epoch = int(epoch)
 
     # -- checkpoint --------------------------------------------------------
-    def store(self, stream) -> None:
+    def store(self, stream) -> int:
         from ..io.stream import write_array
 
         with self._lock:
             keys = np.array(sorted(self._store), dtype=np.int64)
             vals = np.array([self._store[k] for k in sorted(self._store)],
                             dtype=np.float64)
+            version = self.version
         write_array(stream, keys)
         write_array(stream, vals)
+        return version
 
     def load(self, stream) -> None:
         from ..io.stream import read_array

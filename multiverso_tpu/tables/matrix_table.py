@@ -91,9 +91,10 @@ class MatrixTable(TableBase):
         return self.get_rows([row_id])[0]
 
     def _dispatch_keyed(self, ids: np.ndarray, vals: np.ndarray,
-                        option: AddOption) -> None:
+                        option: AddOption) -> int:
         """Pad/bucket + jitted scatter-apply of row deltas; shared by local
-        Adds and the async-PS drain thread."""
+        Adds, the async-PS drain thread and WAL replay. Returns the
+        post-apply version."""
         ids = np.asarray(ids, dtype=np.int32).ravel()
         vals = np.asarray(vals, dtype=self.dtype).reshape(
             ids.shape[0], self.num_col)
@@ -110,6 +111,7 @@ class MatrixTable(TableBase):
                 jnp.asarray(mask), *_option_scalars(option, self.dtype),
             )
             self.version += 1
+            return self.version
 
     def add_rows_async(self, row_ids: Any, values: Any,
                        option: Optional[AddOption] = None) -> AsyncHandle:
@@ -121,7 +123,13 @@ class MatrixTable(TableBase):
         if bus is not None:
             bus.publish_keyed(self.table_id, ids, vals, option)
         ids, vals = self._aggregate_keyed(ids, vals)
-        self._dispatch_keyed(ids, vals, option)
+        version = self._dispatch_keyed(ids, vals, option)
+        if getattr(self._sess, "wal", None) is not None:
+            from ..parallel.async_ps import KEYED
+
+            # journal the POST-aggregate (ids, vals): exactly what this
+            # replica applied, so replay reproduces it bit-for-bit
+            self._journal_local(KEYED, option, [ids, vals], version)
         return self._add_handle()
 
     def add_rows(self, row_ids: Any, values: Any,

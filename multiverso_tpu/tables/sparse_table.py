@@ -51,7 +51,7 @@ class SparseTable(TableBase):
         return np.asarray(out)[:n]
 
     def _dispatch_keyed(self, ids: np.ndarray, vals: np.ndarray,
-                        option: AddOption) -> None:
+                        option: AddOption) -> int:
         ids = np.asarray(ids, dtype=np.int32).ravel()
         vals = np.asarray(vals, dtype=self.dtype).ravel()
         n = ids.shape[0]
@@ -65,6 +65,7 @@ class SparseTable(TableBase):
                 jnp.asarray(mask), *_option_scalars(option, self.dtype),
             )
             self.version += 1
+            return self.version
 
     def add_keys_async(self, keys: Any, values: Any,
                        option: Optional[AddOption] = None) -> AsyncHandle:
@@ -75,7 +76,11 @@ class SparseTable(TableBase):
         if bus is not None:
             bus.publish_keyed(self.table_id, ids, vals, option)
         ids, vals = self._aggregate_keyed(ids, vals)
-        self._dispatch_keyed(ids, vals, option)
+        version = self._dispatch_keyed(ids, vals, option)
+        if getattr(self._sess, "wal", None) is not None:
+            from ..parallel.async_ps import KEYED
+
+            self._journal_local(KEYED, option, [ids, vals], version)
         return self._add_handle()
 
     def add_keys(self, keys: Any, values: Any,
@@ -112,7 +117,7 @@ class FTRLTable(TableBase):
         return zn[:, self.Z], zn[:, self.N]
 
     def _dispatch_keyed(self, ids: np.ndarray, vals: np.ndarray,
-                        option=None) -> None:
+                        option=None) -> int:
         ids = np.asarray(ids, dtype=np.int32).ravel()
         vals = np.asarray(vals, dtype=self.dtype).reshape(ids.shape[0], 2)
         n = ids.shape[0]
@@ -124,6 +129,7 @@ class FTRLTable(TableBase):
                 self._data, jnp.asarray(padded_ids), jnp.asarray(padded_vals),
                 jnp.asarray(mask))
             self.version += 1
+            return self.version
 
     def add_keys(self, keys: Any, delta_z: Any, delta_n: Any) -> None:
         """Accumulate ``FTRLGradient{delta_z, delta_n}`` per key."""
@@ -136,5 +142,9 @@ class FTRLTable(TableBase):
         if bus is not None:
             bus.publish_keyed(self.table_id, ids, vals, None)
         ids, vals = self._aggregate_keyed(ids, vals)
-        self._dispatch_keyed(ids, vals)
+        version = self._dispatch_keyed(ids, vals)
+        if getattr(self._sess, "wal", None) is not None:
+            from ..parallel.async_ps import KEYED
+
+            self._journal_local(KEYED, None, [ids, vals], version)
         jax.block_until_ready(self._data)

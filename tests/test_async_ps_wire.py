@@ -23,11 +23,11 @@ def test_wire_trace_context_round_trip():
     vals = np.ones((2, 3), np.float32)
     ctx = trace.SpanContext(trace_id=0xDEADBEEF1234, span_id=0x42)
     data = async_ps._serialize(async_ps.KEYED, 4, None, [ids, vals], ctx)
-    *_, ctx2 = async_ps._deserialize(data)
+    *_, ctx2, _, _ = async_ps._deserialize(data)
     assert ctx2 == ctx
 
     bare = async_ps._serialize(async_ps.KEYED, 4, None, [ids, vals])
-    *_, ctx3 = async_ps._deserialize(bare)
+    *_, ctx3, _, _ = async_ps._deserialize(bare)
     assert ctx3 is None
 
 
@@ -37,8 +37,10 @@ def test_dense_record_round_trip():
     delta = np.arange(12, dtype=np.float32)
     blobs = SparseFilter(clip=0.0, dtype=np.float32).filter_in([delta])
     data = async_ps._serialize(async_ps.DENSE, 7, opt, blobs)
-    kind, table_id, opt2, arrays, ts, ctx = async_ps._deserialize(data)
+    (kind, table_id, opt2, arrays, ts, ctx, epoch,
+     version) = async_ps._deserialize(data)
     assert (kind, table_id) == (async_ps.DENSE, 7)
+    assert (epoch, version) == (0, 0)      # unfenced legacy defaults
     assert opt2.worker_id == 3
     assert opt2.learning_rate == pytest.approx(0.125)
     assert opt2.momentum == pytest.approx(0.5)
@@ -52,7 +54,8 @@ def test_keyed_record_preserves_dtypes():
     ids = np.array([5, 1, 9], np.int32)
     vals = np.arange(6, dtype=np.float64).reshape(3, 2) * 0.1
     data = async_ps._serialize(async_ps.KEYED, 2, None, [ids, vals])
-    kind, table_id, opt, (ids2, vals2), ts, ctx = async_ps._deserialize(data)
+    (kind, table_id, opt, (ids2, vals2), ts, ctx, _,
+     _) = async_ps._deserialize(data)
     assert kind == async_ps.KEYED and table_id == 2
     assert ids2.dtype == np.int32 and vals2.dtype == np.float64
     np.testing.assert_array_equal(ids2, ids)
@@ -65,7 +68,7 @@ def test_bfloat16_wire_round_trip():
 
     arr = np.array([1.5, -2.5, 0.0, 3.0], ml_dtypes.bfloat16)
     data = async_ps._serialize(async_ps.DENSE, 0, None, [arr])
-    _, _, _, (out,), _, _ = async_ps._deserialize(data)
+    _, _, _, (out,), _, _, _, _ = async_ps._deserialize(data)
     assert out.dtype == np.dtype(ml_dtypes.bfloat16)
     np.testing.assert_array_equal(out.astype(np.float32),
                                   arr.astype(np.float32))
@@ -75,10 +78,110 @@ def test_kv_record():
     keys = np.array([7, -3], np.int64)
     vals = np.array([1.0, 0.5], np.float64)
     data = async_ps._serialize(async_ps.KV, 1, None, [keys, vals])
-    kind, table_id, _, (k2, v2), _, _ = async_ps._deserialize(data)
+    kind, table_id, _, (k2, v2), _, _, _, _ = async_ps._deserialize(data)
     assert kind == async_ps.KV
     np.testing.assert_array_equal(k2, keys)
     np.testing.assert_array_equal(v2, vals)
+
+
+def test_epoch_version_header_round_trip():
+    """The fencing fields (PR 14): a fenced publish's (epoch, version)
+    survive the wire, and the STATE kind (the fenced restart's absolute
+    rebase record) frames like any other record."""
+    state = np.arange(6, dtype=np.float32).reshape(2, 3)
+    data = async_ps._serialize(async_ps.STATE, 3, None, [state],
+                               epoch=7, version=41)
+    (kind, table_id, _, (out,), _, ctx, epoch,
+     version) = async_ps._deserialize(data)
+    assert (kind, table_id) == (async_ps.STATE, 3)
+    assert (epoch, version) == (7, 41)
+    assert ctx is None
+    np.testing.assert_array_equal(out, state)
+
+
+def test_epoch_fence_highest_wins():
+    """EpochFence: unfenced (0) always passes and never advances; a
+    lower epoch than the highest seen is rejected and counted."""
+    fence = async_ps.EpochFence("test")
+    assert fence.admit(0) and fence.epoch == 0
+    assert fence.admit(2) and fence.epoch == 2
+    assert fence.admit(2)
+    assert not fence.admit(1)              # zombie incarnation
+    assert fence.admit(0)                  # legacy records still pass
+    assert fence.admit(3) and fence.epoch == 3
+    assert not fence.admit(2)
+    assert fence.rejections == 2
+
+
+def test_claim_epoch_monotonic():
+    class KV:
+        def __init__(self):
+            self.d = {}
+
+        def key_value_set(self, k, v, allow_overwrite=False):
+            self.d[k] = v
+
+        def key_value_try_get(self, k):
+            if k not in self.d:
+                raise KeyError("NOT_FOUND: " + k)
+            return self.d[k]
+
+    kv = KV()
+    assert async_ps.claim_epoch(kv) == 1
+    assert async_ps.claim_epoch(kv) == 2
+    assert async_ps.claim_epoch(kv) == 3
+
+
+def test_claim_epoch_fails_loudly_on_broken_kv():
+    """A fencing-token read error must NOT default to 0: rewinding the
+    key would fence out the legitimately restarted trainer forever."""
+    import pytest
+
+    from multiverso_tpu.log import FatalError
+
+    class BrokenKV:
+        def key_value_try_get(self, k):
+            raise RuntimeError("UNAVAILABLE: coordinator flapping")
+
+        def key_value_set(self, k, v, allow_overwrite=False):
+            raise AssertionError("must not write after a failed read")
+
+    with pytest.raises(FatalError):
+        async_ps.claim_epoch(BrokenKV())
+
+
+def test_claim_epoch_legacy_client_absent_key_reads_as_zero():
+    """jax<=0.4.x clients (no key_value_try_get) raise XlaRuntimeError
+    ('DEADLINE_EXCEEDED...') — a RuntimeError, not TimeoutError — when
+    the key is absent; the first-ever claim must still succeed. A
+    non-timeout error still fails loudly."""
+    import pytest
+
+    from multiverso_tpu.log import FatalError
+
+    class LegacyKV:
+        def __init__(self):
+            self.d = {}
+
+        def blocking_key_value_get(self, k, timeout_ms):
+            if k not in self.d:
+                raise RuntimeError(
+                    "DEADLINE_EXCEEDED: Timed out waiting for key")
+            return self.d[k]
+
+        def key_value_set(self, k, v, allow_overwrite=False):
+            self.d[k] = v
+
+    kv = LegacyKV()
+    assert async_ps.claim_epoch(kv) == 1     # absent -> first claim
+    assert async_ps.claim_epoch(kv) == 2
+
+    class LegacyBroken(LegacyKV):
+        def blocking_key_value_get(self, k, timeout_ms):
+            raise RuntimeError("UNAVAILABLE: coordinator down")
+
+    with pytest.raises(FatalError):
+        async_ps.claim_epoch(LegacyBroken())
 
 
 def test_part_records_reassemble_to_one_apply():
@@ -128,6 +231,6 @@ def test_sparse_filter_compresses_sparse_dense_payload():
     blobs = f.filter_in([delta])
     wire = async_ps._serialize(async_ps.DENSE, 0, None, blobs)
     assert len(wire) < delta.nbytes // 2   # actually compressed
-    _, _, _, arrays, _, _ = async_ps._deserialize(wire)
+    _, _, _, arrays, _, _, _, _ = async_ps._deserialize(wire)
     out = f.filter_out(arrays)[0]
     np.testing.assert_array_equal(out, delta)

@@ -60,11 +60,45 @@ def test_metric_direction_rules():
     assert metric_direction("itl_p99_ms_info") == 0
     assert metric_direction("shed_rate_info") == 0
     assert metric_direction("tokens_per_s_speedup_info") == 0
+    # serving-fleet recovery invariants (lm_fleet_chaos A/B)
+    assert metric_direction("requests_lost") == -1
+    assert metric_direction("output_mismatches") == -1
+    assert metric_direction("recovery_time_s") == -1
+    # durable online learning (lm_trainer_chaos A/B): acknowledged
+    # updates lost and unexpected fence rejections are zero-baseline
+    # hard gates; the restart wall clock regresses UP; WAL replay
+    # volume and the staleness peak archive as _info
+    assert metric_direction("updates_lost") == -1
+    assert metric_direction("epoch_fence_rejections_unexpected") == -1
+    assert metric_direction("trainer_recovery_time_s") == -1
+    assert metric_direction("wal_replay_records_info") == 0
+    assert metric_direction("staleness_peak_s_info") == 0
     assert metric_direction("completed") == 0       # informational
     assert metric_direction("jit_traces") == 0
     assert metric_direction("step_traces") == 0
     assert metric_direction("kv_pool_blocks") == 0
     assert metric_direction("block_allocs") == 0
+
+
+def test_updates_lost_zero_baseline_gate():
+    """updates_lost 0 -> 1 must regress even though the baseline is 0
+    (the zero-baseline rule): an acknowledged update lost to a trainer
+    kill is an invariant break, not noise."""
+    base = _line(lm_trainer_chaos={"updates_lost": 0.0,
+                                   "epoch_fence_rejections_unexpected":
+                                       0.0})
+    good = _line(lm_trainer_chaos={"updates_lost": 0.0,
+                                   "epoch_fence_rejections_unexpected":
+                                       0.0})
+    bad = _line(lm_trainer_chaos={"updates_lost": 1.0,
+                                  "epoch_fence_rejections_unexpected":
+                                      2.0})
+    regs, _ = compare(base, good)
+    assert regs == []
+    regs, _ = compare(base, bad)
+    assert {r["metric"] for r in regs} == {
+        "lm_trainer_chaos.updates_lost",
+        "lm_trainer_chaos.epoch_fence_rejections_unexpected"}
 
 
 def test_watchdog_trips_hard_gate():

@@ -108,6 +108,93 @@ def test_autosaver_ignores_partial_tmp_dir(mv_session, tmp_path):
     assert checkpoint.restore_latest(root) == 1
 
 
+def test_manifest_records_version_watermarks(mv_session, tmp_path):
+    """save() watermarks each table's version; restore() installs the
+    watermark exactly (WAL replay targets version > watermark)."""
+    import json
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+
+    arr = mv.create_table("array", 8)
+    kv = mv.create_table("kv")
+    for i in range(3):
+        arr.add(np.ones(8, np.float32))
+    kv.add([1], [2.0])
+    ckpt = str(tmp_path / "ckpt")
+    manifest = checkpoint.save(ckpt)
+    assert [e["version"] for e in manifest["tables"]] == [3, 1]
+    with open(ckpt + "/manifest.json") as f:
+        assert json.load(f) == manifest
+    arr.add(np.ones(8, np.float32))
+    kv.add([1], [5.0])
+    checkpoint.restore(ckpt)
+    assert arr.version == 3 and kv.version == 1
+
+
+def test_restore_latest_skips_torn_step_dirs(mv_session, tmp_path):
+    """Satellite regression: a truncated table file or a manifest-less
+    step dir must not be restored (or half-loaded) — restore_latest
+    falls back to the newest COMPLETE step loudly."""
+    import os
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+
+    arr = mv.create_table("array", 16)
+    root = str(tmp_path / "auto")
+    arr.add(np.full(16, 1.0, np.float32))
+    checkpoint.save(os.path.join(root, "step_1"))
+    arr.add(np.full(16, 1.0, np.float32))
+    checkpoint.save(os.path.join(root, "step_2"))
+    # step_2's table file loses its payload tail (crash mid-copy)
+    victim = os.path.join(root, "step_2", "table_0.bin")
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) - 7)
+    # a manifest-less dir (interrupted before the manifest write)
+    os.makedirs(os.path.join(root, "step_3"))
+    # ... and one whose manifest is garbage
+    os.makedirs(os.path.join(root, "step_4"))
+    with open(os.path.join(root, "step_4", "manifest.json"), "w") as f:
+        f.write("{not json")
+    arr.add(np.full(16, 50.0, np.float32))
+    assert checkpoint.restore_latest(root) == 1
+    np.testing.assert_allclose(arr.get(), np.full(16, 1.0))
+    assert arr.version == 1                  # step_1's watermark
+
+
+def test_restore_latest_missing_table_file(mv_session, tmp_path):
+    import os
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+
+    arr = mv.create_table("array", 4)
+    root = str(tmp_path / "auto")
+    arr.add(np.ones(4, np.float32))
+    checkpoint.save(os.path.join(root, "step_1"))
+    arr.add(np.ones(4, np.float32))
+    checkpoint.save(os.path.join(root, "step_2"))
+    os.remove(os.path.join(root, "step_2", "table_0.bin"))
+    assert checkpoint.restore_latest(root) == 1
+    np.testing.assert_allclose(arr.get(), np.ones(4))
+
+
+def test_restore_latest_all_steps_torn_is_fresh_start(mv_session,
+                                                      tmp_path):
+    import os
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+
+    arr = mv.create_table("array", 4)
+    root = str(tmp_path / "auto")
+    arr.add(np.ones(4, np.float32))
+    checkpoint.save(os.path.join(root, "step_1"))
+    os.remove(os.path.join(root, "step_1", "table_0.bin"))
+    assert checkpoint.restore_latest(root) is None
+
+
 def test_orbax_save_restore_roundtrip(mv_session, tmp_path):
     import numpy as np
 

@@ -512,6 +512,8 @@ def test_collector_table_renders_replica_rows():
         "FLEET_REPLICA_STATE[fleet.1]": {"type": "gauge", "value": 3},
         "FLEET_INFLIGHT[fleet.1]": {"type": "gauge", "value": 2},
         "FLEET_HB_AGE_MS[fleet.1]": {"type": "gauge", "value": 41.5},
+        "FLEET_SNAPSHOT_VERSION[fleet.1]": {"type": "gauge",
+                                            "value": 17},
         "FLEET_REPLICA_STATE[fleet.2]": {"type": "gauge", "value": 0},
         "FLEET_INFLIGHT[fleet.2]": {"type": "gauge", "value": 0},
         "FLEET_HB_AGE_MS[fleet.2]": {"type": "gauge", "value": 912.0},
@@ -519,10 +521,15 @@ def test_collector_table_renders_replica_rows():
     rows = col.replica_rows()
     assert [(r["replica"], r["state"], r["inflight"]) for r in rows] == [
         ("fleet.1", "UP", 2), ("fleet.2", "DEAD", 0)]
+    # served snapshot version per replica; a pre-PR 14 archive lacking
+    # the gauge renders -1 (tolerance pattern) — a fleet serving
+    # divergent or frozen versions is visible at a glance
+    assert [r["snapshot_version"] for r in rows] == [17, -1]
     table = col.table()
     assert "fleet.1" in table and "UP" in table
     assert "fleet.2" in table and "DEAD" in table
-    assert "hb_age_ms" in table
+    assert "hb_age_ms" in table and "snap_v" in table
+    assert "17" in table
 
 
 def test_live_router_gauges_feed_the_obs_report():

@@ -578,3 +578,25 @@ def test_fleet_chaos_ab_recovery_face(mv_session):
     assert row["fleet_tokens_per_s"] > 0
     assert row["fleet_tokens_per_s_chaos_info"] > 0
     assert row["chaos_completed_info"] == row["requests"]
+
+
+@pytest.mark.slow
+def test_trainer_chaos_ab_durability_face(mv_session):
+    """The serving_bench trainer-chaos A/B face: a seeded mid-stream
+    trainer kill must lose NO acknowledged update — checkpoint+WAL
+    recovery reaches the exact pre-crash state (updates_lost 0), the
+    recovered-and-republished fleet state is bit-identical to the
+    fault-free leg (output_mismatches 0), exactly the staged zombie
+    publish is fenced, and the staleness/recovery wall clocks are live
+    numbers."""
+    from tools.serving_bench import _trainer_chaos_ab
+
+    row = _trainer_chaos_ab(quick=True)
+    assert row["trainer_killed_info"] == 1
+    assert row["updates_lost"] == 0
+    assert row["output_mismatches"] == 0
+    assert row["epoch_fence_rejections_unexpected"] == 0
+    assert row["trainer_recovery_time_s"] > 0
+    assert row["staleness_peak_s_info"] >= 0.2      # the flag threshold
+    assert row["wal_replay_records_info"] >= 1      # replay did work
+    assert row["checkpoint_step_info"] >= 1         # ...past a real ckpt

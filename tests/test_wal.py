@@ -1,0 +1,332 @@
+"""Write-ahead delta journal (io/wal.py): framing, torn-tail recovery,
+bounded replay, and the checkpoint+WAL exact-recovery contract."""
+
+import os
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.io import wal
+from multiverso_tpu.parallel import async_ps
+from multiverso_tpu.updaters import AddOption
+
+
+def _payload(i, table_id=0):
+    arr = np.full((4,), float(i), np.float32)
+    return async_ps._serialize(async_ps.DENSE, table_id,
+                               AddOption(worker_id=0), [arr],
+                               version=i)
+
+
+def _fill(directory, n, table_id=0, segment_bytes=64 << 20, rank=0):
+    w = wal.DeltaWAL(directory, rank=rank, segment_bytes=segment_bytes)
+    for i in range(1, n + 1):
+        w.append(table_id, i, _payload(i, table_id))
+    w.close()
+    return w
+
+
+# -- framing / rotation -------------------------------------------------------
+
+def test_record_roundtrip_and_order(tmp_path):
+    d = str(tmp_path)
+    payloads = [_payload(i) for i in range(1, 6)]
+    w = wal.DeltaWAL(d, rank=0)
+    for i, p in enumerate(payloads, start=1):
+        w.append(0, i, p)
+    w.close()
+    got = list(wal.iter_records(d, 0))
+    assert [(t, v) for t, v, _, _ in got] == [(0, i) for i in
+                                             range(1, 6)]
+    assert [p for _, _, p, _ in got] == payloads   # bit-exact payloads
+
+
+def test_segment_rotation_and_cross_segment_read(tmp_path):
+    d = str(tmp_path)
+    _fill(d, 40, segment_bytes=1024)         # tiny segments force rolls
+    segs = wal.segments(d, 0)
+    assert len(segs) > 1
+    got = [v for _, v, _, _ in wal.iter_records(d, 0)]
+    assert got == list(range(1, 41))         # order survives rotation
+
+
+def test_per_rank_journals_are_disjoint(tmp_path):
+    d = str(tmp_path)
+    _fill(d, 3, rank=0)
+    _fill(d, 5, rank=1)
+    assert len(list(wal.iter_records(d, 0))) == 3
+    assert len(list(wal.iter_records(d, 1))) == 5
+
+
+def test_new_incarnation_opens_fresh_segment(tmp_path):
+    d = str(tmp_path)
+    _fill(d, 3)
+    w2 = wal.DeltaWAL(d, rank=0)             # restart: recovery + new seg
+    w2.append(0, 4, _payload(4))
+    w2.close()
+    assert len(wal.segments(d, 0)) == 2
+    assert [v for _, v, _, _ in wal.iter_records(d, 0)] == [1, 2, 3, 4]
+
+
+def test_concurrent_appends_across_rotations_stay_whole(tmp_path):
+    """Racing appenders near segment boundaries: exactly one rotator
+    wins (no double-headered segment), stragglers' O_APPEND writes to
+    the just-retired fd stay whole records, and recovery finds a CLEAN
+    journal with every appended record present."""
+    import threading
+
+    d = str(tmp_path)
+    w = wal.DeltaWAL(d, rank=0, segment_bytes=2048)
+    n_threads, per = 4, 60
+
+    def worker(t):
+        for i in range(per):
+            v = t * per + i + 1
+            w.append(0, v, _payload(v))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    w.close()
+    assert w.rotations > 1                   # boundaries were actually hit
+    stats = wal.recover(d, 0)
+    assert stats["truncated_at"] == -1       # nothing torn
+    got = sorted(v for _, v, _, _ in wal.iter_records(d, 0))
+    assert got == list(range(1, n_threads * per + 1))
+
+
+# -- torn-tail recovery -------------------------------------------------------
+
+def test_recovery_truncates_torn_tail_deterministically(tmp_path):
+    """The acceptance property: for ANY byte-level truncation point,
+    recovery keeps exactly the longest prefix of complete records and
+    physically truncates the rest — deterministic, never an error."""
+    d = str(tmp_path)
+    n = 12
+    _fill(d, n)
+    (_, path), = wal.segments(d, 0)
+    blob = open(path, "rb").read()
+    rng = np.random.default_rng(7)
+    # record boundaries, recomputed the same way the reader walks them
+    boundaries = [len(wal._MAGIC) + wal._SEG_HEADER.size]
+    pos = boundaries[0]
+    while pos < len(blob):
+        _, length, _, _ = wal._REC.unpack(blob[pos:pos + wal._REC.size])
+        pos += wal._REC.size + length
+        boundaries.append(pos)
+    for cut in sorted(rng.integers(0, len(blob), size=24).tolist()):
+        with open(path, "wb") as f:
+            f.write(blob[:cut])
+        stats = wal.recover(d, 0)
+        want = max((i for i, b in enumerate(boundaries) if b <= cut),
+                   default=0)
+        got = [v for _, v, _, _ in wal.iter_records(d, 0)]
+        assert got == list(range(1, want + 1)), (cut, stats)
+        # recovery is idempotent: a second pass finds a clean journal
+        assert wal.recover(d, 0)["truncated_at"] == -1
+        with open(path, "wb") as f:
+            f.write(blob)                    # restore for the next cut
+
+
+def test_recovery_bad_crc_mid_journal_drops_suffix(tmp_path):
+    d = str(tmp_path)
+    _fill(d, 30, segment_bytes=1024)
+    segs = wal.segments(d, 0)
+    assert len(segs) >= 3
+    # corrupt a payload byte inside the SECOND segment
+    _, victim = segs[1]
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(wal._MAGIC) + wal._SEG_HEADER.size + wal._REC.size] ^= 0xFF
+    open(victim, "wb").write(bytes(blob))
+    first_seg_records = len(list(wal._scan_segment(segs[0][1])[0]))
+    stats = wal.recover(d, 0)
+    assert stats["truncated_at"] == len(wal._MAGIC) + wal._SEG_HEADER.size
+    # everything after the first bad record is gone: later segments too
+    assert wal.segments(d, 0) == segs[:1]
+    assert len(list(wal.iter_records(d, 0))) == first_seg_records
+
+
+@pytest.mark.parametrize("kind", ["torn_tail", "bad_crc"])
+def test_corrupt_tail_then_writer_recovery(tmp_path, kind):
+    """The chaos helper (FaultPlan wal_torn_tail / wal_bad_crc) stages
+    exactly the corruption a fresh writer's recovery truncates."""
+    d = str(tmp_path)
+    w = wal.DeltaWAL(d, rank=0)
+    for i in range(1, 6):
+        w.append(0, i, _payload(i))
+    w.corrupt_tail(kind)
+    w.close()                                # crash analogue
+    w2 = wal.DeltaWAL(d, rank=0)             # restart runs recovery
+    assert w2.recovery["truncated_at"] >= 0
+    got = [v for _, v, _, _ in wal.iter_records(d, 0)]
+    assert got == [1, 2, 3, 4]               # last record truncated away
+    w2.close()
+
+
+# -- reaping ------------------------------------------------------------------
+
+def test_reap_bounded_by_watermark(tmp_path):
+    d = str(tmp_path)
+    _fill(d, 30, segment_bytes=1024)
+    w = wal.DeltaWAL(d, rank=0)              # fresh active segment
+    before = wal.segments(d, 0)
+    reaped = w.reap({0: 15})
+    after = wal.segments(d, 0)
+    assert reaped and len(after) < len(before)
+    # every surviving closed record set still covers 16.. exactly once,
+    # and nothing above the watermark was lost
+    got = [v for _, v, _, _ in wal.iter_records(d, 0)]
+    assert [v for v in got if v > 15] == list(range(16, 31))
+    # reaped segments are gone from disk (never re-read)
+    assert all(not os.path.exists(p) for p in reaped)
+    # the watermark moving to the end reaps everything closed
+    w.reap({0: 30})
+    got = [v for _, v, _, _ in wal.iter_records(d, 0)]
+    assert all(v > 30 for v in got)
+    w.close()
+
+
+def test_reap_keeps_segments_with_unknown_tables(tmp_path):
+    d = str(tmp_path)
+    w = wal.DeltaWAL(d, rank=0, segment_bytes=1024)
+    for i in range(1, 31):
+        w.append(7, i, _payload(i, table_id=7))
+    assert len(wal.segments(d, 0)) > 1       # closed segments exist
+    assert w.reap({0: 100}) == []            # table 7 not watermarked
+    assert w.reap({7: 30}) != []             # ...its own watermark reaps
+    w.close()
+
+
+# -- replay into live tables --------------------------------------------------
+
+def _arm_wal(mv, tmp_path):
+    from multiverso_tpu.runtime import Session
+
+    sess = Session.get()
+    sess.wal = wal.DeltaWAL(str(tmp_path / "wal"))
+    return sess
+
+
+def test_checkpoint_plus_replay_reaches_exact_version(mv_session,
+                                                      tmp_path):
+    """The durability contract end to end, in process: acknowledged
+    adds past the checkpoint replay to the exact pre-crash version and
+    bit-identical state — dense, keyed and KV tables."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+
+    sess = _arm_wal(mv, tmp_path)
+    mat = mv.create_table("matrix", 6, 3)
+    arr = mv.create_table("array", 8)
+    kv = mv.create_table("kv")
+    rng = np.random.default_rng(3)
+    ck = str(tmp_path / "ckpt" / "step_1")
+    for i in range(9):
+        mat.add_rows([i % 6], rng.standard_normal((1, 3)).astype(
+            np.float32))
+        arr.add(rng.standard_normal(8).astype(np.float32))
+        kv.add([i % 4], [float(i)])
+        if i == 4:
+            checkpoint.save(ck)
+    expect = {"mat": mat.get().copy(), "arr": arr.get().copy(),
+              "kv": dict(kv._store)}
+    vers = (mat.version, arr.version, kv.version)
+    sess.wal.close()
+
+    # "restart": clobber everything, restore + replay
+    mat._install_state(np.zeros((6, 3), np.float32), 0)
+    arr._install_state(np.zeros(8, np.float32), 0)
+    kv._store.clear()
+    kv.version = 0
+    step = checkpoint.restore_latest(str(tmp_path / "ckpt"),
+                                     wal_dir=str(tmp_path / "wal"),
+                                     wal_rank=0)
+    assert step == 1
+    assert checkpoint.LAST_WAL_REPLAY["replayed"] > 0
+    assert checkpoint.LAST_WAL_REPLAY["dropped"] == 0
+    assert (mat.version, arr.version, kv.version) == vers
+    np.testing.assert_array_equal(mat.get(), expect["mat"])
+    np.testing.assert_array_equal(arr.get(), expect["arr"])
+    assert kv._store == expect["kv"]
+    sess.wal = None
+
+
+def test_replay_without_checkpoint_covers_from_zero(mv_session,
+                                                    tmp_path):
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+
+    sess = _arm_wal(mv, tmp_path)
+    t = mv.create_table("matrix", 4, 2)
+    for i in range(3):
+        t.add(np.full((4, 2), float(i + 1), np.float32))
+    want = t.get().copy()
+    sess.wal.close()
+    t._install_state(np.zeros((4, 2), np.float32), 0)
+    assert checkpoint.restore_latest(
+        str(tmp_path / "nockpt"), wal_dir=str(tmp_path / "wal"),
+        wal_rank=0) is None                  # fresh start...
+    assert checkpoint.LAST_WAL_REPLAY["replayed"] == 3   # ...yet replayed
+    np.testing.assert_array_equal(t.get(), want)
+    assert t.version == 3
+    sess.wal = None
+
+
+def test_replay_stops_loudly_at_version_gap(mv_session, tmp_path):
+    import multiverso_tpu as mv
+
+    sess = _arm_wal(mv, tmp_path)
+    t = mv.create_table("array", 4)
+    d = sess.wal.directory
+    # journal versions 1, 2, 4 (3 missing: the racing-adder crash case)
+    for v in (1, 2, 4):
+        sess.wal.append(t.table_id, v, async_ps._serialize(
+            async_ps.DENSE, t.table_id, AddOption(worker_id=0),
+            [np.full(4, float(v), np.float32)], version=v))
+    sess.wal.close()
+    sess.wal = None
+    stats = wal.replay(d, 0, tables={t.table_id: t})
+    assert stats == {"replayed": 2, "skipped": 0, "gaps": 1,
+                     "dropped": 1, "unknown_tables": 0}
+    assert t.version == 2                    # consecutive prefix only
+    np.testing.assert_array_equal(t.get(), np.full(4, 3.0))
+
+
+def test_journaling_refuses_stateful_updaters(mv_session, tmp_path):
+    """Replay re-applies deltas against restored DATA only — updater
+    state (momentum/AdaGrad slots) is not journaled, so a stateful
+    updater's recovery would silently diverge from the acknowledged
+    bytes. The journal hook refuses loudly instead."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.log import FatalError
+
+    sess = _arm_wal(mv, tmp_path)
+    ok = mv.create_table("matrix", 4, 2)                 # stateless
+    ok.add(np.ones((4, 2), np.float32))
+    bad = mv.create_table("matrix", 4, 2, updater="momentum_sgd")
+    with pytest.raises(FatalError):
+        bad.add(np.ones((4, 2), np.float32))
+    sess.wal.close()
+    sess.wal = None
+
+
+def test_acknowledged_add_is_journaled_before_handle_returns(
+        mv_session, tmp_path):
+    """Zero acknowledged-update loss hinges on ordering: the journal
+    append happens inside add_async, BEFORE the caller's handle exists
+    — so anything add() acknowledged is on disk (page cache) even if
+    the process dies the next instant."""
+    import multiverso_tpu as mv
+
+    sess = _arm_wal(mv, tmp_path)
+    t = mv.create_table("matrix", 4, 2)
+    h = t.add_async(np.ones((4, 2), np.float32))
+    assert sess.wal.appended == 1            # journaled pre-wait
+    h.wait()
+    t.add_rows([2], np.ones((1, 2), np.float32))
+    assert sess.wal.appended == 2
+    sess.wal.close()
+    sess.wal = None

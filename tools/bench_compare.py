@@ -75,6 +75,14 @@ from typing import Dict, List, Tuple
 # mismatch on the candidate side gates hard. recovery_time_s (death
 # flagged -> first re-dispatched completion) regresses UP like a
 # latency; fleet_tokens_per_s rides the tokens_per_s rule.
+# updates_lost / epoch_fence_rejections_unexpected are the durable
+# online-learning invariants (lm_trainer_chaos A/B): every add the
+# trainer ACKNOWLEDGED must survive a kill via checkpoint + WAL
+# replay, and the epoch fence must reject exactly the staged zombie
+# publishes — both zero-baseline hard gates. trainer_recovery_time_s
+# (kill -> fleet re-converged on the restarted incarnation) rides the
+# recovery_time_s suffix rule; wal_replay_records archives as _info
+# (it measures the checkpoint cadence, not the code).
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
                   "capacity_seqs", "prefill_tokens_saved",
                   "prefix_hit_rate", "accepted_per_step")
@@ -82,7 +90,8 @@ _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
                  "kv_bytes_per_device", "decode_step_retraces",
                  "watchdog_trips", "lock_order_violations",
                  "dropped_reports", "requests_lost",
-                 "output_mismatches", "recovery_time_s")
+                 "output_mismatches", "recovery_time_s",
+                 "updates_lost", "epoch_fence_rejections_unexpected")
 
 
 def metric_direction(name: str) -> int:

@@ -16,10 +16,14 @@ questions after the fact:
   report interval) renders **SILENT** — the offline analogue of the
   live collector's DEGRADED flag. When a node's registry carries the
   serving-fleet router's per-replica gauges
-  (``FLEET_REPLICA_STATE/FLEET_INFLIGHT/FLEET_HB_AGE_MS``), the table
-  additionally renders one row per decode REPLICA — lifecycle state
-  (UP/PROBING/DEAD), in-flight count, heartbeat age
-  (docs/SERVING.md "Serving fleet").
+  (``FLEET_REPLICA_STATE/FLEET_INFLIGHT/FLEET_HB_AGE_MS/``
+  ``FLEET_SNAPSHOT_VERSION``), the table additionally renders one row
+  per decode REPLICA — lifecycle state (UP/PROBING/DEAD), in-flight
+  count, heartbeat age, and the SERVED snapshot version (``snap_v``;
+  a fleet serving divergent or frozen versions — a dead or zombie
+  trainer — is visible at a glance; -1 = pre-PR-14 archive without the
+  gauge) (docs/SERVING.md "Serving fleet", docs/DISTRIBUTED.md
+  "Durability").
 * ``--prom`` — the merged registry as one Prometheus text exposition,
   every sample carrying a ``node`` label.
 * ``--trace OUT.json`` — the merged cross-process Perfetto document:

@@ -94,6 +94,18 @@ run — deterministic greedy decode), ``recovery_time_s`` (death
 flagged -> first replayed completion, lower-better), and the
 fault-free aggregate ``fleet_tokens_per_s``.
 
+An ``lm_trainer_chaos`` A/B prices DURABILITY (the training half's
+recovery, PR 14): the same deterministic add-and-publish stream runs
+fault-free and under a seeded ``kill_trainer_at_publish`` mid-stream,
+then checkpoint+WAL recovery, an epoch-fenced STATE rebase over the
+real ``mvparam`` wire, and one staged zombie publish. Gated:
+``updates_lost`` and ``output_mismatches`` at ZERO (every acknowledged
+add survives the kill bit-identically),
+``epoch_fence_rejections_unexpected`` at ZERO, and
+``trainer_recovery_time_s`` (restart begin -> subscriber re-converged,
+lower-better); the staleness peak and WAL replay volume are ``_info``
+(docs/DISTRIBUTED.md "Durability").
+
 The JSON line also archives the FULL ``Dashboard.snapshot()`` (every
 Monitor/Histogram/Gauge/Counter/SLO), so a bench run preserves the
 complete instrument state — not just the hand-picked fields above —
@@ -1081,6 +1093,187 @@ def _fleet_chaos_ab(quick: bool) -> dict:
     }
 
 
+def _trainer_chaos_ab(quick: bool) -> dict:
+    """Durable online learning A/B (``lm_trainer_chaos``): one
+    deterministic add-and-publish stream runs twice over the real
+    ``mvparam`` wire into a subscriber replica — fault-free, then with
+    a seeded ``kill_trainer_at_publish`` killing the trainer
+    mid-stream, followed by the full recovery choreography: the
+    subscriber flags STALE (``-params_stale_after_s``), a fresh
+    incarnation restores checkpoint + replays the WAL to the exact
+    pre-crash version, claims the next epoch, rebases the fleet with a
+    STATE publish and finishes the schedule; finally one staged
+    zombie (epoch-1) publish must be fenced. Gated: ``updates_lost``
+    0 (every ACKNOWLEDGED add survives the kill),
+    ``output_mismatches`` 0 (recovered trainer AND re-converged
+    subscriber bit-identical to the fault-free leg),
+    ``epoch_fence_rejections_unexpected`` 0 (exactly the staged
+    zombie is rejected, nothing legitimate), and
+    ``trainer_recovery_time_s`` (restart begin -> subscriber
+    re-converged) regresses UP. The staleness peak and WAL replay
+    volume archive as ``_info``."""
+    import shutil
+    import tempfile
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.io import checkpoint
+    from multiverso_tpu.io.wal import DeltaWAL
+    from multiverso_tpu.runtime import Session
+    from multiverso_tpu.serving import (FaultPlan, ParamPublisher,
+                                        ParamSubscriber)
+
+    n_adds = 16 if quick else 32
+    kill_at = n_adds // 2 + 1       # mid-stream publish (1 = the rebase)
+    rows, cols = 32, 16
+
+    def make_delta(i):
+        rng = np.random.default_rng(4200 + i)
+        return rng.standard_normal((rows, cols)).astype(np.float32)
+
+    class _Killed(Exception):
+        pass
+
+    def _die():
+        raise _Killed()
+
+    sess = Session.get()
+    root = tempfile.mkdtemp(prefix="mv_trainer_chaos_")
+    legs: dict = {}
+    fence_stats: dict = {}
+    try:
+        for label in ("off", "on"):
+            wal_dir = os.path.join(root, label, "wal")
+            ck_root = os.path.join(root, label, "ckpt")
+            src = mv.create_table("matrix", rows, cols,
+                                  name=f"tchaos_src_{label}")
+            dst = mv.create_table("matrix", rows, cols,
+                                  name=f"tchaos_dst_{label}")
+            kv = _ObsBenchKV()
+            plane = f"bench_tchaos_{label}"
+            chaos = (f"kill_trainer_at_publish={kill_at}"
+                     if label == "on" else "")
+            plan = FaultPlan(chaos, kill_fn=_die)
+            sess.wal = DeltaWAL(wal_dir)
+            plan.attach_wal(sess.wal)
+            pub = ParamPublisher(kv, 2, label=plane, chaos=plan)
+            sub = ParamSubscriber(kv, {src.table_id: dst}, rank=1,
+                                  size=2, label=plane, poll_s=0.005,
+                                  stale_after_s=0.2)
+            saver = checkpoint.Autosaver(ck_root, every_steps=5, keep=2)
+            acked = 0
+            killed = False
+            recovery_s = 0.0
+            stale_peak = 0.0
+            replayed = 0
+            restored_step = -1
+            try:
+                try:
+                    pub.publish_state(src)
+                    for i in range(n_adds):
+                        src.add(make_delta(i))       # acknowledged
+                        acked += 1
+                        saver.step(i + 1)
+                        pub.publish_delta(src, make_delta(i))
+                except _Killed:
+                    killed = True
+                if killed:
+                    # crash: nothing more appends from this incarnation
+                    sess.wal.close()
+                    sess.wal = None
+                    deadline = time.monotonic() + 15
+                    while (not sub.params_stale()
+                           and time.monotonic() < deadline):
+                        time.sleep(0.005)
+                    stale_peak = sub.params_age_s()
+                    # restart: clobbered trainer recovers checkpoint +
+                    # WAL to the exact acknowledged state, claims the
+                    # next epoch, rebases the fleet, finishes the run
+                    t_restart = time.monotonic()
+                    src._install_state(
+                        np.zeros((rows, cols), np.float32), 0)
+                    restored_step = checkpoint.restore_latest(
+                        ck_root, wal_dir=wal_dir, wal_rank=0) or 0
+                    replayed = checkpoint.LAST_WAL_REPLAY["replayed"]
+                    lost_at_recovery = acked - int(src.version)
+                    sess.wal = DeltaWAL(wal_dir)
+                    pub.stop()
+                    pub = ParamPublisher(kv, 2, label=plane)  # epoch 2
+                    pub.publish_state(src)
+                    for i in range(acked, n_adds):
+                        src.add(make_delta(i))
+                        pub.publish_delta(src, make_delta(i))
+                    deadline = time.monotonic() + 30
+                    while (dst.version != src.version
+                           and time.monotonic() < deadline):
+                        time.sleep(0.005)
+                    recovery_s = time.monotonic() - t_restart
+                    # the staged zombie: one stale-epoch publish, never
+                    # applied anywhere
+                    pub.publish_record(
+                        0, src.table_id,
+                        [np.full((rows, cols), 99.0, np.float32)],
+                        epoch=1, version=src.version + 1)
+                # both legs: wait for the subscriber to fully converge
+                deadline = time.monotonic() + 30
+                want_rej = 1 if killed else 0
+                while ((dst.version != src.version
+                        or sub._fence.rejections < want_rej)
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
+                legs[label] = {
+                    "src": np.asarray(src.get()),
+                    "dst": np.asarray(dst.get()),
+                    "version": int(src.version),
+                    "acked_at_kill": acked if killed else n_adds,
+                    "updates_lost_at_recovery": (lost_at_recovery
+                                                 if killed else 0),
+                    "killed": killed,
+                    "recovery_s": recovery_s,
+                    "stale_peak": stale_peak,
+                    "replayed": replayed,
+                    "restored_step": restored_step,
+                }
+                fence_stats[label] = {
+                    "rejections": sub._fence.rejections,
+                    "staged": want_rej,
+                }
+            finally:
+                sub.stop()
+                pub.stop()
+                if sess.wal is not None:
+                    sess.wal.close()
+                    sess.wal = None
+    finally:
+        if sess.wal is not None:
+            sess.wal.close()
+            sess.wal = None
+        shutil.rmtree(root, ignore_errors=True)
+    on, off = legs["on"], legs["off"]
+    mismatches = int(not np.array_equal(on["src"], off["src"])) \
+        + int(not np.array_equal(on["dst"], off["dst"])) \
+        + int(not np.array_equal(on["dst"], on["src"]))
+    unexpected = sum(st["rejections"] - st["staged"]
+                     for st in fence_stats.values())
+    # updates_lost: acknowledged adds the recovered state is missing —
+    # the recovered version must equal the acknowledged count, and the
+    # fault-free/chaos final states must agree bit for bit
+    updates_lost = max(0, n_adds - on["version"]) \
+        + max(0, on["updates_lost_at_recovery"])
+    return {
+        "adds": n_adds,
+        "kill_at_publish": kill_at,
+        "trainer_killed_info": int(on["killed"]),
+        "acked_at_kill_info": on["acked_at_kill"],
+        "updates_lost": updates_lost,
+        "output_mismatches": mismatches,
+        "epoch_fence_rejections_unexpected": unexpected,
+        "trainer_recovery_time_s": round(on["recovery_s"], 4),
+        "staleness_peak_s_info": round(on["stale_peak"], 4),
+        "wal_replay_records_info": on["replayed"],
+        "checkpoint_step_info": on["restored_step"],
+    }
+
+
 def _warm(workload, snap_mgr, buckets) -> None:
     """Compile every bucket outside the timed loop (and outside the
     latency histogram)."""
@@ -1207,6 +1400,11 @@ def run(duration_s: float = 2.0, clients: int = 32,
     # are recovery invariants (counts), but recovery_time_s is a wall
     # clock that should not absorb 32 saturating client threads
     out["workloads"]["lm_fleet_chaos"] = _fleet_chaos_ab(quick)
+    # trainer-chaos A/B next to it: the TRAINING half's recovery
+    # invariants (checkpoint+WAL exactness, epoch fencing, staleness
+    # choreography) — count-led gates plus one restart wall clock that
+    # should also stay ahead of the saturating closed-loop phase
+    out["workloads"]["lm_trainer_chaos"] = _trainer_chaos_ab(quick)
     for name, (workload, knobs, n_clients, payload_fn) in specs.items():
         server.register(name, workload, **knobs)
         server.register(f"{name}_b1", workload, max_batch=1,
