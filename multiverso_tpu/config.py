@@ -274,6 +274,40 @@ define_int("spec_k", 0,
            "emitting up to spec_k + 1 tokens per iteration with outputs "
            "token-identical to plain greedy decode. 0 = off (today's "
            "one-token path, bit-for-bit). Needs kv_block_size > 0")
+define_bool("preempt", True,
+            "decode engine: overload-graceful serving — OPTIMISTIC "
+            "paged-KV admission (reserve prompt blocks only; the "
+            "generation grows its reservation block-by-block at decode "
+            "time) with preemption on pool exhaustion: the lowest-"
+            "priority/youngest live sequence releases its blocks, "
+            "re-enqueues at the front of its class, and on re-admission "
+            "recomputes from prompt + emitted tokens — bit-identical "
+            "output, host-side scheduling only (block tables stay "
+            "traced data). Anti-livelock: -preempt_budget per request "
+            "and a guaranteed-progress floor (the OLDEST live sequence "
+            "is never preempted). Needs kv_block_size > 0 and "
+            "prefill_token_budget > 0 (silently inert otherwise). "
+            "false = the pre-PR worst-case prompt+max_new up-front "
+            "reservation (the A/B baseline)")
+define_int("preempt_budget", 3,
+           "decode engine: max times one request may be preempted; a "
+           "request whose budget is spent re-admits PESSIMISTICALLY "
+           "(full worst-case reservation, so it can never need growth "
+           "or be preempted again) — with the oldest-live floor this "
+           "bounds recompute churn and makes preemption livelock-free")
+define_int("sched_lookahead", 8,
+           "decode engine: bounded admission lookahead past a "
+           "block-starved queue head — up to this many younger "
+           "requests of the head's class are scanned for one whose "
+           "reservation fits right now (a huge request at the head "
+           "must not starve small admissible ones). The bypass bound "
+           "is GLOBAL: a starved head accumulates one skip per "
+           "admission that jumps it (same-lane or other-lane), and at "
+           "the bound ALL admission freezes until it fits — freed "
+           "blocks then accumulate for it instead of being re-consumed "
+           "by other lanes' optimistic admissions. 0 = no same-lane "
+           "lookahead (strict FIFO within a class; the global freeze "
+           "then engages after one bypass)")
 define_bool("wal", False,
             "durable online learning: append every acknowledged LOCAL "
             "table apply to a per-rank write-ahead delta journal "

@@ -24,6 +24,14 @@ The inference half of the train/serve stack (docs/SERVING.md). Pieces:
   prefix caching (``prefix_cache``: hash-chained block identities via
   :func:`chain_hashes`, refcounted sharing, copy-on-write) so prompts
   sharing a prefix prefill it once (docs/SERVING.md "Prefix caching").
+  Under load it degrades BY POLICY (``-preempt``): requests carry
+  priority classes and deadlines, the queue is a weighted-fair
+  per-class scheduler that drops expired requests at pop time
+  (:class:`DeadlineExceededError`) before burning prefill, paged
+  admission reserves prompt blocks only and grows at decode time, and
+  pool exhaustion preempts the lowest-priority/youngest sequence —
+  recomputed on resume to a bit-identical output (docs/SERVING.md
+  "Overload and preemption").
 * the black box — :class:`FlightRecorder` (always-on bounded ring of
   per-iteration engine records) and :class:`EngineWatchdog`
   (stall/leak/queue-age self-diagnosis; trips dump a diagnostic bundle

@@ -47,10 +47,16 @@ class OverloadedError(RuntimeError):
     ``what`` names the resource — the queue-depth cap here, or the
     decode engine's KV block pool when a request's ``prompt + max_new``
     could never fit it (``depth``/``cap`` then carry blocks needed vs
-    pool capacity)."""
+    pool capacity). ``retriable`` is the retry-policy hint: a
+    queue-depth/fleet shed is TRANSIENT (back off and resend — capacity
+    frees as requests complete), while a request bigger than the whole
+    pool is PERMANENT (no amount of waiting ever admits it; resending
+    is a spin loop). Retry paths — the fleet router's requeue, the
+    bench's playback — branch on this field, never on string-matching
+    ``what``."""
 
     def __init__(self, model: str, depth: int, cap: int,
-                 what: str = "queue depth") -> None:
+                 what: str = "queue depth", retriable: bool = True) -> None:
         super().__init__(
             f"serving {what} for {model!r} at cap ({depth}/{cap}); "
             "request shed")
@@ -58,6 +64,17 @@ class OverloadedError(RuntimeError):
         self.depth = depth
         self.cap = cap
         self.what = what
+        self.retriable = bool(retriable)
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline passed before it completed.
+
+    Raised on both serving tiers: the :class:`~.router.FleetRouter`
+    expires pending/retrying/in-flight requests against its
+    ``deadline_s``, and the :class:`~.decode_engine.DecodeEngine` drops
+    expired requests at queue-POP time — before any prefill FLOPs are
+    burned on an answer nobody is waiting for."""
 
 
 def shape_buckets(max_batch: int) -> Tuple[int, ...]:

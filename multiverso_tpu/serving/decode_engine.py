@@ -100,6 +100,27 @@ cache (the fixed-slot precursor to vLLM's PagedAttention):
   sequence emits ``eos_id`` or reaches its per-request ``max_new``;
   the finished tokens resolve the caller's Future immediately and the
   slot is reusable on the next iteration.
+* **overload-graceful scheduling** (``-preempt``, default on; paged +
+  chunked only) — requests carry a tenant ``priority`` class and an
+  optional ``deadline_s``. The queue is a set of per-priority FIFO
+  lanes under a stride (weighted-fair) scheduler with bounded
+  lookahead past a block-starved head, and expired-deadline requests
+  are dropped at POP time (:class:`DeadlineExceededError`) before any
+  prefill is burned on them. Paged admission turns OPTIMISTIC: a
+  sequence reserves its PROMPT's blocks only and grows the reservation
+  block-by-block at decode time; on pool exhaustion the lowest-
+  priority/youngest victim is **preempted** — its blocks decref
+  (tail-first, so its prefix-cache chain stays hittable), it re-enters
+  the front of its lane, and on re-admission it recomputes from
+  ``prompt + emitted tokens``, making the final output bit-identical
+  to an un-preempted run (greedy decode is a deterministic function of
+  the token prefix + pinned params, and the recompute is nearly free
+  under the prefix cache). Anti-livelock: a per-request preemption
+  budget (past it the request re-admits pessimistically with its full
+  worst-case reservation) and a guaranteed-progress floor (the OLDEST
+  live sequence is never preempted). Preemption is host-side
+  scheduling only — block tables stay traced data, one compiled trace
+  per program (docs/SERVING.md "Overload and preemption").
 
 Snapshot pinning: an admission pins the engine's current params
 snapshot for the whole generation. The pinned snapshot only moves when
@@ -125,7 +146,7 @@ from ..analysis import lockwatch
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -134,7 +155,8 @@ import numpy as np
 from .. import trace
 from ..dashboard import Dashboard
 from ..log import Log
-from .batcher import OverloadedError, bucket_for, shape_buckets
+from .batcher import (DeadlineExceededError, OverloadedError, bucket_for,
+                      shape_buckets)
 from .block_pool import (SCRATCH_BLOCK, BlockPool, chain_hashes,
                          kv_bytes_per_block)
 from .flight_recorder import FlightRecorder
@@ -182,6 +204,15 @@ class DecodeEngineConfig:
     # spec_k tokens per live slot via n-gram prompt lookup and verifies
     # them in one fused fixed-K step (needs the paged KV cache)
     spec_k: Optional[int] = None
+    # overload-graceful serving (None = the matching flags): optimistic
+    # prompt-only reservation + grow-at-decode + preemption-with-
+    # recompute (paged + chunked only; False = worst-case up-front
+    # reservation, the A/B baseline), the per-request preemption
+    # budget, and the bounded admission lookahead past a block-starved
+    # queue head (0 = strict FIFO within a priority class)
+    preempt: Optional[bool] = None
+    preempt_budget: Optional[int] = None
+    sched_lookahead: Optional[int] = None
     # black-box layer (None = the matching flag): always-on flight
     # recorder ring, stall/leak watchdog, trip-bundle target, and the
     # rolling-window latency SLOs registered in the Dashboard
@@ -243,6 +274,14 @@ class DecodeEngineConfig:
 # process-unique small request ids: the flight recorder's admitted/
 # completed columns join ring records to requests without holding refs
 _RIDS = itertools.count(1)
+
+# tenant priority classes: small ints, higher = more important. The
+# admission scheduler weights class p by 2**p, so under contention
+# class p receives 2**p admissions for every one class 0 gets — and
+# every non-empty class keeps a POSITIVE share (the starvation bound
+# the tests assert; strict priority would starve class 0 forever).
+MAX_PRIORITY = 7
+DEFAULT_PRIORITY = 1
 
 # prompt-lookup n-gram width: the drafter keys on the sequence's last
 # _SPEC_NGRAM tokens. 2 is the sweet spot for the repetitive tails
@@ -307,15 +346,205 @@ class _PromptLookup:
         return out
 
 
+class _PrioQueue:
+    """Per-priority FIFO lanes under a stride (weighted-fair) scheduler.
+
+    Each admission decision picks the non-empty lane with the smallest
+    *pass* value, then advances that lane's pass by ``1 / 2**p``
+    (stride scheduling): class ``p`` receives a ``2**p`` share of
+    admissions under contention, ties break toward the higher class,
+    and an idle lane re-activates at the current pass frontier so it
+    cannot hoard credit and burst. Within a lane order is FIFO, with
+    two exceptions the overload design needs:
+
+    * **bounded lookahead** — when the lane head's block reservation
+      does not fit the pool right now, up to ``lookahead`` younger
+      requests of the SAME lane are scanned for one that does (a huge
+      request at the head must not starve small admissible ones). The
+      bypass bound is GLOBAL: the head accumulates one skip per
+      admission that jumps it — same-lane candidates and other lanes'
+      requests alike — and at ``lookahead`` skips ALL admission
+      freezes until the head fits (see :meth:`pop_admissible`), which
+      keeps every head's wait finite.
+    * **preempted re-enqueue** (:meth:`appendleft`) — a preempted
+      sequence returns to the FRONT of its lane: it is the oldest
+      work its class has, and re-admitting it first is what makes the
+      preemption budget a real churn bound.
+
+    Expired-deadline requests are dropped AT POP TIME, whenever the
+    scheduler's scan touches them — the caller receives them in the
+    second return slot and fails their futures before any prefill
+    runs. Per-lane depth rides ``QUEUE_DEPTH[name.pN]`` gauges.
+    Callers hold the engine lock; this class does no locking itself.
+    """
+
+    def __init__(self, name: str, lookahead: int) -> None:
+        self._name = name
+        self._lookahead = int(lookahead)
+        self._lanes: Dict[int, Deque["_Request"]] = {}
+        self._passes: Dict[int, float] = {}
+        self._gauges: Dict[int, object] = {}
+        self._n = 0
+        # queued requests that were preempted mid-generation and await
+        # resume: while any exist the engine HOLDS its snapshot pin
+        # (a pin move between preemption and resume would recompute
+        # the tail under different params and break the bit-identical
+        # contract) — maintained by _add and every removal path
+        self.n_resumed = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def _gauge(self, p: int):
+        g = self._gauges.get(p)
+        if g is None:
+            g = Dashboard.get_or_create_gauge(
+                f"QUEUE_DEPTH[{self._name}.p{p}]")
+            self._gauges[p] = g
+        return g
+
+    def _min_pass(self) -> float:
+        active = [self._passes[p] for p, lane in self._lanes.items()
+                  if lane]
+        return min(active) if active else 0.0
+
+    def _charge(self, p: int) -> None:
+        self._passes[p] += 1.0 / (1 << min(p, MAX_PRIORITY))
+
+    def _add(self, req: "_Request", front: bool) -> None:
+        lane = self._lanes.get(req.priority)
+        if lane is None:
+            lane = self._lanes[req.priority] = collections.deque()
+            self._passes.setdefault(req.priority, 0.0)
+        if not lane:
+            self._passes[req.priority] = max(
+                self._passes[req.priority], self._min_pass())
+        (lane.appendleft if front else lane.append)(req)
+        self._n += 1
+        if req.resumed:
+            self.n_resumed += 1
+        self._gauge(req.priority).set(float(len(lane)))
+
+    def _removed(self, req: "_Request") -> "_Request":
+        self._n -= 1
+        if req.resumed:
+            self.n_resumed -= 1
+        return req
+
+    def append(self, req: "_Request") -> None:
+        self._add(req, front=False)
+
+    def appendleft(self, req: "_Request") -> None:
+        """Preempted re-enqueue: the front of the request's lane."""
+        self._add(req, front=True)
+
+    def oldest_t_enq(self) -> Optional[float]:
+        heads = [lane[0].t_enq for lane in self._lanes.values() if lane]
+        return min(heads) if heads else None
+
+    def lowest_priority(self) -> Optional[int]:
+        lanes = [p for p, lane in self._lanes.items() if lane]
+        return min(lanes) if lanes else None
+
+    def pop_admissible(self, now: float, covers):
+        """One scheduling decision: ``(request or None, expired)``.
+
+        ``covers(req)`` is the admission gate (block coverage); every
+        queued request the scan touches is first deadline-checked and
+        dropped into ``expired`` when past it — fail-fast BEFORE any
+        prefill, the pop-time contract.
+
+        The bypass bound is GLOBAL: a block-starved head accumulates
+        one skip per admission that jumps it — same-lane lookahead
+        candidates AND other lanes' requests alike — and once any head
+        reaches the bound, admission freezes fleet-wide until that
+        head fits (only bound-reaching heads may admit). Per-lane-only
+        accounting would let the other lanes' small optimistic
+        admissions re-consume every block a completion frees, starving
+        a pessimistic (budget-exhausted worst-case) waiter forever;
+        freezing lets freed blocks ACCUMULATE for it, so its wait is
+        bounded by the live sequences' drain."""
+        expired: List["_Request"] = []
+
+        def dead(r: "_Request") -> bool:
+            return r.deadline is not None and r.deadline <= now
+
+        def sweep(p) -> None:
+            lane = self._lanes[p]
+            while lane and dead(lane[0]):
+                expired.append(self._removed(lane.popleft()))
+
+        thresh = self._lookahead if self._lookahead > 0 else 1
+        order = sorted((p for p, lane in self._lanes.items() if lane),
+                       key=lambda p: (self._passes[p], -p))
+        # starved heads first: one at its bypass bound freezes every
+        # other admission until it goes through
+        for p in list(order):
+            sweep(p)
+        starved = [p for p in order
+                   if self._lanes[p] and self._lanes[p][0].skips >= thresh]
+        scan = starved or [p for p in order if self._lanes[p]]
+        frozen = bool(starved)
+        checked: List["_Request"] = []   # heads found non-coverable
+        try:
+            for p in scan:
+                lane = self._lanes[p]
+                head = lane[0]
+                if covers(head):
+                    self._removed(lane.popleft())
+                    self._charge(p)
+                    for h in checked:
+                        h.skips += 1
+                    return head, expired
+                checked.append(head)
+                if frozen or self._lookahead <= 0 \
+                        or head.skips >= self._lookahead:
+                    continue
+                i, scanned = 1, 0
+                while i < len(lane) and scanned < self._lookahead:
+                    cand = lane[i]
+                    if dead(cand):
+                        del lane[i]
+                        expired.append(self._removed(cand))
+                        continue
+                    scanned += 1
+                    if covers(cand):
+                        del lane[i]
+                        self._removed(cand)
+                        self._charge(p)
+                        for h in checked:
+                            h.skips += 1
+                        return cand, expired
+                    i += 1
+            return None, expired
+        finally:
+            for p in order:
+                self._gauge(p).set(float(len(self._lanes[p])))
+
+    def drain(self) -> List["_Request"]:
+        """Remove and return everything (the failure path)."""
+        out: List["_Request"] = []
+        for p, lane in self._lanes.items():
+            out.extend(lane)
+            lane.clear()
+            self._gauge(p).set(0.0)
+        self._n = 0
+        self.n_resumed = 0
+        return out
+
+
 class _Request:
     __slots__ = ("prompt", "max_new", "future", "t_enq", "t_last",
                  "slot", "out", "version", "ctx", "pf_off", "pf_chunks",
                  "t_admit", "blocks", "rid", "hashes", "hash_seed",
                  "n_hit", "full_hit", "saved", "pf_reg", "ttft_pending",
-                 "drafter")
+                 "drafter", "priority", "deadline", "preempts",
+                 "resumed", "skips", "prompt0")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
-                 ctx: Optional[trace.SpanContext] = None) -> None:
+                 ctx: Optional[trace.SpanContext] = None,
+                 priority: int = DEFAULT_PRIORITY,
+                 deadline: Optional[float] = None) -> None:
         self.rid = next(_RIDS)
         self.prompt = prompt
         self.max_new = max_new
@@ -350,6 +579,19 @@ class _Request:
         # speculative decoding: the slot's prompt-lookup draft index
         # (None on spec_k=0 engines — created at admission)
         self.drafter: Optional[_PromptLookup] = None
+        # overload-graceful scheduling: tenant class, absolute
+        # monotonic deadline (None = none), times preempted (the
+        # budget), whether a preemption already interrupted emitted
+        # output (resume recomputes, TTFT never re-records), times the
+        # admission lookahead bypassed this request at the lane head,
+        # and the ORIGINAL prompt (the resume base — ``prompt`` grows
+        # to prompt0 + emitted tokens across preemptions)
+        self.priority = int(priority)
+        self.deadline = deadline
+        self.preempts = 0
+        self.resumed = False
+        self.skips = 0
+        self.prompt0 = prompt
 
 
 class DecodeEngine:
@@ -511,6 +753,23 @@ class DecodeEngine:
                       f"the paged KV cache (kv_block_size > 0) — the "
                       f"verify window parks rejected/pad writes in the "
                       f"scratch block")
+        # overload-graceful serving: optimistic prompt-only reservation
+        # + grow-at-decode + preemption-with-recompute. Paged + chunked
+        # only (a contiguous strip has no blocks to release, and
+        # monolithic admission can neither grow nor restart mid-prompt)
+        # — the knob gates itself off otherwise, the prefix_cache
+        # precedent. preempt=False keeps the pre-PR worst-case
+        # prompt+max_new up-front reservation (the A/B baseline).
+        self._preempt_on = (self._paged and self._budget > 0
+                            and bool(ec._resolved("preempt")))
+        self._preempt_budget = int(ec._resolved("preempt_budget"))
+        if self._preempt_budget < 0:
+            Log.fatal(f"DecodeEngine {name!r}: negative preempt_budget "
+                      f"{self._preempt_budget}")
+        self._lookahead = int(ec._resolved("sched_lookahead"))
+        if self._lookahead < 0:
+            Log.fatal(f"DecodeEngine {name!r}: negative sched_lookahead "
+                      f"{self._lookahead}")
 
         # fused admission: prefill a group of prompts (padded to a batch
         # bucket x prompt bucket), gather each last REAL position's logits
@@ -661,7 +920,13 @@ class DecodeEngine:
         # watchdog's leaked-reservation heuristic must not read that
         # window as a leak
         self._admitting = False
-        self._q: Deque[_Request] = collections.deque()
+        # per-priority weighted-fair admission lanes (a plain FIFO when
+        # every submit uses the default class)
+        self._q = _PrioQueue(name, self._lookahead)
+        # chaos/test hook (faultinject pool_squeeze=): block ids held
+        # hostage to force pool pressure; excluded from the watchdog's
+        # leaked-reservation heuristic
+        self._squeezed: List[int] = []
         self._lock = lockwatch.lock("serving.DecodeEngine._lock")
         self._cv = threading.Condition(self._lock)
         self._stop = threading.Event()
@@ -680,6 +945,14 @@ class DecodeEngine:
             f"SERVE_PARAMS_AGE[{name}]")
         self.shed_counter = Dashboard.get_or_create_counter(
             f"SERVE_SHED[{name}]")
+        # overload-graceful instruments: preemption events, expired-
+        # deadline drops, and per-class shed counters (created lazily —
+        # one per priority class actually shed)
+        self.preempt_counter = Dashboard.get_or_create_counter(
+            f"PREEMPTIONS[{name}]")
+        self.deadline_counter = Dashboard.get_or_create_counter(
+            f"DEADLINE_DROPS[{name}]")
+        self._shed_class_counters: Dict[int, object] = {}
         self.steps_counter = Dashboard.get_or_create_counter(
             f"DECODE_STEPS[{name}]")
         # token-accounting split: prompt tokens prefilled vs tokens
@@ -764,6 +1037,13 @@ class DecodeEngine:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_steps = 0
+        # overload mirrors (the PREEMPTIONS/DEADLINE_DROPS counters
+        # stay monotonic; these reset with the bench window):
+        # preemption EVENTS, distinct requests preempted at least
+        # once, and expired-deadline queue drops
+        self.preemptions = 0
+        self.preempted = 0
+        self.deadline_drops = 0
         # window base for the pool's monotonic eviction counter, so
         # stats()["prefix_evictions"] resets with its sibling mirrors
         self._evictions_base = 0
@@ -790,16 +1070,44 @@ class DecodeEngine:
             raise ValueError(f"max_new {max_new} outside "
                              f"[1, {self.config.max_new}]")
 
+    def _shed_class(self, priority: int) -> None:
+        counter = self._shed_class_counters.get(priority)
+        if counter is None:
+            counter = Dashboard.get_or_create_counter(
+                f"SHED_BY_CLASS[{self.name}.p{priority}]")
+            self._shed_class_counters[priority] = counter
+        counter.inc()
+
     def submit(self, prompt: np.ndarray, max_new: Optional[int] = None,
-               ctx: Optional[trace.SpanContext] = None) -> Future:
+               ctx: Optional[trace.SpanContext] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Future:
         """Enqueue one prompt; fast-rejects at the admission-queue cap,
         and (paged KV) when ``prompt + max_new`` needs more blocks than
-        the whole pool holds — such a request could NEVER be admitted,
-        so queueing it would deadlock the admission head. ``ctx`` is the
-        request's trace handoff token (or None)."""
+        the whole pool holds — such a request could NEVER be admitted
+        (``retriable=False``: no amount of retrying changes that), so
+        queueing it would deadlock the admission head. ``ctx`` is the
+        request's trace handoff token (or None). ``priority`` is the
+        tenant class (0..7, higher = more important; None = class 1 —
+        admission shares are weighted-fair, docs/SERVING.md "Overload
+        and preemption"). ``deadline_s`` (None = none) is seconds from
+        now past which the answer is worthless: an expired request is
+        dropped at queue-POP time with :class:`DeadlineExceededError`
+        before any prefill runs."""
         self.validate(prompt, max_new)
+        prio = DEFAULT_PRIORITY if priority is None else int(priority)
+        if not 0 <= prio <= MAX_PRIORITY:
+            raise ValueError(f"priority {prio} outside "
+                             f"[0, {MAX_PRIORITY}]")
+        deadline = None
+        if deadline_s is not None:
+            if float(deadline_s) <= 0:
+                raise ValueError(f"deadline_s must be > 0, "
+                                 f"got {deadline_s}")
+            deadline = time.monotonic() + float(deadline_s)
         p = np.asarray(prompt, np.int32).ravel()
-        req = _Request(p, int(max_new or self.config.max_new), ctx)
+        req = _Request(p, int(max_new or self.config.max_new), ctx,
+                       priority=prio, deadline=deadline)
         with self._cv:
             if self._stop.is_set():
                 raise RuntimeError(f"decode engine {self.name!r} is stopped")
@@ -808,12 +1116,15 @@ class DecodeEngine:
                 if need > self._pool.capacity:
                     self.shed += 1
                     self.shed_counter.inc()
+                    self._shed_class(prio)
                     raise OverloadedError(self.name, need,
                                           self._pool.capacity,
-                                          what="kv block pool")
+                                          what="kv block pool",
+                                          retriable=False)
             if len(self._q) >= self.config.max_queue:
                 self.shed += 1
                 self.shed_counter.inc()
+                self._shed_class(prio)
                 raise OverloadedError(self.name, len(self._q),
                                       self.config.max_queue)
             if self.t_first is None:
@@ -833,7 +1144,8 @@ class DecodeEngine:
         now = time.monotonic()
         with self._lock:
             depth = len(self._q)
-            age = (now - self._q[0].t_enq) if self._q else 0.0
+            oldest = self._q.oldest_t_enq()
+            age = (now - oldest) if oldest is not None else 0.0
             pinned = self._pinned_version
             snap = self._snap
         from .. import config
@@ -864,6 +1176,9 @@ class DecodeEngine:
             "active_slots": int(self._active.sum()),
             "queue_depth": depth,
             "queue_age_s": age,
+            # rides replica heartbeats -> the router's FLEET_PREEMPTS
+            # gauge -> the opscenter replica rows
+            "preemptions": self.preemptions,
             "stopped": self._stop.is_set(),
         }
 
@@ -884,7 +1199,10 @@ class DecodeEngine:
         msg = self._pool.drift()
         if msg is not None:
             return msg
-        live_blocks = self._pool.n_live
+        # chaos-squeezed blocks are live-with-no-sequence BY DESIGN —
+        # the leak heuristic must not read a staged pool squeeze as a
+        # lost reservation
+        live_blocks = self._pool.n_live - len(self._squeezed)
         if (live_blocks > 0 and not self._active.any()
                 and self._pf is None and not self._admitting
                 and not self._q):
@@ -925,10 +1243,28 @@ class DecodeEngine:
             else m
         return max(0, usable - cached)
 
+    def _reservation_blocks(self, req: _Request) -> int:
+        """The admission's reservation size. Worst case by default:
+        ``prompt + remaining generation`` worth of blocks (``prompt``
+        already folds in any pre-preemption emitted tokens, which
+        ``max_new`` also counts — hence the subtraction). With
+        ``-preempt`` (optimistic admission) it is the PROMPT's blocks
+        only — the generation grows block-by-block at decode time and
+        preemption supplies blocks under pressure — EXCEPT for a
+        request whose preemption budget is already spent: that one
+        re-admits pessimistically, so it can never need growth, never
+        be preempted again, and never churn (the anti-livelock
+        backstop)."""
+        if self._preempt_on and req.preempts < self._preempt_budget:
+            return self._pool.blocks_needed(len(req.prompt))
+        return self._pool.blocks_needed(
+            len(req.prompt) + req.max_new - len(req.out))
+
     def _blocks_cover(self, req: _Request, reserved: int) -> bool:
-        """Paged-KV admission gate: a request admits only when its WHOLE
-        reservation (``prompt + max_new`` worth of blocks, less what
-        earlier arrivals of the same wave will take — and, with prefix
+        """Paged-KV admission gate: a request admits only when its
+        reservation (:meth:`_reservation_blocks` — worst-case by
+        default, prompt-only under ``-preempt``, less what earlier
+        arrivals of the same wave will take — and, with prefix
         caching, less the cached blocks it will share instead of
         allocate) fits the reclaimable pool (free list + evictable
         cached blocks). A false verdict leaves it QUEUED — completions
@@ -938,10 +1274,30 @@ class DecodeEngine:
         admission deadlock, tested)."""
         if not self._paged:
             return True
-        need = self._pool.blocks_needed(len(req.prompt) + req.max_new)
+        need = self._reservation_blocks(req)
         if self._prefix:
             need -= self._prefix_usable_hits(req)
         return need + reserved <= self._pool.n_free + self._pool.n_cached
+
+    def _drop_expired(self, dropped: List[_Request]) -> None:
+        """Deadline enforcement lands at queue-POP time: the scheduler
+        hands back every expired request its scan touched, and the
+        engine fails them HERE — before a single prefill FLOP is spent
+        on an answer whose requester stopped waiting (the pre-PR
+        behaviour ran the full prefill first). Futures resolve outside
+        the engine lock: their done-callbacks are user code."""
+        now = time.monotonic()
+        for req in dropped:
+            self.deadline_drops += 1
+            self.deadline_counter.inc()
+            if trace.enabled() and req.ctx is not None:
+                trace.record_span("queue.wait", req.ctx, req.t_enq, now,
+                                  cause="deadline")
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(DeadlineExceededError(
+                    f"decode request rid {req.rid} missed its deadline "
+                    f"after {now - req.t_enq:.3f}s queued "
+                    f"(engine {self.name!r})"))
 
     def _loop(self) -> None:
         chunked = self._budget > 0
@@ -954,26 +1310,37 @@ class DecodeEngine:
                 if (self._stop.is_set() and not self._q
                         and self._pf is None and not self._active.any()):
                     return
-                # admission is FIFO off the explicit free-slot set (kept
-                # current at admit/complete — the loop used to rescan all
-                # S slots here every iteration) and, when paged, gated on
+                # admission pops through the weighted-fair lane
+                # scheduler (expired deadlines dropped at pop,
+                # bounded lookahead past a block-starved head) onto
+                # the explicit free-slot set and, when paged, gates on
                 # the block pool covering each arrival's reservation
+                now = time.monotonic()
                 arrivals: List[_Request] = []
+                expired: List[_Request] = []
                 if chunked:
                     # one admission prefills at a time; the NEXT request
                     # is only picked up once the current one goes live
-                    if (self._pf is None and self._free_q and self._q
-                            and self._blocks_cover(self._q[0], 0)):
-                        arrivals.append(self._q.popleft())
+                    if self._pf is None and self._free_q and self._q:
+                        req, expired = self._q.pop_admissible(
+                            now, lambda r: self._blocks_cover(r, 0))
+                        if req is not None:
+                            arrivals.append(req)
                 else:
                     reserved = 0
-                    while (len(arrivals) < len(self._free_q) and self._q
-                           and self._blocks_cover(self._q[0], reserved)):
-                        req = self._q.popleft()
+                    while len(arrivals) < len(self._free_q) and self._q:
+                        req, exp = self._q.pop_admissible(
+                            now,
+                            lambda r, res=reserved:
+                            self._blocks_cover(r, res))
+                        expired.extend(exp)
+                        if req is None:
+                            break
                         if self._paged:
-                            reserved += self._pool.blocks_needed(
-                                len(req.prompt) + req.max_new)
+                            reserved += self._reservation_blocks(req)
                         arrivals.append(req)
+            if expired:
+                self._drop_expired(expired)
             # the progress clock restarts when the loop picks work up:
             # last_iter_age_s then measures how long THIS pass has been
             # stuck, not how long the engine idled beforehand (an idle
@@ -1020,6 +1387,13 @@ class DecodeEngine:
                 return
             if worked:
                 self._record_iteration(t_work0, step_ms)
+            elif not arrivals and not expired:
+                # nothing live and nothing admissible: the queue holds
+                # only block-starved waiters (a budget-exhausted
+                # pessimistic re-admission, or a chaos-squeezed pool) —
+                # yield briefly instead of hot-spinning until blocks
+                # free
+                time.sleep(0.0005)
 
     def _record_iteration(self, t_work0: float, step_ms: float) -> None:
         """One iteration retired: bump the progress clock/counters and
@@ -1034,8 +1408,8 @@ class DecodeEngine:
         if recorder is None:
             return
         try:
-            oldest = self._q[0].t_enq if self._q else None
-        except IndexError:           # racing a concurrent submit/shed
+            oldest = self._q.oldest_t_enq()
+        except (IndexError, RuntimeError):   # racing a concurrent submit
             oldest = None
         recorder.record((
             self.iters_total, now, (now - t_work0) * 1e3, step_ms,
@@ -1051,14 +1425,22 @@ class DecodeEngine:
             self._it_spec_proposed if self._spec else -1,
             self._it_spec_accepted if self._spec else -1))
 
-    def _maybe_refresh(self) -> None:
-        """Move the pinned snapshot only while NO generation is in flight
-        (neither live slots nor a mid-prefill admission) — an admission
-        therefore pins one params version for its lifetime."""
+    def _maybe_refresh(self, hold: bool = False) -> None:
+        """Move the pinned snapshot only while NO generation is in
+        flight — neither live slots, nor a mid-prefill admission, nor
+        (``-preempt``) a PREEMPTED request awaiting resume anywhere in
+        the queue (``hold`` covers the one being re-admitted right
+        now, already popped). A resume recomputes its tail from
+        prompt + emitted tokens, and that recompute is only
+        bit-identical under the SAME params the first life pinned — so
+        preemption extends the pin's lifetime across the eviction gap,
+        and the surfaced trade is staleness, never a mixed-version
+        generation."""
         snap = self._snap
         if snap is None:
             snap = self._manager.current()
-        elif not self._active.any() and self._pf is None:
+        elif (not hold and not self._active.any() and self._pf is None
+                and self._q.n_resumed == 0):
             snap = self._manager.ensure_fresh(self.config.max_staleness_s)
         if self._snap is not snap or self._pinned is None:
             # the decode copy memoizes on snapshot VERSION: a drain/
@@ -1097,10 +1479,13 @@ class DecodeEngine:
                     self._pool.flush_cache()
 
     def _reserve_blocks(self, req: _Request, slot: int) -> None:
-        """Paged KV: build the admission's WHOLE reservation
-        (``prompt + max_new`` positions) up front and install it in the
-        slot's block table row — the loop's ``_blocks_cover`` gate
-        guaranteed coverage, so this cannot fail.
+        """Paged KV: build the admission's reservation
+        (:meth:`_reservation_blocks` — ``prompt + max_new`` positions
+        worst-case, the prompt's positions only under optimistic
+        ``-preempt`` admission) and install it in the slot's block
+        table row — the loop's ``_blocks_cover`` gate guaranteed
+        coverage, so this cannot fail (a racing chaos pool squeeze is
+        the one exception; ``_begin_prefill`` requeues on it).
 
         With prefix caching the reservation SPLICES: the longest cached
         prefix of the prompt is claimed from the content index (those
@@ -1113,14 +1498,20 @@ class DecodeEngine:
         to the jitted step."""
         if not self._paged:
             return
-        total = self._pool.blocks_needed(len(req.prompt) + req.max_new)
+        total = self._reservation_blocks(req)
         matched: List[int] = []
+        hashes: List[bytes] = []
+        full_hit_cow = False
         if self._prefix:
             hashes = self._req_hashes(req)
             matched = self._pool.lookup(hashes)
             req.n_hit = len(matched)
             req.full_hit = bool(matched) and (
                 len(matched) * self._block_size == len(req.prompt))
+            # claimed blocks land on the request IMMEDIATELY: if an
+            # alloc below races a concurrent pool claimant and raises,
+            # the requeue path can decref exactly what was taken
+            req.blocks = matched
             if req.full_hit:
                 shared_last = matched[-1]
                 dup = self._pool.alloc(1)[0]
@@ -1129,13 +1520,19 @@ class DecodeEngine:
                     np.int32(shared_last), np.int32(dup))
                 self._pool.decref([shared_last])
                 matched[-1] = dup
-                self.cow_copies += 1
+                full_hit_cow = True
             req.saved = (len(req.prompt) if req.full_hit
                          else req.n_hit * self._block_size)
+        req.blocks = matched + self._pool.alloc(total - len(matched))
+        # stats commit only once the WHOLE reservation stands: a
+        # squeeze-raced alloc raise requeues the request, and its
+        # re-admission must not count the same hits/saves twice
+        if self._prefix:
+            if full_hit_cow:
+                self.cow_copies += 1
             self.prefix_hits += req.n_hit
             self.prefix_misses += len(hashes) - req.n_hit
             self.prefill_tokens_saved += req.saved
-        req.blocks = matched + self._pool.alloc(total - len(matched))
         row = self._block_tables[slot]
         row[:] = SCRATCH_BLOCK
         row[: total] = req.blocks
@@ -1167,10 +1564,28 @@ class DecodeEngine:
         The reserved-not-live admission keeps its blocks for its whole
         lifetime — a concurrent wave cannot steal a mid-prefill
         sequence's cache out from under it."""
-        self._maybe_refresh()
+        self._maybe_refresh(hold=req.resumed)
         req.version = self._snap.version
         req.slot = slot
-        self._reserve_blocks(req, slot)
+        try:
+            self._reserve_blocks(req, slot)
+        except RuntimeError:
+            # a concurrent pool claimant (the chaos pool squeeze is the
+            # one in-contract case) raced the admission gate: requeue
+            # the request instead of killing the loop thread — exactly
+            # a preemption-before-any-work, minus the accounting
+            if req.blocks:
+                self._pool.decref(reversed(req.blocks))
+                req.blocks = []
+            self._block_tables[slot][:] = SCRATCH_BLOCK
+            self._free_q.append(slot)
+            req.slot = -1
+            req.hashes = None
+            req.n_hit = 0
+            req.full_hit = False
+            with self._cv:
+                self._q.appendleft(req)
+            return
         req.pf_chunks = 0
         req.t_admit = time.monotonic()   # queue.wait ends here
         if self._spec:
@@ -1187,6 +1602,9 @@ class DecodeEngine:
             # IS the request's first token (TTFT = one decode step).
             if trace.enabled() and req.ctx is not None:
                 now = time.monotonic()
+                extra = dict(self._mesh_attrs)
+                if req.preempts:
+                    extra["preempted"] = req.preempts
                 trace.record_span("queue.wait", req.ctx, req.t_enq,
                                   req.t_admit, cause="admission")
                 trace.record_span(
@@ -1195,8 +1613,10 @@ class DecodeEngine:
                     budget=self._budget, snapshot_version=req.version,
                     blocks=len(req.blocks), pool_free=self._pool.n_free,
                     prefix_hit_blocks=req.n_hit,
-                    prefill_tokens_saved=req.saved, **self._mesh_attrs)
-            req.ttft_pending = True
+                    prefill_tokens_saved=req.saved, **extra)
+            # a RESUMED full hit already recorded its TTFT in its first
+            # life: the next fused-step token is an inter-token gap
+            req.ttft_pending = not req.resumed
             # the ITL base moves to ADMISSION: the next step's first
             # token records TTFT, but a speculative window's extra
             # tokens divide (now - t_last) as ITL samples — left at
@@ -1273,8 +1693,15 @@ class DecodeEngine:
         # first generated token (exactly the monolithic prefill's gather)
         tok0 = int(np.argmax(np.asarray(logits)))
         now = time.monotonic()
+        if req.resumed:
+            # preemption recompute: TTFT already happened in the first
+            # life — this token is an inter-token gap, and the sample
+            # honestly carries the whole preemption stall (t_last is
+            # the last PRE-preemption emission)
+            self.itl_hist.record((now - req.t_last) * 1e3)
+        else:
+            self.ttft_hist.record((now - req.t_enq) * 1e3)
         req.t_last = now
-        self.ttft_hist.record((now - req.t_enq) * 1e3)
         self.tokens += 1
         self.decode_tok_counter.inc()
         self._it_decode += 1
@@ -1290,6 +1717,8 @@ class DecodeEngine:
             if self._prefix:
                 extra["prefix_hit_blocks"] = req.n_hit
                 extra["prefill_tokens_saved"] = req.saved
+            if req.preempts:
+                extra["preempted"] = req.preempts
             extra.update(self._mesh_attrs)
             trace.record_span(
                 "decode.admit", req.ctx, req.t_admit, now, slot=req.slot,
@@ -1411,9 +1840,13 @@ class DecodeEngine:
         request's REMAINING budget minus one (the correction token
         always fills the final emission), so a valid window write never
         passes position ``prompt + max_new - 2`` — strictly inside the
-        admission-time block reservation, which is how the K-token
+        worst-case block reservation, which is how the K-token
         overhang is accounted for without reserving a single extra
-        block. Returns ``(None, None)`` when no slot drafted: the
+        block (under optimistic ``-preempt`` admission the same bound
+        is what ``_ensure_growth`` sizes each slot's growth to: the
+        window length rides ``n_valid``, so speculative writes land in
+        grown-and-owned blocks exactly like plain steps' writes do).
+        Returns ``(None, None)`` when no slot drafted: the
         iteration then runs the plain fused step, so a spec engine's
         draft-less iterations (and the whole life of a ``spec_k=0``
         engine) stay on today's path bit-for-bit."""
@@ -1437,6 +1870,144 @@ class DecodeEngine:
             n_valid[s] = 1 + len(drafts)
         return toks, n_valid
 
+    def _admitted_requests(self) -> List[_Request]:
+        reqs = [r for r in self._slot_req if r is not None]
+        if self._pf is not None:
+            reqs.append(self._pf)
+        return reqs
+
+    def _pick_victim(self, grower: _Request) -> Optional[_Request]:
+        """Preemption victim policy: among admitted sequences (live
+        slots plus the reserved-not-live mid-prefill admission), pick
+        the LOWEST-priority then YOUNGEST one — never the grower
+        itself, and NEVER the overall-oldest sequence (the
+        guaranteed-progress floor: whatever the churn, the oldest
+        admission runs to completion, which is what makes preemption
+        terminate). A victim must additionally have preemption budget
+        left and rank below the grower (strictly lower class, or the
+        same class but younger) — EXCEPT when the grower IS the
+        oldest: the floor outranks budget and class, because the
+        submit-time shed gate guarantees the oldest's worst case fits
+        once every other holder is evicted, and the whole design
+        hinges on the oldest always completing."""
+        cands = [r for r in self._admitted_requests() if r is not grower]
+        if not cands:
+            return None
+        oldest = min(cands + [grower], key=lambda r: r.t_enq)
+        cands = [r for r in cands if r is not oldest]
+        if not cands:
+            return None
+        if oldest is not grower:
+            cands = [r for r in cands
+                     if r.preempts < self._preempt_budget
+                     and (r.priority < grower.priority
+                          or (r.priority == grower.priority
+                              and r.t_enq > grower.t_enq))]
+            if not cands:
+                return None
+        return min(cands, key=lambda r: (r.priority, -r.t_enq))
+
+    def _preempt(self, req: _Request, why: str = "") -> None:
+        """Evict one admitted sequence and free its blocks — host-side
+        scheduling only (the block tables are traced DATA; no compiled
+        program ever notices). The victim re-enters the FRONT of its
+        priority lane and, on re-admission, recomputes from
+        ``prompt + emitted tokens``: greedy decode is a deterministic
+        function of the token prefix and the pinned params, and the
+        paged kernels' attention operand is bit-identical across the
+        prefill/decode layouts, so the resumed generation's remaining
+        tokens equal the un-preempted run's exactly (oracle-tested).
+        Blocks decref TAIL-first (the ``_release_seq`` LRU
+        convention), so under the prefix cache the victim's registered
+        blocks park in the cached tier and splice straight back at
+        resume — recompute is then nearly free."""
+        t0 = time.monotonic()
+        slot = req.slot
+        freed = len(req.blocks)
+        if req is self._pf:
+            self._pf = None
+        else:
+            self._active[slot] = False
+            self._slot_req[slot] = None
+        if req.blocks:
+            self._pool.decref(reversed(req.blocks))
+            req.blocks = []
+        self._block_tables[slot][:] = SCRATCH_BLOCK
+        self._free_q.append(slot)
+        req.slot = -1
+        if req.preempts == 0:
+            self.preempted += 1
+        req.preempts += 1
+        self.preemptions += 1
+        self.preempt_counter.inc()
+        # resume state: the working prompt becomes the ORIGINAL prompt
+        # plus everything emitted so far; prefill-progress/prefix/spec
+        # state resets (the drafter rebuilds at re-admission from the
+        # same token sequence, so its proposals are identical)
+        if req.out:
+            req.prompt = np.concatenate(
+                [req.prompt0, np.asarray(req.out, np.int32)])
+            req.resumed = True
+        req.hashes = None
+        req.n_hit = 0
+        req.full_hit = False
+        req.saved = 0
+        req.pf_off = req.pf_chunks = req.pf_reg = 0
+        req.ttft_pending = False
+        req.drafter = None
+        if trace.enabled() and req.ctx is not None:
+            trace.record_span(
+                "decode.preempt", req.ctx, t0, time.monotonic(),
+                victim=req.rid, slot=slot, blocks_freed=freed,
+                preempts=req.preempts, priority=req.priority, why=why)
+        with self._cv:
+            self._q.appendleft(req)
+
+    def _ensure_growth(self, n_valid) -> None:
+        """Optimistic admission's decode-time half: before the fused
+        step (or verify window) dispatches, every live slot's
+        reservation must cover the positions THIS iteration writes —
+        ``pos .. pos + window - 1``. Growth is allocator work plus a
+        block-table row append (traced data, never a shape). On pool
+        exhaustion it preempts via :meth:`_pick_victim`; when no
+        admissible victim exists (everyone shielded by the floor/
+        budget/class rules, or a chaos squeeze holds the pool) the
+        grower itself yields and recomputes later — in normal
+        operation that is never the oldest, whose growth the floor
+        guarantees. Growers run highest-class-oldest-first, so the
+        important/old sequences claim blocks before the preemptible
+        ones."""
+        order = [s for s in range(self.config.slots)
+                 if self._slot_req[s] is not None]
+        order.sort(key=lambda s: (-self._slot_req[s].priority,
+                                  self._slot_req[s].t_enq))
+        for s in order:
+            req = self._slot_req[s]
+            if req is None:          # victimized by an earlier grower
+                continue
+            win = 1 if n_valid is None else max(1, int(n_valid[s]))
+            need = self._pool.blocks_needed(int(self._pos[s]) + win)
+            grow = need - len(req.blocks)
+            if grow <= 0:
+                continue
+            while self._slot_req[s] is req:
+                if self._pool.can_alloc(grow):
+                    try:
+                        blocks = self._pool.alloc(grow)
+                    except RuntimeError:
+                        # a concurrent claimant (chaos pool squeeze)
+                        # raced the check: fall through to preemption
+                        continue
+                    base = len(req.blocks)
+                    req.blocks.extend(blocks)
+                    self._block_tables[s][base: base + grow] = blocks
+                    break
+                victim = self._pick_victim(req)
+                if victim is None:
+                    self._preempt(req, why="yield: no admissible victim")
+                    break
+                self._preempt(victim, why=f"growth for rid {req.rid}")
+
     def _step(self) -> None:
         # ONE branch decides all per-iteration trace work: when tracing
         # is off this loop allocates nothing trace-related (guarded by
@@ -1446,6 +2017,14 @@ class DecodeEngine:
         spec_toks = n_valid = None
         if self._spec:
             spec_toks, n_valid = self._propose_drafts()
+        if self._preempt_on:
+            # grow every live reservation to cover this iteration's
+            # writes, preempting under pool pressure; a yield can
+            # deactivate slots (incl. every drafted one), so re-check
+            self._ensure_growth(n_valid if spec_toks is not None
+                                else None)
+            if not self._active.any():
+                return
         # host state (tok/pos/active — and, paged, the block tables)
         # feeds the jit as plain numpy: the same aval signature warmup()
         # uses, so the two share one trace
@@ -1587,8 +2166,7 @@ class DecodeEngine:
             # the loop thread is dying: flag stop so later submits
             # fast-fail instead of enqueueing futures nobody will drain
             self._stop.set()
-            pending = list(self._q)
-            self._q.clear()
+            pending = self._q.drain()
         live = [r for r in self._slot_req if r is not None]
         if self._pf is not None:      # mid-prefill admission dies too
             live.append(self._pf)
@@ -1604,6 +2182,9 @@ class DecodeEngine:
                 if req.blocks:
                     self._pool.decref(req.blocks)
                     req.blocks = []
+            if self._squeezed:       # staged chaos squeeze dies too
+                self._pool.decref(self._squeezed)
+                self._squeezed = []
             self._block_tables[:] = SCRATCH_BLOCK
         self._active[:] = False
         self._slot_req = [None] * self.config.slots
@@ -1615,6 +2196,37 @@ class DecodeEngine:
             seen.add(id(req))
             if req.future.set_running_or_notify_cancel():
                 req.future.set_exception(exc)
+
+    # -- chaos hooks --------------------------------------------------------
+    def squeeze_pool(self, frac: float) -> int:
+        """Chaos/test hook (the ``-chaos`` ``pool_squeeze=`` fault):
+        take up to ``frac`` of the paged pool's capacity hostage —
+        blocks allocate and are simply HELD, so live traffic sees a
+        shrunken pool and the preemption machinery gets exercised
+        under real pressure. Returns the blocks actually held (capped
+        to what is reclaimable right now). The watchdog's
+        leaked-reservation heuristic excludes squeezed blocks; release
+        with :meth:`unsqueeze_pool` (``stop()``/the failure path
+        release automatically)."""
+        if not self._paged:
+            return 0
+        want = int(self._pool.capacity * float(frac))
+        take = min(want, self._pool.n_free + self._pool.n_cached)
+        if take <= 0:
+            return 0
+        try:
+            self._squeezed.extend(self._pool.alloc(take))
+        except RuntimeError:             # raced a concurrent admission
+            return 0
+        return take
+
+    def unsqueeze_pool(self) -> int:
+        """Release a staged :meth:`squeeze_pool`; returns blocks freed."""
+        n = len(self._squeezed)
+        if n:
+            self._pool.decref(self._squeezed)
+            self._squeezed = []
+        return n
 
     # -- introspection ------------------------------------------------------
     def step_cache_size(self) -> int:
@@ -1741,6 +2353,9 @@ class DecodeEngine:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_steps = 0
+        self.preemptions = 0
+        self.preempted = 0
+        self.deadline_drops = 0
         if self._paged:
             self._evictions_base = self._pool.evictions
         self.t_first = None
@@ -1827,6 +2442,13 @@ class DecodeEngine:
             "flight_records": (self.recorder.total
                                if self.recorder is not None else 0),
             "peak_live_seqs": self.peak_live,
+            # overload-graceful scheduling: preemption EVENTS, distinct
+            # requests preempted at least once, and expired-deadline
+            # queue drops (docs/SERVING.md "Overload and preemption")
+            "preempt": int(self._preempt_on),
+            "preemptions": self.preemptions,
+            "preempted": self.preempted,
+            "deadline_drops": self.deadline_drops,
             "completed": self.completed,
             "shed": self.shed,
             "shed_rate": self.shed / issued if issued else 0.0,
@@ -1856,5 +2478,9 @@ class DecodeEngine:
             self._stop.set()
             self._cv.notify_all()
         self._thread.join(timeout=60)
+        if self._paged:
+            # a staged chaos squeeze must not outlive the engine (the
+            # pool's books would report phantom live blocks forever)
+            self.unsqueeze_pool()
         if self.watchdog is not None:
             self.watchdog.stop()

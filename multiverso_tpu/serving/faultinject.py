@@ -32,6 +32,19 @@ comma-separated, all optional)::
                              by X (a replica that looks dead without
                              being dead — the router must not lose its
                              requests when it flags it)
+    burst=K:N                as the replica dequeues its K-th targeted
+                             request, submit N EXTRA copies of that
+                             prompt straight into its local engine —
+                             a one-replica traffic spike that drives
+                             the priority scheduler and (with a tight
+                             pool) the preemption machinery under
+                             real pressure
+    pool_squeeze=K:F[:R]     at request K, hold fraction F (0..1) of
+                             the replica engine's KV block pool
+                             hostage (``engine.squeeze_pool``) so
+                             live traffic sees a shrunken pool and
+                             growth must preempt; release at request
+                             R (omitted = held until engine stop)
 
 Trainer-side failure points (PR 14 — the durability pipeline's chaos):
 
@@ -109,10 +122,16 @@ class FaultPlan:
         self.wal_fault: str = ""              # "", torn_tail, bad_crc
         self.zombie_at: int = 0               # 0 = never
         self.zombie_epoch: int = 0
+        self.burst_at: int = 0                # 0 = never
+        self.burst_count: int = 0
+        self.squeeze_at: int = 0              # 0 = never
+        self.squeeze_fraction: float = 0.0
+        self.squeeze_release_at: int = 0      # 0 = never released
         self._wal = None                      # attach_wal() target
         self.counts: Dict[str, int] = {
             "kills": 0, "wedges": 0, "wire_delays": 0, "wire_drops": 0,
-            "trainer_kills": 0, "wal_faults": 0, "zombie_publishes": 0}
+            "trainer_kills": 0, "wal_faults": 0, "zombie_publishes": 0,
+            "bursts": 0, "pool_squeezes": 0}
         for directive in filter(None,
                                 (d.strip() for d in self.spec.split(","))):
             key, _, val = directive.partition("=")
@@ -159,6 +178,26 @@ class FaultPlan:
             self.zombie_at, self.zombie_epoch = int(k), int(e or 0)
             if self.zombie_at < 1:
                 raise ValueError("zombie_epoch needs K >= 1 (K:E)")
+        elif key == "burst":
+            k, _, n = val.partition(":")
+            self.burst_at, self.burst_count = int(k), int(n or 0)
+            if self.burst_at < 1 or self.burst_count < 1:
+                raise ValueError("burst needs K >= 1 and N >= 1 (K:N)")
+        elif key == "pool_squeeze":
+            k, _, rest = val.partition(":")
+            f, _, r = rest.partition(":")
+            self.squeeze_at = int(k)
+            self.squeeze_fraction = float(f or 0.0)
+            self.squeeze_release_at = int(r) if r else 0
+            if self.squeeze_at < 1:
+                raise ValueError("pool_squeeze needs K >= 1 (K:F[:R])")
+            if not 0.0 < self.squeeze_fraction <= 1.0:
+                raise ValueError("pool_squeeze fraction F must be in "
+                                 "(0, 1]")
+            if (self.squeeze_release_at
+                    and self.squeeze_release_at <= self.squeeze_at):
+                raise ValueError("pool_squeeze release R must come "
+                                 "after K")
         else:
             raise ValueError(f"unknown failure point {key!r}")
 
@@ -222,6 +261,30 @@ class FaultPlan:
             return self.zombie_epoch
         return epoch
 
+    def burst_n(self, k: int) -> int:
+        """Consulted as the replica dequeues request ``k``: how many
+        EXTRA copies of it to submit to the local engine (0 = none)."""
+        if self.burst_at and k == self.burst_at:
+            self.counts["bursts"] += 1
+            Log.error("chaos: bursting %d extra request(s) at request "
+                      "%d", self.burst_count, k)
+            return self.burst_count
+        return 0
+
+    def squeeze_frac(self, k: int) -> Optional[float]:
+        """Pool fraction to squeeze at request ``k`` (None = none)."""
+        if self.squeeze_at and k == self.squeeze_at:
+            self.counts["pool_squeezes"] += 1
+            Log.error("chaos: squeezing %.0f%% of the KV pool at "
+                      "request %d", self.squeeze_fraction * 100, k)
+            return self.squeeze_fraction
+        return None
+
+    def squeeze_release(self, k: int) -> bool:
+        """True when the staged squeeze releases at request ``k``."""
+        return bool(self.squeeze_release_at
+                    and k == self.squeeze_release_at)
+
     def wire_delay_s(self) -> float:
         """Consulted before each outbound wire record: seconds to stall
         the send (0.0 = send now)."""
@@ -241,7 +304,8 @@ class FaultPlan:
         return bool(self.kill_at or self.wedge_at or self.delay_s
                     or self.drop_p or self.heartbeat_scale != 1.0
                     or self.kill_trainer_at or self.wal_fault
-                    or self.zombie_at)
+                    or self.zombie_at or self.burst_at
+                    or self.squeeze_at)
 
     def stats(self) -> Dict[str, Any]:
         return {"spec": self.spec, "seed": self.seed, **self.counts}

@@ -472,12 +472,14 @@ class ObsCollector:
         if replica_rows:
             lines.append(
                 f"{'replica':>12} {'state':<11} {'inflight':>9} "
-                f"{'hb_age_ms':>10} {'snap_v':>7} {'node':>5}")
+                f"{'hb_age_ms':>10} {'snap_v':>7} {'preempts':>9} "
+                f"{'node':>5}")
             for row in replica_rows:
                 lines.append(
                     f"{row['replica']:>12} {row['state']:<11} "
                     f"{row['inflight']:>9} {row['hb_age_ms']:>10.1f} "
-                    f"{row['snapshot_version']:>7} {row['node']:>5}")
+                    f"{row['snapshot_version']:>7} "
+                    f"{row['preemptions']:>9} {row['node']:>5}")
         for name, h in sorted(fl["histograms"].items()):
             lines.append(
                 f"fleet {name}: p50 {h['p50_ms']:.3f} / p95 "
@@ -518,14 +520,17 @@ class ObsCollector:
                                         {}).get("value", 0))
                 hb_age = float(rows.get(f"FLEET_HB_AGE_MS[{key}]",
                                         {}).get("value", 0.0))
-                # snapshot_version shipped since PR 14; older archives
-                # lack the gauge and render -1 (the PR 8/11 tolerance
-                # pattern)
+                # snapshot_version shipped since PR 14, preempts since
+                # PR 15; older archives lack the gauges and render -1
+                # (the PR 8/11 tolerance pattern)
                 snap_v = int(rows.get(f"FLEET_SNAPSHOT_VERSION[{key}]",
                                       {}).get("value", -1))
+                preempts = int(rows.get(f"FLEET_PREEMPTS[{key}]",
+                                        {}).get("value", -1))
                 out.append({"replica": key, "state": state,
                             "inflight": inflight, "hb_age_ms": hb_age,
-                            "snapshot_version": snap_v, "node": node})
+                            "snapshot_version": snap_v,
+                            "preemptions": preempts, "node": node})
         return out
 
     def stats(self) -> Dict[str, Any]:

@@ -100,6 +100,16 @@ class ReplicaServer:
             "", kill_fn=kill_fn)
         if kill_fn is not None and chaos is not None:
             self.chaos._kill_fn = kill_fn
+        # engine capability probe: the priority/deadline keywords only
+        # ride when the engine's submit takes them (the router unit
+        # tests' deterministic fakes keep the classic 3-arg surface)
+        try:
+            import inspect
+
+            params = inspect.signature(engine.submit).parameters
+            self._engine_prio = "priority" in params
+        except (TypeError, ValueError):   # builtins/partials: assume new
+            self._engine_prio = True
         # publish seq resumes from the router's ack so the router's
         # in-order consumer sees ONE contiguous stream across replica
         # incarnations; subscription resumes from the router's stream
@@ -251,14 +261,42 @@ class ReplicaServer:
         sp = trace.start_span("replica.exec", parent=parent,
                               replica=self.rank, rid=rid)
         prompt = np.asarray(msg["prompt"], np.int32)
+        # the router ships the REMAINING deadline budget (clocks are
+        # per-process): re-anchor it on our monotonic clock so the
+        # engine's pop-time check measures the same instant
+        deadline_ms = msg.get("deadline_ms")
+        deadline_s = (None if not deadline_ms
+                      else float(deadline_ms) / 1e3)
+        # chaos traffic faults staged at dequeue: a burst submits N
+        # extra copies of this prompt straight into the local engine
+        # (a one-replica traffic spike), a pool squeeze holds part of
+        # the engine's KV pool hostage so preemption runs under real
+        # pressure
+        for _ in range(self.chaos.burst_n(self.requests_seen)):
+            try:
+                self.engine.submit(prompt, msg.get("max_new"))
+            except Exception:            # sheds are part of the chaos
+                pass
+        squeeze = self.chaos.squeeze_frac(self.requests_seen)
+        if squeeze is not None and hasattr(self.engine, "squeeze_pool"):
+            self.engine.squeeze_pool(squeeze)
+        if (self.chaos.squeeze_release(self.requests_seen)
+                and hasattr(self.engine, "unsqueeze_pool")):
+            self.engine.unsqueeze_pool()
+        kw = {}
+        if self._engine_prio:
+            kw = {"priority": msg.get("prio"), "deadline_s": deadline_s}
         try:
             fut = self.engine.submit(prompt, msg.get("max_new"),
-                                     ctx=sp.context if parent else None)
+                                     ctx=sp.context if parent else None,
+                                     **kw)
         except OverloadedError as exc:
             sp.end(error="OverloadedError")
             self.failed += 1
             self._publish({"t": MSG_ERR, "node": self.rank, "rid": rid,
                            "kind": "overloaded", "what": exc.what,
+                           "depth": exc.depth, "cap": exc.cap,
+                           "retriable": exc.retriable,
                            "msg": str(exc)})
             return
         except Exception as exc:
@@ -282,11 +320,14 @@ class ReplicaServer:
         if exc is not None:
             sp.end(error=type(exc).__name__)
             self.failed += 1
-            kind = ("overloaded" if isinstance(exc, OverloadedError)
-                    else "error")
-            self._publish({"t": MSG_ERR, "node": self.rank, "rid": rid,
-                           "kind": kind, "what": type(exc).__name__,
-                           "msg": str(exc)})
+            err = {"t": MSG_ERR, "node": self.rank, "rid": rid,
+                   "kind": "error", "what": type(exc).__name__,
+                   "msg": str(exc)}
+            if isinstance(exc, OverloadedError):
+                err.update(kind="overloaded", what=exc.what,
+                           depth=exc.depth, cap=exc.cap,
+                           retriable=exc.retriable)
+            self._publish(err)
             return
         reply = fut.result()
         sp.end(ok=True)

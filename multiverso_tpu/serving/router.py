@@ -23,11 +23,23 @@ accepted request alive across replica failures:
 * **readmission is half-open**: a DEAD replica that heartbeats again
   (restarted process, healed partition) is PROBED — one ``ping`` must
   round-trip on the wire before any real request is dispatched to it.
-* **overload degrades loudly**: past ``-fleet_shed_depth`` aggregate
-  queue depth (pending + retry + in-flight) ``submit`` raises
-  :class:`~.batcher.OverloadedError` ``(what="fleet")`` instead of
-  queueing unboundedly; with N-1 replicas up the fleet keeps serving at
-  reduced capacity rather than failing.
+* **overload degrades loudly — and BY CLASS**: past
+  ``-fleet_shed_depth`` aggregate queue depth (pending + retry +
+  in-flight) ``submit`` sheds with :class:`~.batcher.OverloadedError`
+  ``(what="fleet", retriable=True)`` instead of queueing unboundedly —
+  but it sheds the LOWEST class first: an arriving request of a higher
+  priority class evicts the newest queued request of the lowest
+  pending class rather than being rejected itself, so paying tenants
+  keep flowing while batch traffic absorbs the burst
+  (``SHED_BY_CLASS[name.pN]`` counters say who paid). Dispatch pops
+  the highest class first (FIFO within a class), requests carry
+  ``priority``/``deadline_s`` onto the wire and into the replica
+  engines' weighted-fair schedulers, a retry whose backoff would land
+  past its deadline fails fast with
+  :class:`~.batcher.DeadlineExceededError` instead of burning the
+  wait, and a replica's ``retriable=False`` shed (a request bigger
+  than its whole KV pool) fails immediately instead of burning the
+  retry budget on an impossibility.
 
 Observability: ``FLEET_DISPATCH``/``FLEET_RETRIES``/``FLEET_REDISPATCH``
 /``FLEET_SHED`` counters, per-replica ``FLEET_REPLICA_STATE``/
@@ -56,7 +68,7 @@ from .. import config, trace
 from ..dashboard import Dashboard
 from ..log import Log
 from ..parallel.p2p import reconnect_backoff_s
-from .batcher import OverloadedError
+from .batcher import DeadlineExceededError, OverloadedError
 from .replica import (LABEL, MSG_ERR, MSG_HB, MSG_PING, MSG_PONG, MSG_REQ,
                       MSG_RSP, ROUTER_RANK, decode_msg, encode_msg)
 
@@ -66,9 +78,9 @@ DEAD, CONNECTING, PROBING, UP = 0, 1, 2, 3
 STATE_NAMES = {DEAD: "DEAD", CONNECTING: "CONNECTING",
                PROBING: "PROBING", UP: "UP"}
 
-
-class DeadlineExceededError(RuntimeError):
-    """The request's deadline passed before a replica completed it."""
+# NB DeadlineExceededError lives in .batcher now (both serving tiers
+# raise it); the import above keeps `from .router import
+# DeadlineExceededError` working.
 
 
 class FleetError(RuntimeError):
@@ -126,15 +138,17 @@ class FleetConfig:
 class _FleetRequest:
     __slots__ = ("rid", "prompt", "max_new", "session", "deadline",
                  "attempts", "future", "replica", "t_enq", "root",
-                 "dispatch_span", "redispatched", "exclude")
+                 "dispatch_span", "redispatched", "exclude", "priority")
 
     def __init__(self, prompt: np.ndarray, max_new: Optional[int],
-                 session: Optional[str], deadline: float, root) -> None:
+                 session: Optional[str], deadline: float, root,
+                 priority: int = 1) -> None:
         self.rid = uuid.uuid4().hex[:16]
         self.prompt = np.asarray(prompt, np.int32).ravel()
         self.max_new = max_new
         self.session = session
         self.deadline = deadline
+        self.priority = int(priority)
         self.attempts = 0
         self.future: Future = Future()
         self.replica: Optional[int] = None
@@ -145,11 +159,86 @@ class _FleetRequest:
         self.exclude: Optional[int] = None   # rank that just failed it
 
 
+class _ClassQueue:
+    """The router's pending lanes: one FIFO deque per priority class.
+
+    Dispatch is strict-priority (highest class first, FIFO within —
+    fairness between tenants lives in the replica engines' weighted-
+    fair schedulers; the router's job is just to not let low-class
+    work block high-class work at the front door), and overload shed
+    evicts from the LOWEST class, newest first (the request that
+    waited least loses). Callers hold the router lock."""
+
+    def __init__(self) -> None:
+        self._lanes: Dict[int, collections.deque] = {}
+        self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def append(self, req: _FleetRequest) -> None:
+        self._lanes.setdefault(req.priority,
+                               collections.deque()).append(req)
+        self._n += 1
+
+    def appendleft(self, req: _FleetRequest) -> None:
+        """Retries re-enter at the FRONT of their class (they are the
+        oldest work that class has)."""
+        self._lanes.setdefault(req.priority,
+                               collections.deque()).appendleft(req)
+        self._n += 1
+
+    def peek(self) -> Optional[_FleetRequest]:
+        for p in sorted(self._lanes, reverse=True):
+            if self._lanes[p]:
+                return self._lanes[p][0]
+        return None
+
+    def popleft(self) -> Optional[_FleetRequest]:
+        for p in sorted(self._lanes, reverse=True):
+            if self._lanes[p]:
+                self._n -= 1
+                return self._lanes[p].popleft()
+        return None
+
+    def shed_lowest_below(self, priority: int) -> Optional[_FleetRequest]:
+        """Evict the NEWEST queued request of the lowest non-empty
+        class strictly below ``priority`` (None = nothing lower is
+        queued — the arrival itself sheds)."""
+        for p in sorted(self._lanes):
+            if p >= priority:
+                break
+            if self._lanes[p]:
+                self._n -= 1
+                return self._lanes[p].pop()
+        return None
+
+    def expire(self, now: float) -> List[_FleetRequest]:
+        """Remove and return every queued request past its deadline."""
+        out: List[_FleetRequest] = []
+        for lane in self._lanes.values():
+            if any(r.deadline <= now for r in lane):
+                keep = [r for r in lane if r.deadline > now]
+                out.extend(r for r in lane if r.deadline <= now)
+                lane.clear()
+                lane.extend(keep)
+        self._n -= len(out)
+        return out
+
+    def drain(self) -> List[_FleetRequest]:
+        out: List[_FleetRequest] = []
+        for lane in self._lanes.values():
+            out.extend(lane)
+            lane.clear()
+        self._n = 0
+        return out
+
+
 class _Replica:
     __slots__ = ("rank", "state", "last_hb", "health", "inflight",
                  "wire_dead", "probe_rid", "deaths", "readmissions",
                  "state_gauge", "inflight_gauge", "hb_age_gauge",
-                 "snap_gauge")
+                 "snap_gauge", "preempt_gauge")
 
     def __init__(self, rank: int, router_name: str) -> None:
         self.rank = rank
@@ -172,6 +261,11 @@ class _Replica:
         # visible at a glance in the opscenter replica rows
         self.snap_gauge = Dashboard.get_or_create_gauge(
             f"FLEET_SNAPSHOT_VERSION[{router_name}.{rank}]")
+        # the replica engine's cumulative preemption count (from its
+        # heartbeat health): overload churn per replica at a glance in
+        # the opscenter replica rows
+        self.preempt_gauge = Dashboard.get_or_create_gauge(
+            f"FLEET_PREEMPTS[{router_name}.{rank}]")
         self.state_gauge.set(CONNECTING)
 
 
@@ -193,7 +287,9 @@ class FleetRouter:
         self._lock = lockwatch.lock("serving.FleetRouter._lock")
         self._replicas: Dict[int, _Replica] = {
             r: _Replica(r, name) for r in range(1, size)}
-        self._pending: collections.deque = collections.deque()
+        self._pending = _ClassQueue()
+        self.shed_by_class: Dict[int, int] = {}
+        self._shed_class_counters: Dict[int, Any] = {}
         self._retry: List[Tuple[float, _FleetRequest]] = []
         self._inflight: Dict[str, _FleetRequest] = {}
         self._affinity: Dict[str, int] = {}
@@ -247,20 +343,46 @@ class FleetRouter:
                  self.config.shed_depth)
 
     # -- submit path ---------------------------------------------------------
+    def _count_shed(self, priority: int) -> None:
+        self.shed += 1
+        self.shed_by_class[priority] = \
+            self.shed_by_class.get(priority, 0) + 1
+        counter = self._shed_class_counters.get(priority)
+        if counter is None:
+            counter = Dashboard.get_or_create_counter(
+                f"SHED_BY_CLASS[{self.name}.p{priority}]")
+            self._shed_class_counters[priority] = counter
+        counter.inc()
+
     def submit(self, prompt: np.ndarray, max_new: Optional[int] = None,
                session: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               priority: Optional[int] = None) -> Future:
         """Enqueue one prompt for the fleet; resolves to the reply dict
         ``{"result", "snapshot_version", "staleness_s", "replica"}``.
         ``session`` keys affinity (multi-turn conversations hit the
         same replica's prefix cache while it stays UP); ``deadline_s``
-        overrides ``-fleet_deadline_s``. Sheds ``OverloadedError(
-        what="fleet")`` past the aggregate queue cap."""
+        overrides ``-fleet_deadline_s``; ``priority`` is the tenant
+        class (0..7, higher = more important; None = class 1), carried
+        over the wire into the replica engines' weighted-fair
+        schedulers. Past the aggregate queue cap the fleet sheds BY
+        CLASS, lowest first: a higher-class arrival evicts the newest
+        queued lowest-class request (that one's future fails with the
+        ``OverloadedError``) instead of being rejected itself; only
+        when nothing lower is queued does the arrival shed
+        (``retriable=True`` either way — fleet overload is
+        transient)."""
         root = trace.start_span("serve.request", root=True,
                                 model=self.name, fleet=True)
         deadline = time.monotonic() + float(
             self.config.deadline_s if deadline_s is None else deadline_s)
-        req = _FleetRequest(prompt, max_new, session, deadline, root)
+        prio = 1 if priority is None else int(priority)
+        if not 0 <= prio <= 7:
+            root.end(error="ValueError")
+            raise ValueError(f"priority {prio} outside [0, 7]")
+        req = _FleetRequest(prompt, max_new, session, deadline, root,
+                            priority=prio)
+        victim: Optional[_FleetRequest] = None
         with self._lock:
             stopped = self._stop.is_set()
             depth = -1
@@ -268,7 +390,17 @@ class FleetRouter:
                 depth = (len(self._pending) + len(self._retry)
                          + len(self._inflight))
                 if depth >= self.config.shed_depth:
-                    self.shed += 1
+                    # shed by class: the lowest queued class below the
+                    # arrival pays; the arrival itself only sheds when
+                    # nothing lower is pending
+                    victim = self._pending.shed_lowest_below(prio)
+                    if victim is not None:
+                        self._count_shed(victim.priority)
+                        self.submitted += 1
+                        self._pending.append(req)
+                        depth = -1
+                    else:
+                        self._count_shed(prio)
                 else:
                     self.submitted += 1
                     self._pending.append(req)
@@ -278,6 +410,16 @@ class FleetRouter:
             # must never leave an open span in the collector
             root.end(error="stopped")
             raise RuntimeError(f"fleet router {self.name!r} is stopped")
+        if victim is not None:
+            # the evicted request resolves OUTSIDE the lock (its
+            # done-callbacks are user code) — submitted stays counted,
+            # failed balances the requests_lost identity
+            with self._lock:
+                self.failed += 1
+            self._shed_counter.inc()
+            self._apply_resolutions([(victim, OverloadedError(
+                self.name, self.config.shed_depth,
+                self.config.shed_depth, what="fleet"))])
         if depth >= 0:
             self._shed_counter.inc()
             root.end(error="OverloadedError")
@@ -290,9 +432,10 @@ class FleetRouter:
 
     def predict(self, prompt: np.ndarray, max_new: Optional[int] = None,
                 session: Optional[str] = None,
-                timeout_s: float = 60.0) -> dict:
-        return self.submit(prompt, max_new, session).result(
-            timeout=timeout_s)
+                timeout_s: float = 60.0,
+                priority: Optional[int] = None) -> dict:
+        return self.submit(prompt, max_new, session,
+                           priority=priority).result(timeout=timeout_s)
 
     # -- wire death hook -----------------------------------------------------
     def _on_wire_dead(self, ranks) -> None:
@@ -343,6 +486,8 @@ class FleetRouter:
                     rep.hb_age_gauge.set((now - rep.last_hb) * 1e3)
                     rep.snap_gauge.set(float(
                         (rep.health or {}).get("snapshot_version", -1)))
+                    rep.preempt_gauge.set(float(
+                        (rep.health or {}).get("preemptions", 0)))
         self._apply_resolutions(resolutions)
         for msg in sends:
             self._publish(msg)
@@ -410,9 +555,32 @@ class FleetRouter:
             holder.inflight.discard(rid)
         del self._inflight[rid]
         if kind == MSG_ERR:
-            if msg.get("kind") == "overloaded":
+            if (msg.get("kind") == "overloaded"
+                    and msg.get("retriable", True)):
                 self._requeue_locked(req, f"replica {node} shed",
                                      resolutions)
+            elif msg.get("kind") == "overloaded":
+                # a PERMANENT shed (request bigger than the replica's
+                # whole KV pool): retrying cannot change the verdict —
+                # fail now instead of burning the retry budget on an
+                # impossibility (the retriable hint, not string-
+                # matching `what`)
+                self.failed += 1
+                self._finish_done_locked(rid, None)
+                resolutions.append((req, OverloadedError(
+                    self.name, int(msg.get("depth", -1)),
+                    int(msg.get("cap", -1)),
+                    what=msg.get("what", "replica"), retriable=False)))
+            elif msg.get("what") == "DeadlineExceededError":
+                # the replica engine dropped it at queue-pop time: the
+                # caller sees the same typed error the router's own
+                # deadline sweep raises
+                self.deadline_failures += 1
+                self.failed += 1
+                self._finish_done_locked(rid, None)
+                resolutions.append((req, DeadlineExceededError(
+                    f"fleet request {rid} missed its deadline on "
+                    f"replica {node}: {msg.get('msg')}")))
             else:
                 self.failed += 1
                 self._finish_done_locked(rid, None)
@@ -484,12 +652,25 @@ class FleetRouter:
                 f"fleet request {req.rid} exhausted "
                 f"{self.config.retry_max} re-dispatch attempt(s): {why}")))
             return
-        self._retries_counter.inc()
         delay = retry_backoff_s(req.attempts,
                                 self.config.backoff_ms / 1000.0,
                                 self.config.backoff_cap_ms / 1000.0,
                                 self._rng)
-        self._retry.append((time.monotonic() + delay, req))
+        now = time.monotonic()
+        if now + delay >= req.deadline:
+            # the retry queue respects deadlines: a backoff that lands
+            # past the deadline is a wait for an answer nobody will
+            # read — fail fast instead of burning it
+            self.deadline_failures += 1
+            self.failed += 1
+            self._finish_done_locked(req.rid, None)
+            resolutions.append((req, DeadlineExceededError(
+                f"fleet request {req.rid} cannot retry within its "
+                f"deadline (backoff {delay:.3f}s, "
+                f"{max(0.0, req.deadline - now):.3f}s left): {why}")))
+            return
+        self._retries_counter.inc()
+        self._retry.append((now + delay, req))
 
     def _check_liveness_locked(self, now: float, resolutions,
                                sends) -> None:
@@ -524,8 +705,10 @@ class FleetRouter:
         due = [req for t, req in self._retry if t <= now]
         if due:
             self._retry = [(t, req) for t, req in self._retry if t > now]
-            # retries go to the FRONT: they are the oldest requests
-            self._pending.extendleft(reversed(due))
+            # retries go to the FRONT of their class: they are the
+            # oldest requests that class has
+            for req in reversed(due):
+                self._pending.appendleft(req)
 
     def _check_deadlines_locked(self, now: float, resolutions) -> None:
         def expire(req: _FleetRequest) -> None:
@@ -540,10 +723,7 @@ class FleetRouter:
                 f"fleet request {req.rid} missed its deadline "
                 f"({(now - req.t_enq):.3f}s since submit)")))
 
-        expired = [r for r in self._pending if r.deadline <= now]
-        if expired:
-            self._pending = collections.deque(
-                r for r in self._pending if r.deadline > now)
+        expired = self._pending.expire(now)
         for t, req in list(self._retry):
             if req.deadline <= now:
                 expired.append(req)
@@ -582,7 +762,7 @@ class FleetRouter:
 
     def _dispatch_locked(self, now: float, sends) -> None:
         while self._pending:
-            req = self._pending[0]
+            req = self._pending.peek()
             rep = self._pick_locked(req)
             if rep is None:
                 return                   # nobody UP: requests wait
@@ -606,7 +786,13 @@ class FleetRouter:
             sends.append({
                 "t": MSG_REQ, "target": rep.rank, "rid": req.rid,
                 "session": req.session, "prompt": req.prompt.tolist(),
-                "max_new": req.max_new, "trace": wire_ctx})
+                "max_new": req.max_new, "trace": wire_ctx,
+                # priority + REMAINING deadline budget ride the wire
+                # (remaining, not absolute: the replica's monotonic
+                # clock is not ours) so the replica engine's scheduler
+                # sees the same class and the same urgency
+                "prio": req.priority,
+                "deadline_ms": max(0.0, (req.deadline - now) * 1e3)})
 
     # -- outbound ------------------------------------------------------------
     def _publish(self, msg: Dict[str, Any]) -> None:
@@ -713,6 +899,7 @@ class FleetRouter:
                     "snapshot_version", -1),
                 "params_stale": bool((rep.health or {}).get(
                     "params_stale", False)),
+                "preemptions": (rep.health or {}).get("preemptions", -1),
             } for rep in sorted(self._replicas.values(),
                                 key=lambda x: x.rank)]
 
@@ -734,6 +921,8 @@ class FleetRouter:
                 "completed": self.completed,
                 "failed": self.failed,
                 "shed": self.shed,
+                "shed_by_class": {f"p{p}": n for p, n in
+                                  sorted(self.shed_by_class.items())},
                 "deadline_failures": self.deadline_failures,
                 "pending": pending,
                 "retrying": retrying,
@@ -767,10 +956,9 @@ class FleetRouter:
         self._thread.join(timeout=10)
         resolutions: List[Tuple[_FleetRequest, Any]] = []
         with self._lock:
-            leftovers = (list(self._pending)
+            leftovers = (self._pending.drain()
                          + [r for _, r in self._retry]
                          + list(self._inflight.values()))
-            self._pending.clear()
             self._retry = []
             self._inflight.clear()
         for req in leftovers:

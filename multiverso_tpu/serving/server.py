@@ -39,12 +39,18 @@ class _DecoderEntry:
     def submit(self, payload: Any,
                ctx: Optional[trace.SpanContext] = None) -> Future:
         """Payload: a 1-D prompt id array, or a dict with ``prompt`` and
-        optional per-request ``max_new``."""
+        optional per-request ``max_new``, ``priority`` (tenant class,
+        0..7, higher = more important) and ``deadline_s`` (seconds from
+        now past which the reply is worthless — expired requests drop
+        at queue-pop time with ``DeadlineExceededError``, before any
+        prefill runs)."""
         if isinstance(payload, dict):
             if "prompt" not in payload:
                 raise ValueError("decoder payload dict needs a 'prompt' key")
             return self.engine.submit(payload["prompt"],
-                                      payload.get("max_new"), ctx=ctx)
+                                      payload.get("max_new"), ctx=ctx,
+                                      priority=payload.get("priority"),
+                                      deadline_s=payload.get("deadline_s"))
         return self.engine.submit(payload, ctx=ctx)
 
 
@@ -120,6 +126,9 @@ class InferenceServer:
                          decode_tp: Optional[int] = None,
                          prefix_cache: Optional[bool] = None,
                          spec_k: Optional[int] = None,
+                         preempt: Optional[bool] = None,
+                         preempt_budget: Optional[int] = None,
+                         sched_lookahead: Optional[int] = None,
                          watchdog: Optional[bool] = None,
                          debug_dump_dir: Optional[str] = None,
                          slo_ttft_ms: Optional[float] = None,
@@ -159,7 +168,15 @@ class InferenceServer:
         by one fused fixed-K step per iteration — up to ``spec_k + 1``
         tokens per iteration, outputs token-identical to plain greedy
         decode (docs/SERVING.md "Speculative decoding"; needs the
-        paged KV cache).
+        paged KV cache). ``preempt`` (None = the ``-preempt`` flag,
+        default on; paged + chunked only) switches paged admission to
+        OPTIMISTIC prompt-only reservation with grow-at-decode and
+        preemption-with-recompute under pool pressure —
+        ``preempt_budget`` bounds how often one request may be
+        preempted and ``sched_lookahead`` bounds admission lookahead
+        past a block-starved queue head (docs/SERVING.md "Overload
+        and preemption"; ``preempt=False`` restores the worst-case
+        ``prompt + max_new`` up-front reservation).
 
         The black-box layer rides along by default: an always-on
         flight recorder (``engine.recorder``) and a stall/leak/queue-age
@@ -176,7 +193,9 @@ class InferenceServer:
             prefill_token_budget=prefill_token_budget,
             kv_block_size=kv_block_size, kv_pool_blocks=kv_pool_blocks,
             decode_tp=decode_tp, prefix_cache=prefix_cache,
-            spec_k=spec_k, watchdog=watchdog, debug_dump_dir=debug_dump_dir,
+            spec_k=spec_k, preempt=preempt, preempt_budget=preempt_budget,
+            sched_lookahead=sched_lookahead,
+            watchdog=watchdog, debug_dump_dir=debug_dump_dir,
             slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
         with self._lock:
             if self._stopped:

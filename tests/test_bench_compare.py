@@ -73,6 +73,15 @@ def test_metric_direction_rules():
     assert metric_direction("trainer_recovery_time_s") == -1
     assert metric_direction("wal_replay_records_info") == 0
     assert metric_direction("staleness_peak_s_info") == 0
+    # overload-graceful serving (lm_overload A/B): bit-identical
+    # preempted outputs and zero starvation are zero-baseline hard
+    # gates, deadline drops regress UP; preemption churn and the
+    # per-class latencies archive as _info
+    assert metric_direction("preempt_output_mismatches") == -1
+    assert metric_direction("starved_requests") == -1
+    assert metric_direction("deadline_drops") == -1
+    assert metric_direction("preemptions_info") == 0
+    assert metric_direction("lat_p99_class0_ms_info") == 0
     assert metric_direction("completed") == 0       # informational
     assert metric_direction("jit_traces") == 0
     assert metric_direction("step_traces") == 0
@@ -99,6 +108,33 @@ def test_updates_lost_zero_baseline_gate():
     assert {r["metric"] for r in regs} == {
         "lm_trainer_chaos.updates_lost",
         "lm_trainer_chaos.epoch_fence_rejections_unexpected"}
+
+
+def test_preempt_invariants_zero_baseline_gate():
+    """preempt_output_mismatches / starved_requests / deadline_drops
+    0 -> nonzero must regress though the baseline is 0 (the zero-
+    baseline rule): a preempted generation diverging from its oracle,
+    a starved request, or a blown deadline on the met-by-design trace
+    is an invariant break, not noise — while the churn counters and
+    per-class p99s ride as _info."""
+    clean = {"preempt_output_mismatches": 0.0,
+             "preempt": {"starved_requests": 0.0, "deadline_drops": 0.0,
+                         "capacity_seqs": 11.0, "preemptions_info": 9.0,
+                         "lat_p99_class2_ms_info": 40.0}}
+    base = _line(lm_overload=clean)
+    good = _line(lm_overload=json.loads(json.dumps(clean)))
+    regs, _ = compare(base, good)
+    assert regs == []
+    bad = _line(lm_overload={
+        "preempt_output_mismatches": 1.0,
+        "preempt": {"starved_requests": 2.0, "deadline_drops": 3.0,
+                    "capacity_seqs": 11.0, "preemptions_info": 900.0,
+                    "lat_p99_class2_ms_info": 4000.0}})
+    regs, _ = compare(base, bad)
+    assert {r["metric"] for r in regs} == {
+        "lm_overload.preempt_output_mismatches",
+        "lm_overload.preempt.starved_requests",
+        "lm_overload.preempt.deadline_drops"}
 
 
 def test_watchdog_trips_hard_gate():

@@ -433,10 +433,13 @@ def test_paged_out_of_blocks_sheds_and_never_deadlocks(mv_session):
     # pool of 2 usable blocks x 4 positions: an 8-position reservation
     # (plen 2 + max_new 4 -> 2 blocks) takes the WHOLE pool even though
     # 2 slots are free; a 12-position one (plen 4 + max_new 8 -> 3
-    # blocks) can never fit
+    # blocks) can never fit. preempt=False: this test pins the
+    # WORST-CASE-reservation baseline contract (optimistic admission
+    # would legitimately run both prompts concurrently and grow;
+    # tests/test_overload.py covers that side)
     engine = srv.register_decoder("lm", lm, slots=2, max_prompt=4,
                                   max_new=8, kv_block_size=4,
-                                  kv_pool_blocks=2)
+                                  kv_pool_blocks=2, preempt=False)
     engine.warmup()
     params, _ = lm.snapshot_params()
 

@@ -311,6 +311,123 @@ def test_replica_overload_is_retried_elsewhere():
         _stop_fleet(router, replicas)
 
 
+def test_shed_by_class_evicts_lowest_pending():
+    """Past the aggregate cap the fleet sheds the LOWEST class first:
+    a class-2 arrival evicts the newest queued class-0 request (whose
+    future gets the retriable OverloadedError) instead of being
+    rejected itself; only when nothing lower is queued does the
+    arrival shed."""
+    from multiverso_tpu.serving import (FleetConfig, FleetRouter,
+                                        OverloadedError)
+
+    kv = _KV()
+    # no replicas ever come up: everything accepted stays PENDING,
+    # which is exactly the state class-shedding arbitrates
+    router = FleetRouter(3, kv, label="shedcls", name="shedcls",
+                         fleet_config=FleetConfig(heartbeat_ms=50,
+                                                  shed_depth=3,
+                                                  deadline_s=60.0))
+    try:
+        lows = [router.submit(np.arange(1, 3, dtype=np.int32), 2,
+                              priority=0) for _ in range(3)]
+        hi = router.submit(np.arange(1, 3, dtype=np.int32), 2,
+                           priority=2)
+        with pytest.raises(OverloadedError) as exc:
+            lows[-1].result(timeout=10)     # the NEWEST class-0 paid
+        assert exc.value.retriable is True
+        assert exc.value.what == "fleet"
+        assert not hi.done()                # the class-2 arrival queued
+        s = router.stats()
+        assert s["shed_by_class"] == {"p0": 1}
+        assert s["requests_lost"] == 0
+        with pytest.raises(OverloadedError):
+            router.submit(np.arange(1, 3, dtype=np.int32), 2,
+                          priority=0)       # nothing lower: self-shed
+        assert router.stats()["shed_by_class"] == {"p0": 2}
+        for f in lows[:2] + [hi]:
+            f.cancel()
+    finally:
+        router.stop()
+
+
+def test_retry_backoff_past_deadline_fails_fast():
+    """The retry queue respects deadlines: a backoff that would land
+    past the request's deadline fails NOW with DeadlineExceededError
+    instead of burning the wait on an answer nobody will read."""
+    from multiverso_tpu.serving import DeadlineExceededError, OverloadedError
+
+    engines = [_FakeEngine(fail_with=OverloadedError("e", 9, 8))]
+    kv, router, replicas, _ = _mk_fleet(
+        "dlretry", n_replicas=1, engines=engines,
+        backoff_ms=1000.0, backoff_cap_ms=1000.0, deadline_s=0.3)
+    try:
+        fut = router.submit(np.arange(1, 3, dtype=np.int32), 2)
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=10)
+        s = router.stats()
+        assert s["deadline_failures"] == 1
+        assert s["requests_lost"] == 0
+    finally:
+        _stop_fleet(router, replicas)
+
+
+def test_nonretriable_shed_fails_without_burning_retries():
+    """A replica's retriable=False shed (request bigger than its whole
+    KV pool) fails the request immediately — exactly ONE dispatch, no
+    retry storm against an impossibility."""
+    from multiverso_tpu.serving import OverloadedError
+
+    engines = [_FakeEngine(fail_with=OverloadedError(
+        "e", 9, 2, what="kv block pool", retriable=False)),
+        _FakeEngine(fail_with=OverloadedError(
+            "e", 9, 2, what="kv block pool", retriable=False))]
+    kv, router, replicas, _ = _mk_fleet("permshed", n_replicas=2,
+                                        engines=engines)
+    try:
+        fut = router.submit(np.arange(1, 3, dtype=np.int32), 2)
+        with pytest.raises(OverloadedError) as exc:
+            fut.result(timeout=10)
+        assert exc.value.retriable is False
+        assert engines[0].submits + engines[1].submits == 1
+        assert router.stats()["requests_lost"] == 0
+    finally:
+        _stop_fleet(router, replicas)
+
+
+class _PrioRecordingEngine(_FakeEngine):
+    """Fake engine with the PRIORITY-aware submit surface: records the
+    (priority, deadline_s) the replica handed it."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.seen = []
+
+    def submit(self, prompt, max_new=None, ctx=None, priority=None,
+               deadline_s=None):
+        self.seen.append((priority, deadline_s))
+        return super().submit(prompt, max_new, ctx)
+
+
+def test_priority_and_deadline_ride_the_wire():
+    """submit(priority=) crosses the mvserve wire and lands in the
+    replica engine's submit as the same class, with the REMAINING
+    deadline budget re-anchored on the replica's clock."""
+    engines = [_PrioRecordingEngine()]
+    kv, router, replicas, _ = _mk_fleet("priowire", n_replicas=1,
+                                        engines=engines,
+                                        deadline_s=30.0)
+    try:
+        reply = router.predict(np.arange(1, 3, dtype=np.int32), 2,
+                               priority=3)
+        assert reply["replica"] == 1
+        assert len(engines[0].seen) == 1
+        prio, deadline_s = engines[0].seen[0]
+        assert prio == 3
+        assert deadline_s is not None and 0 < deadline_s <= 30.0
+    finally:
+        _stop_fleet(router, replicas)
+
+
 # -- death, redispatch, readmission -------------------------------------------
 
 def test_dead_replica_flagged_drained_and_survivors_serve():

@@ -379,6 +379,34 @@ def test_prefix_cache_ab_capacity_and_saved_tokens(mv_session):
 
 
 @pytest.mark.slow
+def test_overload_ab_preemption_face(mv_session):
+    """The serving_bench overload A/B: at 2x pool pressure the
+    priority+preemption leg must pack strictly more concurrent
+    sequences than FIFO+worst-case-reserve, actually preempt, keep
+    every output bit-identical to the FIFO leg's (zero
+    preempt_output_mismatches), starve nobody, drop no met-by-design
+    deadlines, and hold the one-trace invariant on both legs."""
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import InferenceServer
+    from tools.serving_bench import _overload_ab
+
+    srv = InferenceServer("t")
+    cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                            n_layers=2, d_ff=256, max_seq=64)
+    row = _overload_ab(srv, TransformerLM(cfg), quick=True)
+    pre, fifo = row["preempt"], row["fifo"]
+    assert pre["capacity_seqs"] > fifo["capacity_seqs"]
+    assert pre["preemptions_info"] > 0
+    assert fifo["preemptions_info"] == 0
+    assert row["preempt_output_mismatches"] == 0
+    assert pre["starved_requests"] == fifo["starved_requests"] == 0
+    assert pre["deadline_drops"] == fifo["deadline_drops"] == 0
+    assert pre["step_traces"] == fifo["step_traces"] == 1
+    assert pre["prefill_traces"] == fifo["prefill_traces"] == 1
+
+
+@pytest.mark.slow
 def test_observability_ab_black_box_clean(mv_session):
     """The serving_bench observability A/B: tracing-off vs tail-sampled
     tracing on the same engine — the black box (flight recorder +

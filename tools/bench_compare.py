@@ -83,6 +83,16 @@ from typing import Dict, List, Tuple
 # (kill -> fleet re-converged on the restarted incarnation) rides the
 # recovery_time_s suffix rule; wal_replay_records archives as _info
 # (it measures the checkpoint cadence, not the code).
+# preempt_output_mismatches / starved_requests are the overload-
+# graceful invariants (lm_overload A/B): a preempted-and-resumed
+# generation must be bit-identical to its un-preempted oracle, and
+# every accepted request must resolve under sustained pressure — both
+# zero-baseline hard gates. deadline_drops regresses UP: the A/B's
+# deadlines are sized so the priority+preemption leg meets them all
+# (zero baseline), so any drop on the candidate side is scheduling
+# gone wrong, not traffic. output_mismatches already covers the
+# fleet's twin; capacity_seqs covers the optimistic-admission packing
+# headline via the existing higher-better rule.
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
                   "capacity_seqs", "prefill_tokens_saved",
                   "prefix_hit_rate", "accepted_per_step")
@@ -91,7 +101,9 @@ _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
                  "watchdog_trips", "lock_order_violations",
                  "dropped_reports", "requests_lost",
                  "output_mismatches", "recovery_time_s",
-                 "updates_lost", "epoch_fence_rejections_unexpected")
+                 "updates_lost", "epoch_fence_rejections_unexpected",
+                 "preempt_output_mismatches", "starved_requests",
+                 "deadline_drops")
 
 
 def metric_direction(name: str) -> int:

@@ -19,11 +19,14 @@ questions after the fact:
   (``FLEET_REPLICA_STATE/FLEET_INFLIGHT/FLEET_HB_AGE_MS/``
   ``FLEET_SNAPSHOT_VERSION``), the table additionally renders one row
   per decode REPLICA — lifecycle state (UP/PROBING/DEAD), in-flight
-  count, heartbeat age, and the SERVED snapshot version (``snap_v``;
+  count, heartbeat age, the SERVED snapshot version (``snap_v``;
   a fleet serving divergent or frozen versions — a dead or zombie
-  trainer — is visible at a glance; -1 = pre-PR-14 archive without the
-  gauge) (docs/SERVING.md "Serving fleet", docs/DISTRIBUTED.md
-  "Durability").
+  trainer — is visible at a glance), and the engine's cumulative
+  preemption count (``preempts``; overload churn per replica — a
+  replica preempting while its siblings idle is a routing or pool-
+  sizing problem). -1 in either column = an archive predating its
+  gauge (docs/SERVING.md "Serving fleet" / "Overload and preemption",
+  docs/DISTRIBUTED.md "Durability").
 * ``--prom`` — the merged registry as one Prometheus text exposition,
   every sample carrying a ``node`` label.
 * ``--trace OUT.json`` — the merged cross-process Perfetto document:

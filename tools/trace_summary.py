@@ -210,6 +210,15 @@ def request_report(spans, device_events=None):
         # served each row (replicated engines omit it — no column)
         if admits and "decode_tp" in admits[0]["args"]:
             row["decode_tp"] = admits[0]["args"]["decode_tp"]
+        # preempted-and-resumed requests: decode.preempt spans count the
+        # evictions and the resume's admit span carries the running
+        # total — a fat total_ms next to a nonzero preempt column says
+        # this request paid for someone else's burst
+        preempts = sum(s["name"] == "decode.preempt" for s in group)
+        resumed = [a for a in admits if "preempted" in a["args"]]
+        if preempts or resumed:
+            row["preempted"] = (resumed[-1]["args"]["preempted"]
+                                if resumed else preempts)
         if device:
             w0, w1 = root["ts"], root["ts"] + root["dur"]
             row["device_ms"] = sum(
@@ -227,6 +236,7 @@ def print_request_report(rows, top: int, sort: str,
     has_blocks = any("blocks" in r for r in rows)
     has_prefix = any("prefix_hit_blocks" in r for r in rows)
     has_tp = any("decode_tp" in r for r in rows)
+    has_preempt = any("preempted" in r for r in rows)
     has_keep = any(r.get("keep") for r in rows)
     # the node column ships as soon as the doc holds more than one
     # recording process (an obs-plane merged fleet trace); single-node
@@ -249,6 +259,8 @@ def print_request_report(rows, top: int, sort: str,
         hdr += f" {'pfxhit':>7} {'saved':>6}"
     if has_tp:
         hdr += f" {'tp':>3}"
+    if has_preempt:
+        hdr += f" {'preempt':>8}"
     if has_dev:
         hdr += f" {'device':>9}"
     if has_keep:
@@ -270,6 +282,8 @@ def print_request_report(rows, top: int, sort: str,
                      f"{str(r.get('prefill_tokens_saved', '-')):>6}")
         if has_tp:
             line += f" {str(r.get('decode_tp', '-')):>3}"
+        if has_preempt:
+            line += f" {str(r.get('preempted', '-')):>8}"
         if has_dev:
             line += f" {r.get('device_ms', 0.0):9.3f}"
         if has_keep:
