@@ -45,7 +45,13 @@ The inference half of the train/serve stack (docs/SERVING.md). Pieces:
   replicas on the ``mvserve`` p2p wire; a killed replica's in-flight
   requests replay bit-identically on survivors, and
   :class:`FaultPlan` (``-chaos``) stages the failures that prove it
-  (docs/SERVING.md "Serving fleet").
+  (docs/SERVING.md "Serving fleet"). Replicas can specialize
+  (``role="prefill"|"decode"``; default ``unified``): the router's
+  two-stage dispatch prefills on one replica, ships the paged KV
+  blocks + content chain hashes over the wire (``kv_transfer``) and
+  splices them into the decode replica's pool — bit-identical to
+  unified serving, with warm prefixes deduped off the wire
+  (docs/SERVING.md "Disaggregated prefill/decode").
 * the durable train half — :class:`ParamPublisher` /
   :class:`ParamSubscriber` (``mvparam`` wire): the trainer's fenced
   parameter publish stream into serving replicas. Each trainer

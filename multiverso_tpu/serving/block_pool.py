@@ -378,6 +378,18 @@ class BlockPool:
             self.miss_counter.inc(len(hashes) - len(matched))
         return matched
 
+    def indexed_hashes(self, limit: Optional[int] = None) -> List[bytes]:
+        """Chain hashes currently content-addressed here (live OR
+        cached), insertion order, optionally capped. This is the
+        decode replica's dedup ADVERTISEMENT: the heartbeat ships it so
+        the prefill side can skip shipping blocks the receiver already
+        holds (kv_transfer source-side dedup). A capped list is a
+        weaker advertisement, never a wrong one — an unadvertised block
+        just crosses the wire and dedups on arrival instead."""
+        with self._lock:
+            out = list(self._index)
+        return out if limit is None else out[:int(limit)]
+
     def flush_cache(self) -> int:
         """Drop every content identity and free all cached blocks (the
         engine calls this when the pinned snapshot moves: cached K/V

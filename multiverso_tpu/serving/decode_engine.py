@@ -146,7 +146,7 @@ from ..analysis import lockwatch
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -157,6 +157,7 @@ from ..dashboard import Dashboard
 from ..log import Log
 from .batcher import (DeadlineExceededError, OverloadedError, bucket_for,
                       shape_buckets)
+from . import kv_transfer
 from .block_pool import (SCRATCH_BLOCK, BlockPool, chain_hashes,
                          kv_bytes_per_block)
 from .flight_recorder import FlightRecorder
@@ -282,6 +283,13 @@ _RIDS = itertools.count(1)
 # the tests assert; strict priority would starve class 0 forever).
 MAX_PRIORITY = 7
 DEFAULT_PRIORITY = 1
+
+# disaggregated serving: cap on the chain hashes health() advertises
+# (the decode side's dedup advertisement rides replica heartbeats — at
+# 16 bytes/hash this bounds the heartbeat cost to ~8 KB of hex). A
+# capped advertisement is weaker, never wrong: an unadvertised cached
+# block crosses the wire and dedups on arrival instead.
+_CHAIN_ADVERT_CAP = 256
 
 # prompt-lookup n-gram width: the drafter keys on the sequence's last
 # _SPEC_NGRAM tokens. 2 is the sweet spot for the repetitive tails
@@ -539,7 +547,8 @@ class _Request:
                  "t_admit", "blocks", "rid", "hashes", "hash_seed",
                  "n_hit", "full_hit", "saved", "pf_reg", "ttft_pending",
                  "drafter", "priority", "deadline", "preempts",
-                 "resumed", "skips", "prompt0")
+                 "resumed", "skips", "prompt0", "pf_only", "known",
+                 "xfer")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  ctx: Optional[trace.SpanContext] = None,
@@ -592,6 +601,15 @@ class _Request:
         self.resumed = False
         self.skips = 0
         self.prompt0 = prompt
+        # disaggregated serving (kv_transfer): prefill-only admissions
+        # resolve with a transfer payload instead of tokens; ``known``
+        # holds the hex chain hashes the receiver advertised (skip
+        # shipping those); ``xfer`` carries the splice accounting of the
+        # transfer that warmed this request's prefix (decode side) so
+        # the admit span can attribute the hit to the wire
+        self.pf_only = False
+        self.known: frozenset = frozenset()
+        self.xfer: Optional[Dict[str, int]] = None
 
 
 class DecodeEngine:
@@ -883,6 +901,35 @@ class DecodeEngine:
                         cfg, params, kc, vc, tok, pos, active),
                     donate_argnums=donate)
 
+        # -- KV transfer plane (disaggregated prefill/decode) ---------------
+        # two construction-time programs, prefix-cache engines only (the
+        # transfer plane ships chain-addressed FULL blocks, so it rides
+        # the same gate): FETCH pulls one block's K/V slices off both
+        # pools (prefill side — the result is host-materialized into the
+        # wire payload), SPLICE writes one received block into a freshly
+        # allocated pool slot (decode side). The block id is a TRACED
+        # scalar in both, so each is exactly one compiled trace per
+        # engine (transfer_cache_size() asserts 2 after warmup) — a
+        # static index would recompile per pool position. Splice donates
+        # like the step/CoW (it reassigns both pools); fetch cannot
+        # donate (the pools survive it). Fresh lambdas per engine for
+        # the same per-engine compile-cache accounting as the CoW above.
+        if self._prefix:
+            self._fetch_fn = jax.jit(
+                lambda kc, vc, b: (
+                    jax.lax.dynamic_index_in_dim(kc, b, axis=1,
+                                                 keepdims=False),
+                    jax.lax.dynamic_index_in_dim(vc, b, axis=1,
+                                                 keepdims=False)))
+            self._splice_fn = jax.jit(
+                lambda kc, vc, b, k, v: (
+                    jax.lax.dynamic_update_index_in_dim(kc, k, b, axis=1),
+                    jax.lax.dynamic_update_index_in_dim(vc, v, b, axis=1)),
+                donate_argnums=(0, 1) if donate else ())
+        else:
+            self._fetch_fn = None
+            self._splice_fn = None
+
         # -- device state (owned by the loop thread after start) -------------
         # committed placement from birth: warmup scratch caches use the
         # same put, so the traces warmup compiles ARE the serving traces
@@ -927,6 +974,11 @@ class DecodeEngine:
         # hostage to force pool pressure; excluded from the watchdog's
         # leaked-reservation heuristic
         self._squeezed: List[int] = []
+        # inbound KV transfers awaiting the loop thread: the caches are
+        # loop-thread-owned (donation reassigns them per dispatch), so
+        # splice() parks (payload, done-event, out-dict) triples here
+        # and the loop applies them between iterations
+        self._splice_q: Deque = collections.deque()
         self._lock = lockwatch.lock("serving.DecodeEngine._lock")
         self._cv = threading.Condition(self._lock)
         self._stop = threading.Event()
@@ -974,6 +1026,20 @@ class DecodeEngine:
                 f"SPEC_PROPOSED[{name}]")
             self.spec_acc_counter = Dashboard.get_or_create_counter(
                 f"SPEC_ACCEPTED[{name}]")
+        # KV-transfer instruments, created only on prefix-cache engines
+        # (the transfer plane's gate) so a prefix_cache=off engine's
+        # dashboard/stats surface stays byte-for-byte (the metrics
+        # regression contract). Bytes are RAW K/V bytes moved — the
+        # kv_transfer.payload_bytes unit, not wire encoding.
+        self.xfer_bytes_counter = self.xfer_blocks_counter = None
+        self.xfer_dedup_counter = None
+        if self._prefix:
+            self.xfer_bytes_counter = Dashboard.get_or_create_counter(
+                f"KV_XFER_BYTES[{name}]")
+            self.xfer_blocks_counter = Dashboard.get_or_create_counter(
+                f"KV_XFER_BLOCKS[{name}]")
+            self.xfer_dedup_counter = Dashboard.get_or_create_counter(
+                f"KV_XFER_DEDUP[{name}]")
         # iteration progress: the counter for dashboards/rates, the local
         # mirror + monotonic age for stats()/the watchdog's stall check
         self.iters_counter = Dashboard.get_or_create_counter(
@@ -1031,6 +1097,14 @@ class DecodeEngine:
         self.prefix_misses = 0
         self.prefill_tokens_saved = 0
         self.cow_copies = 0
+        # KV-transfer mirrors (the KV_XFER_* counters stay monotonic;
+        # these reset with the bench window): blocks whose bytes crossed
+        # this engine's boundary (fetched out OR spliced in), the raw
+        # K/V bytes they carried, and blocks deduped away (source-side
+        # skip on this engine's fetch, or arrival-side index hit)
+        self.xfer_blocks = 0
+        self.xfer_bytes = 0
+        self.xfer_dedup = 0
         # speculative-decoding mirrors (the SPEC_* counters stay
         # monotonic; these reset with the bench window): drafts
         # proposed/accepted and verify-step dispatches
@@ -1081,7 +1155,8 @@ class DecodeEngine:
     def submit(self, prompt: np.ndarray, max_new: Optional[int] = None,
                ctx: Optional[trace.SpanContext] = None,
                priority: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               xfer_info: Optional[Dict[str, int]] = None) -> Future:
         """Enqueue one prompt; fast-rejects at the admission-queue cap,
         and (paged KV) when ``prompt + max_new`` needs more blocks than
         the whole pool holds — such a request could NEVER be admitted
@@ -1093,7 +1168,10 @@ class DecodeEngine:
         and preemption"). ``deadline_s`` (None = none) is seconds from
         now past which the answer is worthless: an expired request is
         dropped at queue-POP time with :class:`DeadlineExceededError`
-        before any prefill runs."""
+        before any prefill runs. ``xfer_info`` (disaggregated serving)
+        is the :meth:`splice` accounting of the KV transfer that warmed
+        this prompt's prefix, threaded onto the admit span so the trace
+        attributes the cache hit to the wire."""
         self.validate(prompt, max_new)
         prio = DEFAULT_PRIORITY if priority is None else int(priority)
         if not 0 <= prio <= MAX_PRIORITY:
@@ -1108,6 +1186,8 @@ class DecodeEngine:
         p = np.asarray(prompt, np.int32).ravel()
         req = _Request(p, int(max_new or self.config.max_new), ctx,
                        priority=prio, deadline=deadline)
+        if xfer_info:
+            req.xfer = dict(xfer_info)
         with self._cv:
             if self._stop.is_set():
                 raise RuntimeError(f"decode engine {self.name!r} is stopped")
@@ -1133,6 +1213,96 @@ class DecodeEngine:
             self._cv.notify()
         return req.future
 
+    # -- disaggregated prefill/decode (kv_transfer) -------------------------
+    @property
+    def supports_transfer(self) -> bool:
+        """Whether this engine can be a disaggregation endpoint. The
+        transfer plane moves chain-addressed FULL blocks, so it rides
+        exactly the prefix-cache gate (paged + chunked + prefix_cache):
+        without the content index there is nothing to splice INTO, and
+        without chunked prefill nothing block-granular to fetch FROM."""
+        return self._prefix
+
+    def submit_prefill(self, prompt: np.ndarray,
+                       known_hashes: Sequence[str] = (),
+                       ctx: Optional[trace.SpanContext] = None) -> Future:
+        """Enqueue a PREFILL-ONLY admission (the disaggregated fleet's
+        stage 1): the prompt chunk-prefills into paged blocks exactly
+        like a normal admission, but instead of going live the request
+        resolves with ``{"xfer": payload, "snapshot_version",
+        "staleness_s"}`` — the prompt's finished full blocks fetched to
+        the host as a :mod:`kv_transfer` payload — and releases its
+        reservation (the prefilled blocks stay behind in the CACHED
+        tier, so a repeat prompt full-hits locally). ``known_hashes``
+        are hex chain hashes the receiver already holds (router-tracked
+        shipped set + heartbeat advertisement): those blocks ride as
+        metadata only. Sheds like :func:`submit`; fails fast on engines
+        without :attr:`supports_transfer`."""
+        if not self.supports_transfer:
+            raise RuntimeError(
+                f"decode engine {self.name!r} cannot serve prefill-only "
+                f"admissions (needs paged KV + chunked prefill + "
+                f"prefix_cache — the transfer plane's gate)")
+        self.validate(prompt, None)
+        p = np.asarray(prompt, np.int32).ravel()
+        # max_new=1 keeps the reservation arithmetic in-range; the
+        # pf_only reservation is prompt-only regardless (nothing decodes)
+        req = _Request(p, 1, ctx)
+        req.pf_only = True
+        req.known = frozenset(str(h) for h in known_hashes)
+        with self._cv:
+            if self._stop.is_set():
+                raise RuntimeError(f"decode engine {self.name!r} is stopped")
+            need = self._pool.blocks_needed(p.shape[0])
+            if need > self._pool.capacity:
+                self.shed += 1
+                self.shed_counter.inc()
+                self._shed_class(req.priority)
+                raise OverloadedError(self.name, need,
+                                      self._pool.capacity,
+                                      what="kv block pool",
+                                      retriable=False)
+            if len(self._q) >= self.config.max_queue:
+                self.shed += 1
+                self.shed_counter.inc()
+                self._shed_class(req.priority)
+                raise OverloadedError(self.name, len(self._q),
+                                      self.config.max_queue)
+            if self.t_first is None:
+                self.t_first = req.t_enq
+            self._q.append(req)
+            self._cv.notify()
+        return req.future
+
+    def splice(self, payload: dict, timeout_s: float = 30.0) -> Dict:
+        """Splice a :mod:`kv_transfer` payload into this engine's block
+        pool (the disaggregated fleet's arrival side) and return the
+        accounting ``{"xfer_blocks", "xfer_bytes", "dedup_blocks"}``
+        (plus ``"skipped"`` when nothing could apply). BLOCKING and
+        thread-safe: the caches are loop-thread-owned, so the payload
+        parks on ``_splice_q`` and the loop applies it between
+        iterations — callers (the replica's drain thread) wait so the
+        follow-up ``submit`` of the same prompt is guaranteed to see
+        the warm prefix. Degrades, never raises: an unsupported engine,
+        stopped loop, or timeout returns a zero accounting and the
+        caller's submit re-prefills locally (correctness by
+        construction — the full prompt always rides stage 2)."""
+        zero = {"xfer_blocks": 0, "xfer_bytes": 0, "dedup_blocks": 0}
+        if not self.supports_transfer:
+            return dict(zero, skipped="unsupported")
+        done = threading.Event()
+        info: Dict = {}
+        with self._cv:
+            if self._stop.is_set():
+                return dict(zero, skipped="stopped")
+            self._splice_q.append((payload, done, info))
+            self._cv.notify()
+        if not done.wait(timeout_s):
+            return dict(zero, skipped="timeout")
+        out = dict(zero)
+        out.update(info)
+        return out
+
     def queue_depth(self) -> int:
         with self._lock:
             return len(self._q)
@@ -1157,7 +1327,7 @@ class DecodeEngine:
         params_age = self._manager.params_age_s()
         stale_after = float(config.get_flag("params_stale_after_s"))
         self.params_age_gauge.set(params_age)
-        return {
+        out = {
             "iters_total": self.iters_total,
             "last_iter_age_s": now - self._last_progress,
             "snapshot_version": (-1 if pinned is None else int(pinned)),
@@ -1181,6 +1351,17 @@ class DecodeEngine:
             "preemptions": self.preemptions,
             "stopped": self._stop.is_set(),
         }
+        if self._prefix:
+            # dedup ADVERTISEMENT (disaggregated serving): the chain
+            # hashes content-addressed here, riding replica heartbeats
+            # so the router's prefill stage skips shipping blocks this
+            # engine already holds. Capped — a truncated advertisement
+            # is weaker (those blocks cross the wire and dedup on
+            # arrival), never wrong.
+            out["cached_chains"] = [
+                h.hex() for h in self._pool.indexed_hashes(
+                    limit=_CHAIN_ADVERT_CAP)]
+        return out
 
     def pool_drift(self) -> Optional[str]:
         """Paged-KV accounting sanity: allocator invariant violations,
@@ -1255,6 +1436,10 @@ class DecodeEngine:
         re-admits pessimistically, so it can never need growth, never
         be preempted again, and never churn (the anti-livelock
         backstop)."""
+        if req.pf_only:
+            # prefill-only admissions never decode: the prompt's blocks
+            # are the whole reservation (no growth, no CoW headroom)
+            return self._pool.blocks_needed(len(req.prompt))
         if self._preempt_on and req.preempts < self._preempt_budget:
             return self._pool.blocks_needed(len(req.prompt))
         return self._pool.blocks_needed(
@@ -1302,14 +1487,26 @@ class DecodeEngine:
     def _loop(self) -> None:
         chunked = self._budget > 0
         while True:
+            splices: List[tuple] = []
             with self._cv:
                 while (not self._q and self._pf is None
                        and not self._active.any()
+                       and not self._splice_q
                        and not self._stop.is_set()):
                     self._cv.wait()
                 if (self._stop.is_set() and not self._q
                         and self._pf is None and not self._active.any()):
+                    # release any splice waiters before the loop dies —
+                    # a blocked replica drain thread must not hang on a
+                    # transfer the loop will never apply
+                    while self._splice_q:
+                        _, done, info = self._splice_q.popleft()
+                        info["skipped"] = "stopped"
+                        done.set()
                     return
+                if self._splice_q:
+                    splices = list(self._splice_q)
+                    self._splice_q.clear()
                 # admission pops through the weighted-fair lane
                 # scheduler (expired deadlines dropped at pop,
                 # bounded lookahead past a block-starved head) onto
@@ -1354,6 +1551,18 @@ class DecodeEngine:
             step_ms = 0.0
             worked = False
             try:
+                # inbound KV transfers apply OUTSIDE the engine lock on
+                # this (loop) thread — the only thread allowed to
+                # reassign the donated caches. A bad payload degrades
+                # (accounting says so); the waiter is released either way
+                for payload, done, info in splices:
+                    try:
+                        info.update(self._apply_splice(payload))
+                    except Exception as exc:    # pragma: no cover
+                        info["skipped"] = f"splice failed: {exc}"
+                    finally:
+                        done.set()
+                    worked = True
                 if chunked:
                     if arrivals:
                         self._begin_prefill(arrivals[0],
@@ -1512,7 +1721,11 @@ class DecodeEngine:
             # alloc below races a concurrent pool claimant and raises,
             # the requeue path can decref exactly what was taken
             req.blocks = matched
-            if req.full_hit:
+            # a prefill-only full hit skips the CoW: nothing will ever
+            # WRITE this sequence (no decode step recomputes P-1), so
+            # the last matched block stays shared and the payload
+            # fetches straight from the cached blocks
+            if req.full_hit and not req.pf_only:
                 shared_last = matched[-1]
                 dup = self._pool.alloc(1)[0]
                 self._k_cache, self._v_cache = self._cow_fn(
@@ -1594,6 +1807,14 @@ class DecodeEngine:
             req.drafter = _PromptLookup()
             req.drafter.extend(req.prompt)
         self._it_admitted.append(req.rid)
+        if self._prefix and req.full_hit and req.pf_only:
+            # prefill-only admission of a fully cached prompt: every
+            # block is already resident (and stays shared — reservation
+            # skipped the CoW), so the payload fetches immediately and
+            # the slot never goes live
+            self._pf = None
+            self._finish_prefill_only(req, chunks=0)
+            return
         if self._prefix and req.full_hit:
             # the WHOLE prompt was cached: no prefill at all. The slot
             # goes live at position P-1 with the prompt's last token as
@@ -1605,6 +1826,13 @@ class DecodeEngine:
                 extra = dict(self._mesh_attrs)
                 if req.preempts:
                     extra["preempted"] = req.preempts
+                if req.xfer:
+                    # the splice that warmed this prefix (disaggregated
+                    # stage 2): the trace links the hit to the wire
+                    extra["xfer_blocks"] = req.xfer.get("xfer_blocks", 0)
+                    extra["xfer_bytes"] = req.xfer.get("xfer_bytes", 0)
+                    extra["dedup_blocks"] = req.xfer.get(
+                        "dedup_blocks", 0)
                 trace.record_span("queue.wait", req.ctx, req.t_enq,
                                   req.t_admit, cause="admission")
                 trace.record_span(
@@ -1689,6 +1917,15 @@ class DecodeEngine:
                 tokens=n, budget=C)
         if not final:
             return
+        if req.pf_only:
+            # prefill-only admission (disaggregated stage 1): no first
+            # token — the prompt's finished blocks ARE the result. The
+            # logits fall on the floor by design: the decode side
+            # recomputes P-1 through its own full-hit CoW step, which
+            # is what keeps disaggregated output bit-identical.
+            self._pf = None
+            self._finish_prefill_only(req, chunks=req.pf_chunks)
+            return
         # final chunk: the prompt's last real position's logits are the
         # first generated token (exactly the monolithic prefill's gather)
         tok0 = int(np.argmax(np.asarray(logits)))
@@ -1719,6 +1956,10 @@ class DecodeEngine:
                 extra["prefill_tokens_saved"] = req.saved
             if req.preempts:
                 extra["preempted"] = req.preempts
+            if req.xfer:
+                extra["xfer_blocks"] = req.xfer.get("xfer_blocks", 0)
+                extra["xfer_bytes"] = req.xfer.get("xfer_bytes", 0)
+                extra["dedup_blocks"] = req.xfer.get("dedup_blocks", 0)
             extra.update(self._mesh_attrs)
             trace.record_span(
                 "decode.admit", req.ctx, req.t_admit, now, slot=req.slot,
@@ -1736,6 +1977,135 @@ class DecodeEngine:
         self._tok[req.slot] = tok0
         self._pos[req.slot] = len(req.prompt)
         self._active[req.slot] = True
+
+    def _finish_prefill_only(self, req: _Request, chunks: int) -> None:
+        """Prefill-only admission complete (disaggregated stage 1): the
+        prompt's full blocks are prefilled (or cache-resident), so fetch
+        the ones the receiver did NOT advertise to the host, build the
+        :mod:`kv_transfer` payload, release the reservation (the blocks
+        park in the CACHED tier — a repeat prompt full-hits locally),
+        and resolve the future with the payload instead of tokens. Runs
+        on the loop thread: the caches are loop-thread-owned."""
+        hashes = self._req_hashes(req)
+        payload = kv_transfer.new_payload(
+            len(req.prompt), self._block_size, req.version,
+            (self._model_cfg.n_layers, self._block_size,
+             self._model_cfg.d_model), self._model_cfg.dtype)
+        shipped = 0
+        for i, h in enumerate(hashes):
+            hx = h.hex()
+            if hx in req.known:
+                # source-side dedup: the receiver advertised this chain
+                # prefix — the hash rides, the bytes stay home
+                kv_transfer.add_block(payload, hx)
+                continue
+            k, v = self._fetch_fn(self._k_cache, self._v_cache,
+                                  np.int32(req.blocks[i]))
+            kv_transfer.add_block(payload, hx, np.asarray(k),
+                                  np.asarray(v))
+            shipped += 1
+        nbytes = kv_transfer.payload_bytes(payload)
+        dedup = int(payload["dedup_blocks"])
+        self.xfer_blocks += shipped
+        self.xfer_bytes += nbytes
+        self.xfer_dedup += dedup
+        self.xfer_blocks_counter.inc(shipped)
+        self.xfer_bytes_counter.inc(nbytes)
+        if dedup:
+            self.xfer_dedup_counter.inc(dedup)
+        now = time.monotonic()
+        if trace.enabled() and req.ctx is not None:
+            trace.record_span("queue.wait", req.ctx, req.t_enq,
+                              req.t_admit, cause="admission")
+            trace.record_span(
+                "decode.admit", req.ctx, req.t_admit, now,
+                slot=req.slot, prompt_len=len(req.prompt), chunks=chunks,
+                budget=self._budget, snapshot_version=req.version,
+                blocks=len(req.blocks), pool_free=self._pool.n_free,
+                prefix_hit_blocks=req.n_hit,
+                prefill_tokens_saved=req.saved, prefill_only=True,
+                xfer_blocks=shipped, xfer_bytes=nbytes,
+                dedup_blocks=dedup, **self._mesh_attrs)
+        self._release_seq(req)
+        self.completed += 1
+        self._it_completed.append(req.rid)
+        if req.future.set_running_or_notify_cancel():
+            req.future.set_result({
+                "xfer": payload,
+                "snapshot_version": req.version,
+                "staleness_s": self._manager.staleness_s(self._snap)})
+
+    def _apply_splice(self, payload: dict) -> Dict:
+        """Splice one received payload into the pool (loop thread).
+        Walks the hash chain head-first: an already-indexed hash is an
+        arrival-side dedup hit; a hash with shipped bytes allocates one
+        block, writes the K/V via the jitted splice program, registers
+        the content identity, and decrefs straight into the CACHED tier
+        (claimable by the follow-up admission's lookup, evictable under
+        pressure). The walk STOPS at the first gap — chain hashes only
+        have meaning as prefixes — so a chaos-dropped payload or a full
+        pool degrades to a shorter warm prefix, never a wrong one. A
+        payload whose pinned-version seed disagrees is skipped whole
+        (splicing stale-params K/V would poison the content index)."""
+        info: Dict = {"xfer_blocks": 0, "xfer_bytes": 0,
+                      "dedup_blocks": 0}
+        why = kv_transfer.validate(payload)
+        if why is not None:
+            info["skipped"] = why
+            return info
+        # pin a snapshot if nothing has yet (a fresh decode replica may
+        # see its first transfer before its first request), then check
+        # the payload's version against OUR hash-chain seed
+        self._maybe_refresh()
+        if str(int(payload["snapshot_version"])).encode() != \
+                self._hash_seed:
+            info["skipped"] = (
+                f"snapshot version {payload['snapshot_version']} != "
+                f"pinned {self._pinned_version}")
+            return info
+        if int(payload["block_size"]) != self._block_size:
+            info["skipped"] = (f"block size {payload['block_size']} != "
+                               f"{self._block_size}")
+            return info
+        cfg = self._model_cfg
+        shape = tuple(int(d) for d in payload["shape"])
+        if shape != (cfg.n_layers, self._block_size, cfg.d_model):
+            info["skipped"] = f"block shape {shape} mismatch"
+            return info
+        dtype = np.dtype(payload["dtype"])
+        if dtype != np.dtype(cfg.dtype):
+            info["skipped"] = f"dtype {dtype} != {np.dtype(cfg.dtype)}"
+            return info
+        per_block = kv_transfer.block_nbytes(shape, dtype)
+        blocks = payload.get("blocks") or {}
+        for hx in payload["hashes"]:
+            h = bytes.fromhex(hx)
+            if self._pool.peek([h]):
+                info["dedup_blocks"] += 1
+                continue
+            rec = blocks.get(hx)
+            if rec is None or not self._pool.can_alloc(1):
+                break
+            try:
+                k, v = kv_transfer.unpack_block(rec, shape, dtype)
+            except ValueError:
+                break
+            blk = self._pool.alloc(1)[0]
+            self._k_cache, self._v_cache = self._splice_fn(
+                self._k_cache, self._v_cache, np.int32(blk), k, v)
+            self._pool.register(blk, h)
+            self._pool.decref([blk])
+            info["xfer_blocks"] += 1
+            info["xfer_bytes"] += per_block
+        self.xfer_blocks += info["xfer_blocks"]
+        self.xfer_bytes += info["xfer_bytes"]
+        self.xfer_dedup += info["dedup_blocks"]
+        if info["xfer_blocks"]:
+            self.xfer_blocks_counter.inc(info["xfer_blocks"])
+            self.xfer_bytes_counter.inc(info["xfer_bytes"])
+        if info["dedup_blocks"]:
+            self.xfer_dedup_counter.inc(info["dedup_blocks"])
+        return info
 
     def _admit(self, arrivals: List[_Request]) -> None:
         t_admit = time.monotonic()     # queue.wait ends / admission begins
@@ -2167,6 +2537,11 @@ class DecodeEngine:
             # fast-fail instead of enqueueing futures nobody will drain
             self._stop.set()
             pending = self._q.drain()
+            # release splice waiters: the loop will never apply these
+            while self._splice_q:
+                _, done, info = self._splice_q.popleft()
+                info["skipped"] = "engine failed"
+                done.set()
         live = [r for r in self._slot_req if r is not None]
         if self._pf is not None:      # mid-prefill admission dies too
             live.append(self._pf)
@@ -2250,6 +2625,16 @@ class DecodeEngine:
             return 0
         return _jit_cache_size(self._verify_fn)
 
+    def transfer_cache_size(self) -> int:
+        """Compiled-trace count of the KV transfer plane (2 after
+        warmup on a prefix-cache engine — one fetch, one splice; the
+        block id is traced, so pool position never recompiles; 0 when
+        the plane doesn't exist)."""
+        if self._fetch_fn is None:
+            return 0
+        return (_jit_cache_size(self._fetch_fn)
+                + _jit_cache_size(self._splice_fn))
+
     def warmup(self) -> None:
         """Compile every admission trace (the ONE chunk program when
         chunked, else every (batch bucket, prompt bucket) fused
@@ -2303,6 +2688,17 @@ class DecodeEngine:
                 kc, vc = scratch()
                 jax.block_until_ready(self._cow_fn(
                     kc, vc, np.int32(0), np.int32(0)))
+                # the KV transfer plane's two programs likewise (a
+                # disaggregated fleet dispatches fetch at stage-1
+                # completion and splice at arrival): warm both so no
+                # transfer pays a compile. The host round-trip mirrors
+                # serving — fetch materializes before splice donates
+                # the pools away.
+                kc, vc = scratch()
+                k, v = self._fetch_fn(kc, vc, np.int32(0))
+                k, v = np.asarray(k), np.asarray(v)
+                jax.block_until_ready(self._splice_fn(
+                    kc, vc, np.int32(0), k, v)[0])
             if self._spec:
                 # the verify step pins like the step programs: compiled
                 # here against the pinned params + scratch pools, so
@@ -2350,6 +2746,9 @@ class DecodeEngine:
         self.prefix_misses = 0
         self.prefill_tokens_saved = 0
         self.cow_copies = 0
+        self.xfer_blocks = 0
+        self.xfer_bytes = 0
+        self.xfer_dedup = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_steps = 0
@@ -2401,6 +2800,21 @@ class DecodeEngine:
                 "prefix_evictions": self._pool.evictions
                 - self._evictions_base,
                 "cow_copies": self.cow_copies,
+            })
+        if self._prefix:
+            # KV transfer plane (disaggregated serving), prefix-cache
+            # engines only — the plane's gate, so a prefix_cache=off
+            # engine's stats surface stays byte-for-byte today's.
+            # kv_bytes_moved is RAW K/V bytes that crossed this
+            # engine's boundary (fetched out or spliced in); the dedup
+            # hit rate is blocks-deduped over blocks-considered
+            moved = self.xfer_blocks + self.xfer_dedup
+            pool.update({
+                "kv_bytes_moved": self.xfer_bytes,
+                "xfer_blocks": self.xfer_blocks,
+                "xfer_dedup_blocks": self.xfer_dedup,
+                "xfer_dedup_hit_rate": (self.xfer_dedup / moved
+                                        if moved else 0.0),
             })
         if self._spec:
             # speculative-decoding surface, present only on spec

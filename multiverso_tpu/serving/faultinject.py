@@ -45,6 +45,17 @@ comma-separated, all optional)::
                              live traffic sees a shrunken pool and
                              growth must preempt; release at request
                              R (omitted = held until engine stop)
+    kv_xfer_drop=K           drop the K-th (1-based) outbound KV-block
+                             transfer mid-flight: the prefill replica
+                             strips the payload's K/V bytes
+                             (``kv_transfer.drop_blocks``) before
+                             publishing, keeping the header + hash
+                             chain so the loss is observable. The
+                             decode side splices nothing new and
+                             re-prefills the prompt locally — a
+                             dropped transfer must cost latency,
+                             never tokens (``output_mismatches`` 0,
+                             ``requests_lost`` 0)
 
 Trainer-side failure points (PR 14 — the durability pipeline's chaos):
 
@@ -127,11 +138,12 @@ class FaultPlan:
         self.squeeze_at: int = 0              # 0 = never
         self.squeeze_fraction: float = 0.0
         self.squeeze_release_at: int = 0      # 0 = never released
+        self.xfer_drop_at: int = 0            # 0 = never
         self._wal = None                      # attach_wal() target
         self.counts: Dict[str, int] = {
             "kills": 0, "wedges": 0, "wire_delays": 0, "wire_drops": 0,
             "trainer_kills": 0, "wal_faults": 0, "zombie_publishes": 0,
-            "bursts": 0, "pool_squeezes": 0}
+            "bursts": 0, "pool_squeezes": 0, "kv_xfer_drops": 0}
         for directive in filter(None,
                                 (d.strip() for d in self.spec.split(","))):
             key, _, val = directive.partition("=")
@@ -198,6 +210,10 @@ class FaultPlan:
                     and self.squeeze_release_at <= self.squeeze_at):
                 raise ValueError("pool_squeeze release R must come "
                                  "after K")
+        elif key == "kv_xfer_drop":
+            self.xfer_drop_at = int(val)
+            if self.xfer_drop_at < 1:
+                raise ValueError("kv_xfer_drop needs K >= 1")
         else:
             raise ValueError(f"unknown failure point {key!r}")
 
@@ -293,6 +309,17 @@ class FaultPlan:
             return self.delay_s
         return 0.0
 
+    def drop_kv_xfer(self, k: int) -> bool:
+        """Consulted as the prefill replica publishes its ``k``-th
+        (1-based) KV-block transfer: True = strip the payload's K/V
+        bytes (``kv_transfer.drop_blocks``) before it hits the wire."""
+        if self.xfer_drop_at and k == self.xfer_drop_at:
+            self.counts["kv_xfer_drops"] += 1
+            Log.error("chaos: dropping KV transfer %d mid-flight "
+                      "(kv_xfer_drop)", k)
+            return True
+        return False
+
     def drop_heartbeat(self) -> bool:
         """Consulted per heartbeat: True = suppress this one."""
         if self.drop_p > 0 and self._rng.random() < self.drop_p:
@@ -305,7 +332,7 @@ class FaultPlan:
                     or self.drop_p or self.heartbeat_scale != 1.0
                     or self.kill_trainer_at or self.wal_fault
                     or self.zombie_at or self.burst_at
-                    or self.squeeze_at)
+                    or self.squeeze_at or self.xfer_drop_at)
 
     def stats(self) -> Dict[str, Any]:
         return {"spec": self.spec, "seed": self.seed, **self.counts}

@@ -1,0 +1,181 @@
+"""KV-block transfer plane for disaggregated prefill/decode serving.
+
+A disaggregated fleet splits the two phases of generation onto
+specialized replicas (DistServe / Splitwise): throughput-bound PREFILL
+replicas chunk-prefill prompts into paged KV blocks, latency-bound
+DECODE replicas run the per-token steps. What crosses between them is
+not tokens but *cache state*: the finished KV blocks of the prompt,
+shipped over the existing ``mvserve`` wire and spliced into the decode
+replica's block pool so admission lands on the PR 8 full-hit path
+(lookup -> CoW on the last block -> live at position P-1) and emits
+tokens bit-identical to unified serving.
+
+This module is the wire format and the byte accounting — deliberately
+small and engine-free, so both ends (and the router, which carries the
+payload between stages) agree on one schema:
+
+* **one payload per prefilled prompt** (:func:`new_payload`): header
+  (``prompt_len``, ``block_size``, ``snapshot_version``, the per-block
+  ``shape``/``dtype``) + the prompt's full-block **chain hashes in
+  chain order** + a sparse ``blocks`` map of the hashes whose K/V bytes
+  actually ride the wire. Only FULL blocks transfer — a trailing
+  partial block has no chain identity (block_pool.chain_hashes) and the
+  decode side re-prefills the tail locally, which is also what makes a
+  lost transfer a performance event rather than a correctness event.
+* **dedup at the source** (:func:`add_block` with ``k=None``): a hash
+  the decode side already advertised (router-tracked shipped set +
+  heartbeat ``cached_chains``) rides as metadata only — the hash holds
+  its place in the chain so arrival-side splicing can still claim the
+  warm prefix, but zero K/V bytes move. ``dedup_blocks`` counts them.
+* **dedup on arrival**: the decode engine checks its pool's content
+  index per hash before splicing; a block that landed since the
+  advertisement is skipped there too. Both ends count into the same
+  ``KV_XFER_DEDUP`` ledger.
+
+Transfer-unit math: one block costs
+``2 * n_layers * block_size * d_model * itemsize`` bytes across both
+pools (:func:`block_nbytes` — the same arithmetic as
+``block_pool.kv_bytes_per_block``, restated over the payload's shape
+tuple so the wire accounting cannot drift from the device accounting).
+Bytes are base64 in the JSON record (the ``mvserve`` wire is one JSON
+object per transport record); ``payload_bytes`` reports the RAW K/V
+bytes moved, which is what ``kv_bytes_moved`` gates on — encoding
+overhead is a wire detail, not a capacity number.
+
+Versioning: ``snapshot_version`` scopes the chain hashes (cached K/V
+bytes are a function of (token prefix, params version) — the engine
+seeds its hash chain with the pinned snapshot version). A payload whose
+version disagrees with the receiver's pinned snapshot is dropped whole
+at splice time: splicing stale-params KV would poison the receiver's
+content index. Correctness survives because stage-2 dispatch always
+carries the full prompt — the decode side re-prefills whatever the
+splice did not provide (docs/SERVING.md "Disaggregated prefill/decode").
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+#: payload schema version; a receiver rejects (skips) other versions
+WIRE_VERSION = 1
+
+
+def block_nbytes(shape: Sequence[int], dtype) -> int:
+    """Raw bytes ONE block moves across both pools (K and V) given the
+    payload's per-block ``shape`` — ``(n_layers, block_size, d_model)``
+    as the engine fetches it."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return 2 * n * np.dtype(dtype).itemsize
+
+
+def pack_block(k: np.ndarray, v: np.ndarray) -> Dict[str, str]:
+    """One block's K/V slices as a JSON-safe record: base64 of the raw
+    C-order bytes. Shape/dtype ride ONCE in the payload header — every
+    block of a payload shares them by construction."""
+    return {
+        "k": base64.b64encode(
+            np.ascontiguousarray(k).tobytes()).decode("ascii"),
+        "v": base64.b64encode(
+            np.ascontiguousarray(v).tobytes()).decode("ascii"),
+    }
+
+
+def unpack_block(rec: Dict[str, str], shape: Sequence[int], dtype):
+    """Inverse of :func:`pack_block` -> ``(k, v)`` ndarrays shaped per
+    the payload header. Raises ``ValueError`` when the byte count does
+    not factor into the declared shape (a truncated/corrupt record must
+    fail loudly, not splice garbage)."""
+    shape = tuple(int(d) for d in shape)
+    k = np.frombuffer(base64.b64decode(rec["k"]), dtype=dtype)
+    v = np.frombuffer(base64.b64decode(rec["v"]), dtype=dtype)
+    want = 1
+    for d in shape:
+        want *= d
+    if k.size != want or v.size != want:
+        raise ValueError(
+            f"kv_transfer: block record has {k.size}/{v.size} elems, "
+            f"shape {shape} wants {want}")
+    return k.reshape(shape), v.reshape(shape)
+
+
+def new_payload(prompt_len: int, block_size: int, snapshot_version: int,
+                shape: Sequence[int], dtype) -> Dict[str, Any]:
+    """Empty transfer payload (header only); fill with :func:`add_block`
+    in chain order."""
+    return {
+        "v": WIRE_VERSION,
+        "prompt_len": int(prompt_len),
+        "block_size": int(block_size),
+        "snapshot_version": int(snapshot_version),
+        "shape": [int(d) for d in shape],
+        "dtype": np.dtype(dtype).name,
+        "hashes": [],           # every full block's chain hash, in order
+        "blocks": {},           # hex hash -> pack_block record (shipped)
+        "dedup_blocks": 0,      # source-side skips (receiver had them)
+        "dropped": False,       # chaos kv_xfer_drop stripped the bytes
+    }
+
+
+def add_block(payload: Dict[str, Any], hex_hash: str,
+              k: Optional[np.ndarray] = None,
+              v: Optional[np.ndarray] = None) -> None:
+    """Append one full block to the chain. ``k``/``v`` given = ship the
+    bytes; ``k=None`` = source-side dedup (the receiver advertised this
+    chain prefix) — the hash still holds its chain position so
+    arrival-side splicing can claim the warm prefix past it."""
+    payload["hashes"].append(hex_hash)
+    if k is None:
+        payload["dedup_blocks"] += 1
+    else:
+        payload["blocks"][hex_hash] = pack_block(k, v)
+
+
+def payload_bytes(payload: Dict[str, Any]) -> int:
+    """RAW K/V bytes this payload moves (shipped blocks only — dedup'd
+    hashes are metadata). The ``kv_bytes_moved`` unit of account."""
+    return len(payload.get("blocks") or {}) * block_nbytes(
+        payload["shape"], payload["dtype"])
+
+
+def shipped_hashes(payload: Dict[str, Any]) -> Set[str]:
+    """Hex hashes whose bytes ride this payload (the router folds these
+    into its per-decode-replica shipped set for future source dedup)."""
+    return set(payload.get("blocks") or {})
+
+
+def drop_blocks(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Chaos ``kv_xfer_drop``: strip every shipped block mid-flight,
+    keeping the header + hashes (the metadata that makes the loss
+    OBSERVABLE). The receiver splices nothing new and re-prefills — a
+    dropped transfer must cost latency, never correctness."""
+    payload = dict(payload)
+    payload["blocks"] = {}
+    payload["dropped"] = True
+    return payload
+
+
+def validate(payload: Dict[str, Any]) -> Optional[str]:
+    """Schema check -> reason string, or None when the payload is
+    well-formed. The splice path skips (never raises on) a bad payload:
+    the full prompt is in the stage-2 request, so degrading to a local
+    re-prefill is always available."""
+    if not isinstance(payload, dict):
+        return "payload is not a dict"
+    if payload.get("v") != WIRE_VERSION:
+        return f"wire version {payload.get('v')!r} != {WIRE_VERSION}"
+    for key in ("prompt_len", "block_size", "snapshot_version",
+                "shape", "dtype", "hashes"):
+        if key not in payload:
+            return f"missing {key!r}"
+    if len(payload["shape"]) != 3:
+        return f"shape {payload['shape']!r} is not (L, block, D)"
+    blocks = payload.get("blocks") or {}
+    stray = set(blocks) - set(payload["hashes"])
+    if stray:
+        return f"{len(stray)} shipped block(s) not in the hash chain"
+    return None

@@ -471,12 +471,13 @@ class ObsCollector:
         replica_rows = self.replica_rows()
         if replica_rows:
             lines.append(
-                f"{'replica':>12} {'state':<11} {'inflight':>9} "
-                f"{'hb_age_ms':>10} {'snap_v':>7} {'preempts':>9} "
-                f"{'node':>5}")
+                f"{'replica':>12} {'state':<11} {'role':<8} "
+                f"{'inflight':>9} {'hb_age_ms':>10} {'snap_v':>7} "
+                f"{'preempts':>9} {'node':>5}")
             for row in replica_rows:
                 lines.append(
                     f"{row['replica']:>12} {row['state']:<11} "
+                    f"{row['role']:<8} "
                     f"{row['inflight']:>9} {row['hb_age_ms']:>10.1f} "
                     f"{row['snapshot_version']:>7} "
                     f"{row['preemptions']:>9} {row['node']:>5}")
@@ -501,7 +502,9 @@ class ObsCollector:
         shipped registry carries them — the :class:`FleetRouter`'s
         state machine rendered into the fleet table (state, in-flight,
         heartbeat age), live or from ``tools/opscenter.py`` archives."""
-        from .router import STATE_NAMES
+        from .router import ROLE_CODES, STATE_NAMES
+
+        role_names = {code: role for role, code in ROLE_CODES.items()}
 
         with self._lock:
             per_node = [(node, dict(st["rows"]))
@@ -527,7 +530,13 @@ class ObsCollector:
                                       {}).get("value", -1))
                 preempts = int(rows.get(f"FLEET_PREEMPTS[{key}]",
                                         {}).get("value", -1))
+                # role shipped since PR 16; pre-disaggregation archives
+                # lack the gauge and render "-" (same tolerance)
+                role_code = int(rows.get(f"FLEET_ROLE[{key}]",
+                                         {}).get("value", -1))
+                role = role_names.get(role_code, "-")
                 out.append({"replica": key, "state": state,
+                            "role": role,
                             "inflight": inflight, "hb_age_ms": hb_age,
                             "snapshot_version": snap_v,
                             "preemptions": preempts, "node": node})

@@ -64,6 +64,17 @@ MSG_RSP = "rsp"        # replica -> router: completed generation
 MSG_ERR = "err"        # replica -> router: shed / engine failure
 MSG_PONG = "pong"      # replica -> router: probe answer
 MSG_HB = "hb"          # replica -> router: engine.health() heartbeat
+MSG_XFER = "xfer"      # replica -> router: prefilled KV-block payload
+                       # (disaggregated stage 1 -> the router carries it
+                       # to the chosen decode replica in stage 2)
+
+#: replica roles (disaggregated serving). "unified" is the back-compat
+#: default: the replica both prefills and decodes, exactly the pre-PR
+#: fleet. A "prefill" replica only serves stage-1 prefill-only
+#: admissions; a "decode" replica serves stage-2 (splice + generate) —
+#: and either still handles a plain unified request, which is what
+#: makes the router's no-prefill-UP fallback safe.
+ROLES = ("unified", "prefill", "decode")
 
 
 def encode_msg(msg: Dict[str, Any]) -> bytes:
@@ -83,13 +94,17 @@ class ReplicaServer:
     def __init__(self, rank: int, size: int, client: Any, engine: Any,
                  label: str = LABEL, heartbeat_ms: Optional[int] = None,
                  chaos: Optional[FaultPlan] = None,
-                 kill_fn: Optional[Callable[[], None]] = None) -> None:
+                 kill_fn: Optional[Callable[[], None]] = None,
+                 role: str = "unified") -> None:
         from ..parallel.p2p import P2PTransport
 
         if not 1 <= rank < size:
             raise ValueError(f"replica rank {rank} outside [1, {size})")
+        if role not in ROLES:
+            raise ValueError(f"replica role {role!r} not in {ROLES}")
         self.rank = int(rank)
         self.size = int(size)
+        self.role = role
         self._client = client
         self._label = label
         self.engine = engine
@@ -108,8 +123,16 @@ class ReplicaServer:
 
             params = inspect.signature(engine.submit).parameters
             self._engine_prio = "priority" in params
+            self._engine_xfer_kw = "xfer_info" in params
         except (TypeError, ValueError):   # builtins/partials: assume new
             self._engine_prio = True
+            self._engine_xfer_kw = True
+        # transfer-plane capability: an inbound payload only splices
+        # when the engine can (the fakes keep the classic surface —
+        # the payload is then ignored and the prompt prefills locally;
+        # a stage-1 request against an engine without submit_prefill
+        # errors through the normal MSG_ERR path)
+        self._engine_splice = hasattr(engine, "splice")
         # publish seq resumes from the router's ack so the router's
         # in-order consumer sees ONE contiguous stream across replica
         # incarnations; subscription resumes from the router's stream
@@ -136,6 +159,8 @@ class ReplicaServer:
         self.completed = 0
         self.failed = 0
         self.heartbeats = 0
+        self.xfers_sent = 0             # stage-1 payloads published
+        self.xfers_spliced = 0          # stage-2 payloads applied
         self._threads = [
             threading.Thread(target=self._drain_loop,
                              name=f"mvserve-replica-{rank}", daemon=True),
@@ -283,9 +308,44 @@ class ReplicaServer:
         if (self.chaos.squeeze_release(self.requests_seen)
                 and hasattr(self.engine, "unsqueeze_pool")):
             self.engine.unsqueeze_pool()
+        if msg.get("stage") == "prefill":
+            # disaggregated stage 1: chunk-prefill the prompt into
+            # paged blocks and reply with the transfer payload instead
+            # of tokens ("known" = chain hashes the decode side already
+            # holds — those ride as metadata, zero bytes)
+            try:
+                fut = self.engine.submit_prefill(
+                    prompt, msg.get("known") or (),
+                    ctx=sp.context if parent else None)
+            except Exception as exc:
+                sp.end(error=type(exc).__name__)
+                self.failed += 1
+                err = {"t": MSG_ERR, "node": self.rank, "rid": rid,
+                       "kind": "error", "what": type(exc).__name__,
+                       "msg": str(exc)}
+                if isinstance(exc, OverloadedError):
+                    err.update(kind="overloaded", what=exc.what,
+                               depth=exc.depth, cap=exc.cap,
+                               retriable=exc.retriable)
+                self._publish(err)
+                return
+            fut.add_done_callback(
+                lambda f, rid=rid, sp=sp: self._reply_xfer(rid, f, sp))
+            return
+        xfer_info = None
+        if msg.get("xfer") is not None and self._engine_splice:
+            # disaggregated stage 2: splice the carried payload into
+            # the local pool BEFORE submitting the prompt, so admission
+            # sees the warm prefix (full hit -> CoW -> live at P-1).
+            # splice degrades instead of raising — a bad/stale/dropped
+            # payload just means the prompt re-prefills locally
+            xfer_info = self.engine.splice(msg["xfer"])
+            self.xfers_spliced += 1
         kw = {}
         if self._engine_prio:
             kw = {"priority": msg.get("prio"), "deadline_s": deadline_s}
+        if xfer_info is not None and self._engine_xfer_kw:
+            kw["xfer_info"] = xfer_info
         try:
             fut = self.engine.submit(prompt, msg.get("max_new"),
                                      ctx=sp.context if parent else None,
@@ -338,6 +398,44 @@ class ReplicaServer:
             "snapshot_version": reply.get("snapshot_version"),
             "staleness_s": reply.get("staleness_s", 0.0)})
 
+    def _reply_xfer(self, rid: str, fut, sp) -> None:
+        """Stage-1 completion: publish the KV-block payload as a
+        MSG_XFER record for the router to carry to the decode replica.
+        The ``kv_xfer_drop`` chaos point fires here — the payload's
+        K/V bytes are stripped mid-flight while the header + hash chain
+        survive, so the loss is observable and the decode side
+        re-prefills (latency, never tokens)."""
+        if self._stop.is_set():
+            sp.end(error="died")
+            return
+        exc = fut.exception()
+        if exc is not None:
+            sp.end(error=type(exc).__name__)
+            self.failed += 1
+            err = {"t": MSG_ERR, "node": self.rank, "rid": rid,
+                   "kind": "error", "what": type(exc).__name__,
+                   "msg": str(exc)}
+            if isinstance(exc, OverloadedError):
+                err.update(kind="overloaded", what=exc.what,
+                           depth=exc.depth, cap=exc.cap,
+                           retriable=exc.retriable)
+            self._publish(err)
+            return
+        reply = fut.result()
+        payload = reply["xfer"]
+        self.xfers_sent += 1
+        if self.chaos.drop_kv_xfer(self.xfers_sent):
+            from . import kv_transfer
+
+            payload = kv_transfer.drop_blocks(payload)
+        sp.end(ok=True)
+        self.completed += 1
+        self._publish({
+            "t": MSG_XFER, "node": self.rank, "rid": rid,
+            "payload": payload,
+            "snapshot_version": reply.get("snapshot_version"),
+            "staleness_s": reply.get("staleness_s", 0.0)})
+
     # -- heartbeat side ------------------------------------------------------
     def _heartbeat_loop(self) -> None:
         # heartbeat_scale is read PER BEAT, not folded in at init: the
@@ -355,17 +453,20 @@ class ReplicaServer:
             self.heartbeats += 1
             self._publish({"t": MSG_HB, "node": self.rank,
                            "n": self.heartbeats, "mono": time.monotonic(),
-                           "health": health})
+                           "role": self.role, "health": health})
             self._release_acked()
 
     # -- lifecycle -----------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         return {
             "rank": self.rank,
+            "role": self.role,
             "requests_seen": self.requests_seen,
             "completed": self.completed,
             "failed": self.failed,
             "heartbeats": self.heartbeats,
+            "xfers_sent": self.xfers_sent,
+            "xfers_spliced": self.xfers_spliced,
             "chaos": self.chaos.stats(),
         }
 
@@ -407,12 +508,15 @@ class ReplicaServer:
 
 def serve_replica(rank: int, size: int, client: Any, lm,
                   label: str = LABEL, engine_kw: Optional[dict] = None,
-                  warm: bool = True) -> ReplicaServer:
+                  warm: bool = True, role: str = "unified"
+                  ) -> ReplicaServer:
     """Standalone replica bootstrap: build a warm
     :class:`~.decode_engine.DecodeEngine` over ``lm`` and put it on the
-    wire, with the ``-chaos`` flag plan armed. The subprocess
-    acceptance test and any real deployment entry call this after
-    ``mv.init()`` (Session bootstrap: flags, topology, tables)."""
+    wire, with the ``-chaos`` flag plan armed. ``role`` specializes the
+    replica for a disaggregated fleet (``prefill``/``decode``;
+    ``unified`` is the symmetric default). The subprocess acceptance
+    test and any real deployment entry call this after ``mv.init()``
+    (Session bootstrap: flags, topology, tables)."""
     from .decode_engine import DecodeEngine, DecodeEngineConfig
 
     engine = DecodeEngine(f"replica{rank}", lm,
@@ -420,4 +524,4 @@ def serve_replica(rank: int, size: int, client: Any, lm,
     if warm:
         engine.warmup()
     return ReplicaServer(rank, size, client, engine, label=label,
-                         chaos=FaultPlan.from_flags())
+                         chaos=FaultPlan.from_flags(), role=role)

@@ -40,6 +40,19 @@ accepted request alive across replica failures:
   wait, and a replica's ``retriable=False`` shed (a request bigger
   than its whole KV pool) fails immediately instead of burning the
   retry budget on an impossibility.
+* **disaggregated prefill/decode is a routing decision**: when the
+  fleet has both a ``prefill``-role and a ``decode``-role replica UP
+  (roles ride the heartbeats), dispatch goes two-stage — stage 1 sends
+  the prompt to a prefill rank (with the decode rank's ``known`` chain
+  hashes, so a warm prefix never crosses the wire), the finished KV
+  blocks come back as a ``MSG_XFER`` payload bracketed by a
+  ``kv.transfer`` span, and stage 2 lands the request + payload on the
+  chosen decode rank, which splices the blocks into its pool and
+  admits through the full-hit path. Either role pool going empty (or a
+  prefill death mid-stage-1) falls back to classic unified admission —
+  the payload is a latency optimization, never a correctness
+  dependency (:mod:`.kv_transfer`, docs/SERVING.md "Disaggregated
+  prefill/decode").
 
 Observability: ``FLEET_DISPATCH``/``FLEET_RETRIES``/``FLEET_REDISPATCH``
 /``FLEET_SHED`` counters, per-replica ``FLEET_REPLICA_STATE``/
@@ -68,15 +81,27 @@ from .. import config, trace
 from ..dashboard import Dashboard
 from ..log import Log
 from ..parallel.p2p import reconnect_backoff_s
+from . import kv_transfer
 from .batcher import DeadlineExceededError, OverloadedError
 from .replica import (LABEL, MSG_ERR, MSG_HB, MSG_PING, MSG_PONG, MSG_REQ,
-                      MSG_RSP, ROUTER_RANK, decode_msg, encode_msg)
+                      MSG_RSP, MSG_XFER, ROUTER_RANK, decode_msg,
+                      encode_msg)
 
 # replica lifecycle states; the numeric codes are the
 # FLEET_REPLICA_STATE gauge values (ordered by serviceability)
 DEAD, CONNECTING, PROBING, UP = 0, 1, 2, 3
 STATE_NAMES = {DEAD: "DEAD", CONNECTING: "CONNECTING",
                PROBING: "PROBING", UP: "UP"}
+
+# replica role codes — the FLEET_ROLE gauge values (disaggregated
+# serving). Archives written before the gauge existed read -1 in the
+# opscenter, same tolerance as the PR 8/11 gauge additions.
+ROLE_CODES = {"unified": 0, "prefill": 1, "decode": 2}
+
+# per-decode-rank shipped-hash book cap: past this the book clears and
+# rebuilds from heartbeat advertisements (a stale book only costs a
+# re-shipped block that dedups on arrival — bounded memory wins)
+_SHIPPED_CAP = 8192
 
 # NB DeadlineExceededError lives in .batcher now (both serving tiers
 # raise it); the import above keeps `from .router import
@@ -138,7 +163,8 @@ class FleetConfig:
 class _FleetRequest:
     __slots__ = ("rid", "prompt", "max_new", "session", "deadline",
                  "attempts", "future", "replica", "t_enq", "root",
-                 "dispatch_span", "redispatched", "exclude", "priority")
+                 "dispatch_span", "redispatched", "exclude", "priority",
+                 "stage", "decode_rank", "xfer", "xfer_span")
 
     def __init__(self, prompt: np.ndarray, max_new: Optional[int],
                  session: Optional[str], deadline: float, root,
@@ -157,6 +183,14 @@ class _FleetRequest:
         self.dispatch_span = None
         self.redispatched = False
         self.exclude: Optional[int] = None   # rank that just failed it
+        # disaggregated two-stage dispatch state: stage is None (plain)
+        # or "prefill" (stage 1 in flight at a prefill replica);
+        # decode_rank is the replica the KV payload is destined for;
+        # xfer holds the arrived payload while stage 2 waits to dispatch
+        self.stage: Optional[str] = None
+        self.decode_rank: Optional[int] = None
+        self.xfer: Optional[Dict[str, Any]] = None
+        self.xfer_span = None
 
 
 class _ClassQueue:
@@ -238,11 +272,12 @@ class _Replica:
     __slots__ = ("rank", "state", "last_hb", "health", "inflight",
                  "wire_dead", "probe_rid", "deaths", "readmissions",
                  "state_gauge", "inflight_gauge", "hb_age_gauge",
-                 "snap_gauge", "preempt_gauge")
+                 "snap_gauge", "preempt_gauge", "role", "role_gauge")
 
     def __init__(self, rank: int, router_name: str) -> None:
         self.rank = rank
         self.state = CONNECTING
+        self.role = "unified"               # learned from heartbeats
         self.last_hb: Optional[float] = None
         self.health: Dict[str, Any] = {}
         self.inflight: set = set()          # rids currently assigned here
@@ -266,7 +301,13 @@ class _Replica:
         # the opscenter replica rows
         self.preempt_gauge = Dashboard.get_or_create_gauge(
             f"FLEET_PREEMPTS[{router_name}.{rank}]")
+        # the replica's serving role (from its heartbeat): a
+        # disaggregated fleet's prefill/decode split at a glance in
+        # the opscenter replica rows (ROLE_CODES)
+        self.role_gauge = Dashboard.get_or_create_gauge(
+            f"FLEET_ROLE[{router_name}.{rank}]")
         self.state_gauge.set(CONNECTING)
+        self.role_gauge.set(ROLE_CODES["unified"])
 
 
 class FleetRouter:
@@ -314,6 +355,16 @@ class FleetRouter:
         self.deadline_failures = 0
         self.duplicate_replies = 0
         self.output_mismatches = 0
+        # disaggregated transfer-plane accounting + the per-decode-rank
+        # book of KV-block hashes known to be resident there (union of
+        # payloads routed to it and its heartbeat advertisements);
+        # "known" hashes are told to the prefill side so warm prefixes
+        # never cross the wire
+        self.kv_xfers = 0
+        self.kv_bytes_moved = 0
+        self.xfer_blocks = 0
+        self.xfer_dedup_blocks = 0
+        self._shipped: Dict[int, set] = {}
         self._last_death: Optional[float] = None
         self._last_recovery: Optional[float] = None
         self._dispatch_counter = Dashboard.get_or_create_counter(
@@ -516,6 +567,10 @@ class FleetRouter:
         if kind == MSG_HB:
             rep.last_hb = now
             rep.health = msg.get("health") or {}
+            role = msg.get("role") or "unified"
+            if role != rep.role and role in ROLE_CODES:
+                rep.role = role
+                rep.role_gauge.set(ROLE_CODES[role])
             if rep.state == CONNECTING:
                 self._set_state_locked(rep, UP)
             return
@@ -526,6 +581,54 @@ class FleetRouter:
                 self._set_state_locked(rep, UP)
                 Log.info("fleet: replica %d readmitted (probe %s "
                          "round-tripped)", node, msg.get("rid"))
+            return
+        if kind == MSG_XFER:
+            # stage-1 complete: a prefill replica finished chunk-
+            # prefilling and shipped the paged KV blocks. Release the
+            # prefill assignment and re-enqueue the request at the
+            # FRONT of its class as stage 2 (payload in tow) — it is
+            # the oldest work its class has, and the decode side goes
+            # live at P-1 through the full-hit admission path
+            rid = msg.get("rid")
+            req = self._inflight.get(rid)
+            if req is None:
+                return          # late duplicate / already re-dispatched
+            for holder in self._replicas.values():
+                holder.inflight.discard(rid)
+            del self._inflight[rid]
+            payload = msg.get("payload") or {}
+            shipped = kv_transfer.shipped_hashes(payload)
+            nbytes = kv_transfer.payload_bytes(payload)
+            dedup = int(payload.get("dedup_blocks", 0))
+            self.kv_xfers += 1
+            self.kv_bytes_moved += nbytes
+            self.xfer_blocks += len(shipped)
+            self.xfer_dedup_blocks += dedup
+            if req.decode_rank is not None and not payload.get("dropped"):
+                # every hash in an intact payload is resident at the
+                # decode rank after the splice (the dedup'd ones
+                # already were) — a chaos-dropped payload's blocks
+                # never arrived, so its hashes stay out of the book. A
+                # stale book only costs a re-ship that dedups on
+                # arrival; correctness never depends on it
+                book = self._shipped.setdefault(req.decode_rank, set())
+                if len(book) > _SHIPPED_CAP:
+                    book.clear()
+                book.update(payload.get("hashes") or ())
+            xsp = req.xfer_span
+            if xsp is not None:
+                req.xfer_span = None
+                xsp.end(ok=not payload.get("dropped"),
+                        xfer_blocks=len(shipped), xfer_bytes=nbytes,
+                        dedup_blocks=dedup)
+            sp = req.dispatch_span
+            if sp is not None:
+                req.dispatch_span = None
+                sp.end(ok=True)
+            req.stage = None
+            req.xfer = payload
+            req.replica = None
+            self._pending.appendleft(req)
             return
         if kind not in (MSG_RSP, MSG_ERR):
             return
@@ -627,6 +730,9 @@ class FleetRouter:
         for session, r in list(self._affinity.items()):
             if r == rep.rank:
                 del self._affinity[session]
+        # a dead decode rank's KV pool is gone with it: forget what we
+        # shipped there (its heartbeat advertisements rebuild the book)
+        self._shipped.pop(rep.rank, None)
         Log.error("fleet: replica %d DEAD (%s); re-dispatching %d "
                   "in-flight request(s)", rep.rank, why, len(drained))
         for req in drained:
@@ -642,9 +748,19 @@ class FleetRouter:
         if sp is not None:
             sp.end(error=why)
             req.dispatch_span = None
+        xsp = req.xfer_span
+        if xsp is not None:
+            xsp.end(error=why)
+            req.xfer_span = None
         self._inflight.pop(req.rid, None)
         req.exclude = req.replica        # prefer a DIFFERENT survivor
         req.replica = None
+        # a failed stage-1 re-decides its route at redispatch time: the
+        # surviving fleet may have no prefill rank left, in which case
+        # the request falls back to unified admission (any role's
+        # engine handles a plain request). A carried stage-2 payload
+        # (req.xfer) survives — the blocks are still good
+        req.stage = None
         if req.attempts > self.config.retry_max:
             self.failed += 1
             self._finish_done_locked(req.rid, None)
@@ -718,6 +834,10 @@ class FleetRouter:
             if sp is not None:
                 sp.end(error="deadline")
                 req.dispatch_span = None
+            xsp = req.xfer_span
+            if xsp is not None:
+                xsp.end(error="deadline")
+                req.xfer_span = None
             self._finish_done_locked(req.rid, None)
             resolutions.append((req, DeadlineExceededError(
                 f"fleet request {req.rid} missed its deadline "
@@ -739,8 +859,12 @@ class FleetRouter:
             expire(req)
 
     # -- dispatch ------------------------------------------------------------
-    def _pick_locked(self, req: _FleetRequest) -> Optional[_Replica]:
-        up = [rep for rep in self._replicas.values() if rep.state == UP]
+    def _pick_locked(self, req: _FleetRequest,
+                     pool: Optional[List[_Replica]] = None
+                     ) -> Optional[_Replica]:
+        up = (pool if pool is not None else
+              [rep for rep in self._replicas.values()
+               if rep.state == UP])
         if not up:
             return None
         # a retried request prefers a DIFFERENT replica than the one
@@ -752,7 +876,8 @@ class FleetRouter:
             pin = self._affinity.get(req.session)
             if pin is not None and pin != req.exclude:
                 rep = self._replicas.get(pin)
-                if rep is not None and rep.state == UP:
+                if rep is not None and rep.state == UP \
+                        and (pool is None or rep in up):
                     return rep
         def load(rep: _Replica) -> Tuple[int, int]:
             return (len(rep.inflight)
@@ -760,10 +885,57 @@ class FleetRouter:
                     rep.rank)
         return min(up, key=load)
 
+    def _role_pools_locked(self) -> Tuple[List[_Replica], List[_Replica]]:
+        prefills = [rep for rep in self._replicas.values()
+                    if rep.state == UP and rep.role == "prefill"]
+        decodes = [rep for rep in self._replicas.values()
+                   if rep.state == UP and rep.role == "decode"]
+        return prefills, decodes
+
     def _dispatch_locked(self, now: float, sends) -> None:
         while self._pending:
             req = self._pending.peek()
-            rep = self._pick_locked(req)
+            # two-stage route decision, re-made at EVERY dispatch (the
+            # role pools may have changed since the last attempt):
+            #   stage 1 — both role pools populated and no payload yet:
+            #     prefill rank computes the KV, decode rank is chosen
+            #     NOW so its cached chains can be advertised upstream;
+            #   stage 2 — payload in tow: land on the chosen decode
+            #     rank (or any survivor — the payload degrades to a
+            #     local re-prefill if its blocks cannot splice);
+            #   otherwise — classic unified admission (fallback when a
+            #   role pool is empty: every role serves plain requests).
+            prefills, decodes = self._role_pools_locked()
+            stage1 = False
+            extra: Dict[str, Any] = {}
+            if req.xfer is not None:
+                rep = None
+                if req.decode_rank is not None:
+                    cand = self._replicas.get(req.decode_rank)
+                    if cand is not None and cand.state == UP:
+                        rep = cand
+                if rep is None:
+                    rep = self._pick_locked(req, decodes or None)
+                extra["xfer"] = req.xfer
+            elif prefills and decodes:
+                dec = self._pick_locked(req, decodes)
+                rep = self._pick_locked(req, prefills)
+                if dec is not None and rep is not None:
+                    stage1 = True
+                    req.stage = "prefill"
+                    req.decode_rank = dec.rank
+                    # the decode side's known chains (our shipping book
+                    # + its own heartbeat advertisement): a warm prefix
+                    # never crosses the wire
+                    known = set(self._shipped.get(dec.rank, ()))
+                    known.update(
+                        (dec.health or {}).get("cached_chains") or ())
+                    extra["stage"] = "prefill"
+                    extra["known"] = sorted(known)
+                else:
+                    rep = self._pick_locked(req)
+            else:
+                rep = self._pick_locked(req)
             if rep is None:
                 return                   # nobody UP: requests wait
             self._pending.popleft()
@@ -772,7 +944,10 @@ class FleetRouter:
             rep.inflight.add(req.rid)
             self._inflight[req.rid] = req
             if req.session:
-                self._affinity[req.session] = rep.rank
+                # affinity pins the rank that HOLDS the KV — the
+                # decode side of a disaggregated route
+                self._affinity[req.session] = (req.decode_rank
+                                               if stage1 else rep.rank)
             self._dispatch_counter.inc()
             sp = trace.start_span(
                 "route.dispatch",
@@ -780,6 +955,16 @@ class FleetRouter:
                 else None,
                 replica=rep.rank, rid=req.rid, attempt=req.attempts)
             req.dispatch_span = sp
+            if stage1 and req.xfer_span is None:
+                # the kv.transfer span brackets the whole stage-1 →
+                # payload round trip; closed at MSG_XFER (or error'd by
+                # the requeue/deadline paths)
+                req.xfer_span = trace.start_span(
+                    "kv.transfer",
+                    parent=req.root.context
+                    if req.root is not trace.NULL_SPAN else None,
+                    rid=req.rid, prefill_replica=rep.rank,
+                    decode_replica=req.decode_rank)
             wire_ctx = None
             if sp is not trace.NULL_SPAN:
                 wire_ctx = [sp.trace_id, sp.span_id]
@@ -792,7 +977,8 @@ class FleetRouter:
                 # clock is not ours) so the replica engine's scheduler
                 # sees the same class and the same urgency
                 "prio": req.priority,
-                "deadline_ms": max(0.0, (req.deadline - now) * 1e3)})
+                "deadline_ms": max(0.0, (req.deadline - now) * 1e3),
+                **extra})
 
     # -- outbound ------------------------------------------------------------
     def _publish(self, msg: Dict[str, Any]) -> None:
@@ -875,6 +1061,10 @@ class FleetRouter:
             if sp is not None:
                 req.dispatch_span = None
                 sp.end(ok=not isinstance(outcome, Exception))
+            xsp = req.xfer_span
+            if xsp is not None:
+                req.xfer_span = None
+                xsp.end(ok=not isinstance(outcome, Exception))
             if not req.future.set_running_or_notify_cancel():
                 continue
             if isinstance(outcome, Exception):
@@ -889,6 +1079,7 @@ class FleetRouter:
             return [{
                 "rank": rep.rank,
                 "state": STATE_NAMES[rep.state],
+                "role": rep.role,
                 "inflight": len(rep.inflight),
                 "hb_age_ms": (None if rep.last_hb is None
                               else round((now - rep.last_hb) * 1e3, 1)),
@@ -932,6 +1123,15 @@ class FleetRouter:
                                   - inflight),
                 "duplicate_replies": self.duplicate_replies,
                 "output_mismatches": self.output_mismatches,
+                "kv_xfers": self.kv_xfers,
+                "kv_bytes_moved": self.kv_bytes_moved,
+                "xfer_blocks": self.xfer_blocks,
+                "xfer_dedup_blocks": self.xfer_dedup_blocks,
+                "xfer_dedup_hit_rate": (
+                    self.xfer_dedup_blocks
+                    / (self.xfer_blocks + self.xfer_dedup_blocks)
+                    if (self.xfer_blocks + self.xfer_dedup_blocks)
+                    else 0.0),
                 "deaths": sum(rep.deaths
                               for rep in self._replicas.values()),
                 "readmissions": sum(rep.readmissions

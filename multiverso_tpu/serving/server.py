@@ -12,6 +12,13 @@ Lifecycle ties into the Session: a started server registers itself, and
 ``Session.stop()`` (``mv.shutdown()``) stops serving before tables are
 torn down — the reference Zoo's shutdown-order contract extended to the
 inference plane.
+
+A fleet deployment scales this out behind :class:`~.router.FleetRouter`
+(``mvserve``), optionally with role-specialized replicas — prefill
+ranks chunk-prefill prompts and ship the finished paged-KV blocks over
+the wire to decode ranks (:mod:`.kv_transfer`, docs/SERVING.md
+"Disaggregated prefill/decode"); this in-process server is the
+``unified`` role both specializations degrade to.
 """
 
 from __future__ import annotations

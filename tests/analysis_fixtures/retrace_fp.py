@@ -181,3 +181,32 @@ class FpShardedEngine:
 
     def _iterate(self):
         return self._step(1.0) + self._chunk(2.0)
+
+
+def _build_fp_xfer_programs(fn):
+    """KV-transfer fetch/splice program builders: ONE host-gather and
+    ONE donating scatter per pool layout, built at construction by the
+    engine below (the kv_transfer one-trace contract)."""
+    fetch = jax.jit(fn)
+    splice = jax.jit(fn, donate_argnums=(0,))
+    return fetch, splice
+
+
+class FpXferEngine:
+    """RT106: the KV-transfer contract upheld — fetch/splice programs
+    built once in __init__ through a module-level builder, and the
+    transfer path DISPATCHES the handles with the block id as traced
+    data; np.asarray on the RESULT is ordinary host serialization
+    (payload packing), not a retrace."""
+
+    def __init__(self, fn):
+        self._fetch, self._splice = _build_fp_xfer_programs(fn)
+
+    def _loop(self):
+        while True:
+            self._iterate()
+
+    def _iterate(self):
+        out = self._fetch(1.0)
+        self._splice(2.0)
+        return np.asarray(out)

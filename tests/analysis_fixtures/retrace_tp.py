@@ -161,3 +161,30 @@ class Rt106SpecEngine:
     def _iterate(self):
         verify = _build_verify_step(self._fn, 4)   # RT106 builder
         return verify(4, 1.0)
+
+
+def _build_xfer_fetch(fn):
+    """A KV-transfer fetch-program builder: one host-gather program per
+    pool layout at construction time IS its job (sanctioned at module
+    level; hazardous only when the transfer path rebuilds it per
+    shipped block — see Rt106XferEngine)."""
+    return jax.jit(fn)
+
+
+class Rt106XferEngine:
+    """RT106 via the KV-transfer plane: rebuilding the block fetch /
+    splice program per TRANSFER (e.g. keying the gather on the block
+    id instead of passing it as a traced index) recompiles once per
+    shipped block — the programs must be built once per pool layout
+    and the block id must stay traced data."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def _loop(self):
+        while True:
+            self._iterate()
+
+    def _iterate(self):
+        fetch = _build_xfer_fetch(self._fn)   # RT106 builder
+        return fetch(1.0)

@@ -82,6 +82,17 @@ def test_metric_direction_rules():
     assert metric_direction("deadline_drops") == -1
     assert metric_direction("preemptions_info") == 0
     assert metric_direction("lat_p99_class0_ms_info") == 0
+    # disaggregated prefill/decode (lm_disagg A/B): raw K/V bytes over
+    # the wire regress UP (the transfer plane exists to move less of
+    # them), the dedup fraction regresses DOWN, the repeat phase is a
+    # zero-baseline gate via the kv_bytes_moved suffix, the decode-ITL
+    # ratio rides the higher-better ratio rule; TTFT/tok-per-leg _info
+    assert metric_direction("kv_bytes_moved") == -1
+    assert metric_direction("dedup_repeat_kv_bytes_moved") == -1
+    assert metric_direction("xfer_dedup_hit_rate") == 1
+    assert metric_direction("itl_p99_ratio") == 1
+    assert metric_direction("ttft_p99_ms_disagg_info") == 0
+    assert metric_direction("xfer_blocks_info") == 0
     assert metric_direction("completed") == 0       # informational
     assert metric_direction("jit_traces") == 0
     assert metric_direction("step_traces") == 0

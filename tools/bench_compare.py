@@ -93,9 +93,16 @@ from typing import Dict, List, Tuple
 # gone wrong, not traffic. output_mismatches already covers the
 # fleet's twin; capacity_seqs covers the optimistic-admission packing
 # headline via the existing higher-better rule.
+# kv_bytes_moved / xfer_dedup_hit_rate are the disaggregated-serving
+# transfer-plane pair (lm_disagg A/B): raw K/V bytes crossing the
+# prefill->decode wire regress UP (dedup-on-arrival and chain
+# advertisement exist to shrink them), and the fraction of blocks that
+# dedup'd instead of shipping regresses DOWN. The saturated tok/s of
+# each leg archives as _info — it measures the trace mix, not the code.
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
                   "capacity_seqs", "prefill_tokens_saved",
-                  "prefix_hit_rate", "accepted_per_step")
+                  "prefix_hit_rate", "accepted_per_step",
+                  "xfer_dedup_hit_rate")
 _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
                  "kv_bytes_per_device", "decode_step_retraces",
                  "watchdog_trips", "lock_order_violations",
@@ -103,7 +110,7 @@ _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
                  "output_mismatches", "recovery_time_s",
                  "updates_lost", "epoch_fence_rejections_unexpected",
                  "preempt_output_mismatches", "starved_requests",
-                 "deadline_drops")
+                 "deadline_drops", "kv_bytes_moved")
 
 
 def metric_direction(name: str) -> int:

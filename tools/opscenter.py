@@ -18,15 +18,18 @@ questions after the fact:
   serving-fleet router's per-replica gauges
   (``FLEET_REPLICA_STATE/FLEET_INFLIGHT/FLEET_HB_AGE_MS/``
   ``FLEET_SNAPSHOT_VERSION``), the table additionally renders one row
-  per decode REPLICA — lifecycle state (UP/PROBING/DEAD), in-flight
-  count, heartbeat age, the SERVED snapshot version (``snap_v``;
-  a fleet serving divergent or frozen versions — a dead or zombie
-  trainer — is visible at a glance), and the engine's cumulative
-  preemption count (``preempts``; overload churn per replica — a
-  replica preempting while its siblings idle is a routing or pool-
-  sizing problem). -1 in either column = an archive predating its
-  gauge (docs/SERVING.md "Serving fleet" / "Overload and preemption",
-  docs/DISTRIBUTED.md "Durability").
+  per decode REPLICA — lifecycle state (UP/PROBING/DEAD), serving
+  role (``role``: unified / prefill / decode from ``FLEET_ROLE``; a
+  disaggregated fleet's split at a glance — "-" = an archive predating
+  the gauge), in-flight count, heartbeat age, the SERVED snapshot
+  version (``snap_v``; a fleet serving divergent or frozen versions —
+  a dead or zombie trainer — is visible at a glance), and the engine's
+  cumulative preemption count (``preempts``; overload churn per
+  replica — a replica preempting while its siblings idle is a routing
+  or pool-sizing problem). -1 in a numeric column = an archive
+  predating its gauge (docs/SERVING.md "Serving fleet" / "Overload and
+  preemption" / "Disaggregated prefill/decode", docs/DISTRIBUTED.md
+  "Durability").
 * ``--prom`` — the merged registry as one Prometheus text exposition,
   every sample carrying a ``node`` label.
 * ``--trace OUT.json`` — the merged cross-process Perfetto document:

@@ -94,6 +94,20 @@ run — deterministic greedy decode), ``recovery_time_s`` (death
 flagged -> first replayed completion, lower-better), and the
 fault-free aggregate ``fleet_tokens_per_s``.
 
+An ``lm_disagg`` A/B prices DISAGGREGATION: the same two engines at
+equal hardware serve one mixed long-prompt / short-interactive trace
+as a 2-replica unified fleet, then as one ``prefill`` + one ``decode``
+replica behind the router's two-stage dispatch with the KV-block
+transfer plane (``serving/kv_transfer.py``). Gated:
+``output_mismatches`` at ZERO (splice-at-arrival is bit-exact),
+``itl_p99_ratio`` (unified over disagg decode ITL p99, higher-better),
+the deterministic ``kv_bytes_moved`` (every long prompt distinct,
+lower-better), ``xfer_dedup_hit_rate`` (higher-better) and
+``dedup_repeat_kv_bytes_moved`` (~0: re-submitting already-shipped
+prompts moves no bytes — dedup-on-arrival plus the router's shipped
+book). TTFT p99 and tok/s per leg archive as ``_info``
+(docs/SERVING.md "Disaggregated prefill/decode").
+
 An ``lm_trainer_chaos`` A/B prices DURABILITY (the training half's
 recovery, PR 14): the same deterministic add-and-publish stream runs
 fault-free and under a seeded ``kill_trainer_at_publish`` mid-stream,
@@ -1221,6 +1235,172 @@ def _fleet_chaos_ab(quick: bool) -> dict:
     }
 
 
+def _disagg_ab(quick: bool) -> dict:
+    """Disaggregated prefill/decode A/B (``lm_disagg``): the SAME two
+    engines serve one mixed long-prompt / short-interactive trace twice
+    over the real ``mvserve`` wire at equal hardware — as a classic
+    2-replica unified fleet, then split into one ``prefill`` and one
+    ``decode`` replica behind the router's two-stage dispatch (stage 1
+    chunk-prefills into paged KV blocks and ships them as a
+    ``kv_transfer`` payload, stage 2 splices them and admits through
+    the prefix-cache full-hit path). Gated: ``output_mismatches`` 0
+    (splice-at-arrival is bit-exact — every trace request AND the
+    sequential repeat phase compared token by token across legs),
+    ``itl_p99_ratio`` (unified decode-ITL p99 over disagg decode-ITL
+    p99 — disaggregation exists to keep decode iterations clean of
+    prefill bursts, so the ratio is higher-better), ``kv_bytes_moved``
+    (raw K/V bytes over the wire; every long prompt in the trace is
+    DISTINCT so the total is deterministic, lower-better),
+    ``xfer_dedup_hit_rate`` (higher-better) and
+    ``dedup_repeat_kv_bytes_moved`` (bytes moved when three
+    already-shipped prompts are re-submitted sequentially: ~0 — a warm
+    prefix never crosses the wire again). TTFT p99 and per-leg tok/s
+    archive as ``_info``: the disagg leg's engine-side TTFT starts at
+    stage-2 admission (the cross-stage wait lives in the
+    ``kv.transfer`` span, not this histogram), and tok/s sits on the
+    2-CPU noise floor."""
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import FleetConfig, FleetRouter
+    from multiverso_tpu.serving.decode_engine import (DecodeEngine,
+                                                      DecodeEngineConfig)
+    from multiverso_tpu.serving.replica import ReplicaServer
+
+    max_prompt, cap, block = 16, 12, 4
+    cfg = TransformerConfig(vocab_size=256, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=128, max_seq=48)
+    engines = []
+    for r in (1, 2):
+        # SAME config (same param seed) on both: replicas are replicas,
+        # and the A/B's bit-exactness gate depends on it
+        engine = DecodeEngine(f"disagg_r{r}", TransformerLM(cfg),
+                              DecodeEngineConfig(
+                                  slots=4, max_prompt=max_prompt,
+                                  max_new=cap, max_queue=64,
+                                  kv_block_size=block, kv_pool_blocks=64,
+                                  prefill_token_budget=8,
+                                  prefix_cache=True, watchdog=False))
+        engine.warmup()
+        engines.append(engine)
+    n = 16 if quick else 32
+    rng = np.random.default_rng(53)
+    # Mixed trace: even slots are block-aligned LONG prompts (4 full
+    # blocks each, all DISTINCT — so the disagg leg's shipped-bytes
+    # total is exactly n/2 payloads of 4 blocks, deterministic run to
+    # run), odd slots are 2-3 token interactive prompts (no full
+    # block: nothing ships, stage 2 re-prefills them in one chunk).
+    trace, longs, t = [], [], 0.0
+    for i in range(n):
+        t += float(rng.exponential(0.004))
+        if i % 2 == 0:
+            prompt = rng.integers(1, 256, max_prompt).astype(np.int32)
+            longs.append(prompt)
+            n_new = 4 + int(rng.integers(0, 5))
+        else:
+            prompt = rng.integers(
+                1, 256, int(rng.integers(2, 4))).astype(np.int32)
+            n_new = 6 + int(rng.integers(0, 5))
+        trace.append((t, prompt, n_new))
+    useful = sum(n_new for _, _, n_new in trace)
+    legs: dict = {}
+    try:
+        for label, roles in (("unified", ("unified", "unified")),
+                             ("disagg", ("prefill", "decode"))):
+            for engine in engines:
+                # cold caches + zeroed histograms per leg: the unified
+                # leg's warm prefixes must not inflate the disagg leg's
+                # dedup numbers, and per-leg ITL/TTFT must not mix
+                engine._pool.flush_cache()
+                engine.reset_stats()
+            kv = _ObsBenchKV()
+            router = FleetRouter(3, kv, label=f"bench_disagg_{label}",
+                                 fleet_config=FleetConfig(
+                                     heartbeat_ms=100, deadline_s=120.0))
+            replicas = []
+            try:
+                for i, engine in enumerate(engines):
+                    replicas.append(ReplicaServer(
+                        i + 1, 3, kv, engine,
+                        label=f"bench_disagg_{label}",
+                        heartbeat_ms=100, role=roles[i]))
+                deadline = time.monotonic() + 60
+                while (router.stats()["up"] < 2
+                       or [r["role"] for r in router.replica_rows()]
+                       != list(roles)):
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(f"fleet never came up: "
+                                           f"{router.replica_rows()}")
+                    time.sleep(0.01)
+                futs = []
+                t0 = time.monotonic()
+                for i, (at, prompt, n_new) in enumerate(trace):
+                    delay = at - (time.monotonic() - t0)
+                    if delay > 0:
+                        time.sleep(delay)
+                    futs.append(router.submit(prompt, n_new,
+                                              session=f"s{i % 6}"))
+                outs = [np.asarray(f.result(timeout=300)["result"],
+                                   np.int32) for f in futs]
+                elapsed = time.monotonic() - t0
+                # sequential repeat phase: the first three long prompts
+                # again, one at a time, after the trace drained — in
+                # the disagg leg their chains sit in the router's
+                # shipped book, so the prefill replica ships ZERO bytes
+                # (dedup-at-source); outputs must still match the
+                # unified leg's repeats bit-exactly
+                b0 = router.stats()["kv_bytes_moved"]
+                for j, p in enumerate(longs[:3]):
+                    outs.append(np.asarray(
+                        router.submit(p, 6, session=f"rep{j}")
+                        .result(timeout=300)["result"], np.int32))
+                st = router.stats()
+                legs[label] = {
+                    "outs": outs, "elapsed": elapsed, "stats": st,
+                    "repeat_bytes": st["kv_bytes_moved"] - b0,
+                    "engine_stats": [e.stats() for e in engines],
+                }
+            finally:
+                # a failed leg must not leave router/replica threads
+                # ticking (and holding sockets) under later workloads
+                router.stop()
+                for rep in replicas:
+                    rep.stop(stop_engine=False)
+    finally:
+        for engine in engines:
+            engine.stop()
+    mismatches = sum(
+        1 for a, b in zip(legs["unified"]["outs"], legs["disagg"]["outs"])
+        if a.shape != b.shape or not np.array_equal(a, b))
+    dstats = legs["disagg"]["stats"]
+    uni_itl = max(e["itl_p99_ms"]
+                  for e in legs["unified"]["engine_stats"])
+    dec_itl = legs["disagg"]["engine_stats"][1]["itl_p99_ms"]
+    uni_ttft = max(e["ttft_p99_ms"]
+                   for e in legs["unified"]["engine_stats"])
+    dec_ttft = legs["disagg"]["engine_stats"][1]["ttft_p99_ms"]
+    return {
+        "requests": n,
+        "useful_tokens": useful,
+        "output_mismatches": mismatches + dstats["output_mismatches"],
+        "requests_lost": dstats["requests_lost"],
+        "itl_p99_ratio": round(uni_itl / dec_itl, 3) if dec_itl else 0.0,
+        "kv_bytes_moved": dstats["kv_bytes_moved"],
+        "xfer_dedup_hit_rate": round(dstats["xfer_dedup_hit_rate"], 4),
+        "dedup_repeat_kv_bytes_moved": legs["disagg"]["repeat_bytes"],
+        "kv_xfers_info": dstats["kv_xfers"],
+        "xfer_blocks_info": dstats["xfer_blocks"],
+        "xfer_dedup_blocks_info": dstats["xfer_dedup_blocks"],
+        "tokens_per_s_unified_info": round(
+            useful / legs["unified"]["elapsed"], 1),
+        "tokens_per_s_disagg_info": round(
+            useful / legs["disagg"]["elapsed"], 1),
+        "ttft_p99_ms_unified_info": round(uni_ttft, 3),
+        "ttft_p99_ms_disagg_info": round(dec_ttft, 3),
+        "itl_p99_ms_unified_info": round(uni_itl, 3),
+        "itl_p99_ms_disagg_info": round(dec_itl, 3),
+    }
+
+
 def _trainer_chaos_ab(quick: bool) -> dict:
     """Durable online learning A/B (``lm_trainer_chaos``): one
     deterministic add-and-publish stream runs twice over the real
@@ -1536,6 +1716,12 @@ def run(duration_s: float = 2.0, clients: int = 32,
     # are recovery invariants (counts), but recovery_time_s is a wall
     # clock that should not absorb 32 saturating client threads
     out["workloads"]["lm_fleet_chaos"] = _fleet_chaos_ab(quick)
+    # disaggregated prefill/decode A/B rides the same wire plane: the
+    # same two engines as a unified pair vs a prefill+decode split at
+    # equal hardware — bit-exactness, the decode-ITL ratio and the
+    # deterministic KV wire bytes gated, dedup proven by a zero-byte
+    # sequential repeat phase
+    out["workloads"]["lm_disagg"] = _disagg_ab(quick)
     # trainer-chaos A/B next to it: the TRAINING half's recovery
     # invariants (checkpoint+WAL exactness, epoch fencing, staleness
     # choreography) — count-led gates plus one restart wall clock that

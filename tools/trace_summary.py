@@ -219,6 +219,19 @@ def request_report(spans, device_events=None):
         if preempts or resumed:
             row["preempted"] = (resumed[-1]["args"]["preempted"]
                                 if resumed else preempts)
+        # disaggregated requests: the decode.admit and kv.transfer
+        # spans carry the transfer-plane accounting — blocks shipped,
+        # raw K/V bytes moved, and blocks that dedup'd instead of
+        # crossing the wire (a fat xfkb next to a zero dedup column
+        # says the decode side's cache was cold for this prefix)
+        xfers = [s for s in group if s["name"] == "kv.transfer"]
+        annotated = ([a for a in admits if "xfer_blocks" in a["args"]]
+                     + [x for x in xfers if "xfer_blocks" in x["args"]])
+        if annotated:
+            src = annotated[0]["args"]
+            row["xfer_blocks"] = src["xfer_blocks"]
+            row["xfer_bytes"] = src.get("xfer_bytes", 0)
+            row["dedup_blocks"] = src.get("dedup_blocks", 0)
         if device:
             w0, w1 = root["ts"], root["ts"] + root["dur"]
             row["device_ms"] = sum(
@@ -237,6 +250,7 @@ def print_request_report(rows, top: int, sort: str,
     has_prefix = any("prefix_hit_blocks" in r for r in rows)
     has_tp = any("decode_tp" in r for r in rows)
     has_preempt = any("preempted" in r for r in rows)
+    has_xfer = any("xfer_blocks" in r for r in rows)
     has_keep = any(r.get("keep") for r in rows)
     # the node column ships as soon as the doc holds more than one
     # recording process (an obs-plane merged fleet trace); single-node
@@ -261,6 +275,8 @@ def print_request_report(rows, top: int, sort: str,
         hdr += f" {'tp':>3}"
     if has_preempt:
         hdr += f" {'preempt':>8}"
+    if has_xfer:
+        hdr += f" {'xfblk':>6} {'xfkb':>8} {'dedup':>6}"
     if has_dev:
         hdr += f" {'device':>9}"
     if has_keep:
@@ -284,6 +300,13 @@ def print_request_report(rows, top: int, sort: str,
             line += f" {str(r.get('decode_tp', '-')):>3}"
         if has_preempt:
             line += f" {str(r.get('preempted', '-')):>8}"
+        if has_xfer:
+            if "xfer_blocks" in r:
+                line += (f" {r['xfer_blocks']:6d} "
+                         f"{r.get('xfer_bytes', 0) / 1024.0:8.1f} "
+                         f"{r.get('dedup_blocks', 0):6d}")
+            else:
+                line += f" {'-':>6} {'-':>8} {'-':>6}"
         if has_dev:
             line += f" {r.get('device_ms', 0.0):9.3f}"
         if has_keep:
