@@ -258,6 +258,42 @@ define_int("decode_tp", 1,
            "1 = single-device replicated decode (replicate_for_decode, "
            "the pre-PR 9 path). Needs kv_block_size > 0, "
            "decode_tp | n_heads and decode_tp | d_ff")
+define_string("kv_quant", "none",
+              "decode engine: paged KV cache storage precision — 'int8' "
+              "stores both pools as int8 with a per-(layer, block) fp32 "
+              "scale array riding the jitted programs as traced data "
+              "(quantize-on-write, dequantize-on-gather; one compiled "
+              "trace per engine config exactly as fp32), so the same "
+              "pool-byte budget holds ~4x the blocks "
+              "(block_pool.kv_bytes_per_block reports the real quantized "
+              "+ scales footprint). 'none' = fp32 pools, bit-identical "
+              "to the pre-quantization engine. Needs kv_block_size > 0; "
+              "quality face: argmax-match rate vs the fp32 oracle "
+              "(docs/SERVING.md 'Quantized KV & params')")
+define_string("decode_param_quant", "none",
+              "decode engine: pinned param snapshot precision — 'int8' "
+              "quantizes each snapshot leaf symmetric per-tensor (per-"
+              "column for matrices) ON THE HOST once per pinned version, "
+              "shrinking the per-version pin copy (the one cross-mesh "
+              "device_put) and per-device param bytes ~4x; dequant is "
+              "folded into the pre-partitioned decode programs at "
+              "compile time, so pin_copies memoization and "
+              "decode_step_retraces == 0 survive. 'none' = fp32 pins")
+define_bool("param_wire_compress", True,
+            "param plane: route publish_delta/publish_keyed payloads "
+            "through the reference SparseFilter (quantization.py) before "
+            "the mvparam wire — sparse-ish deltas ship as (index, value) "
+            "pairs, dense ones pass through untouched (lossless either "
+            "way; subscribers decode transparently by payload shape). "
+            "publish_bytes / wire_compressed_ratio land in publisher "
+            "stats (docs/OBSERVABILITY.md)")
+define_string("param_wire_quant", "none",
+              "param plane: optional LOSSY int8 delta codec — 'int8' "
+              "ships publish_delta/publish_keyed values as int8 with one "
+              "fp32 per-record scale (~4x fewer wire bytes on top of "
+              "-param_wire_compress; subscribers dequantize "
+              "transparently). 'none' = exact values (default: the "
+              "publish stream stays bit-exact)")
 define_bool("prefix_cache", True,
             "decode engine: content-addressed KV block reuse over the "
             "paged pool — full blocks get a hash-chained identity, "

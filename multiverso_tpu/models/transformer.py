@@ -831,9 +831,393 @@ def cow_block_copy(k_pool: jax.Array, v_pool: jax.Array, src: jax.Array,
             v_pool.at[:, dst].set(v_pool[:, src]))
 
 
+# -- serving: int8 per-block-scaled paged KV cache ----------------------------
+#
+# The ``_q`` variants below store the paged pools as int8 with ONE fp32
+# scale per (layer, block) — ``k_scales``/``v_scales`` [L, N] arrays that
+# ride every program as TRACED OPERANDS next to the block tables (never
+# static), so the one-compiled-trace-per-engine-config invariant is
+# untouched: which blocks hold what scale is data, exactly like which
+# blocks a slot owns.
+#
+# Write semantics (quantize-on-write): gather the affected blocks,
+# dequantize with the OLD scale, insert the new fp32 rows, then requantize
+# the whole block against ``new_scale = max(old_scale, rowmax / 127)``.
+# Two properties make this sound:
+#
+# * **identity when the scale is unchanged** — ``round(q * s / s) == q``
+#   exactly for |q| <= 127 in fp32, so re-quantizing untouched rows (and
+#   untouched blocks swept up by a whole-row scatter: scratch padding,
+#   shared prefix blocks visible from several tables) rewrites their
+#   exact bytes — repeated writes cause NO drift, and duplicate scatters
+#   carry identical values (deterministic). A scale GROWTH re-rounds the
+#   block's earlier rows once onto the coarser grid — the per-block-scale
+#   trade, bounded by one rounding step.
+# * **reset at block entry** — the first write into a block (block-local
+#   offset 0) discards the previous occupant's scale instead of
+#   max-merging it, so a freed-and-reallocated block cannot ratchet the
+#   pool's scales up forever. The stale occupant's rows requantize as
+#   clipped garbage under the new scale — finite, and never reachable by
+#   a live attention mask before being overwritten (the standard pad
+#   contract).
+#
+# Reads (dequantize-on-gather) multiply the gathered int8 view by its
+# gathered scales before attention, so the operand shape (and masking)
+# matches the fp32 kernels exactly; quality is measured as argmax-match
+# rate against the fp32 oracle (docs/SERVING.md "Quantized KV & params").
+
+_KV_QMAX = 127.0
+
+
+def _kv_q_safe(scale: jax.Array) -> jax.Array:
+    """Zero-divide guard: an all-zero (never-written / reset) block keeps
+    scale 0 and dequantizes to exact zeros; dividing by 1 there quantizes
+    zeros to zeros."""
+    return jnp.where(scale > 0, scale, jnp.ones_like(scale))
+
+
+def _kv_q_requant(rows: jax.Array, scale: jax.Array) -> jax.Array:
+    """fp32 ``rows`` [..., Bs, D] against per-block ``scale`` [...] ->
+    int8 (symmetric, clipped)."""
+    q = jnp.round(rows / _kv_q_safe(scale)[..., None, None])
+    return jnp.clip(q, -_KV_QMAX, _KV_QMAX).astype(jnp.int8)
+
+
+def _kv_q_dequant(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """int8 blocks [..., Bs, D] * per-block ``scale`` [...] -> fp32."""
+    return q.astype(jnp.float32) * scale[..., None, None]
+
+
+def decode_step_paged_q(cfg: TransformerConfig, params: Dict[str, Any],
+                        k_pool: jax.Array, v_pool: jax.Array,
+                        k_scales: jax.Array, v_scales: jax.Array,
+                        block_tables: jax.Array, tok: jax.Array,
+                        pos: jax.Array, active: jax.Array,
+                        t_logical: Optional[int] = None
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                   jax.Array, jax.Array, jax.Array]:
+    """Quantized :func:`decode_step_paged`: int8 pools [L, N, Bs, D] +
+    fp32 ``k_scales``/``v_scales`` [L, N]. Each live slot writes exactly
+    ONE block (exclusively owned — the engine CoWs shared blocks before
+    any write), so the write is a per-slot gather/requant/scatter of that
+    block; dead lanes park on scratch, where order-undefined duplicates
+    are unobservable exactly as in the fp32 kernel.
+
+    Returns ``(k_pool, v_pool, k_scales, v_scales, next_tok, pos)``.
+    """
+    S = tok.shape[0]
+    Bs = k_pool.shape[2]
+    M = block_tables.shape[1]
+    T = M * Bs if t_logical is None else int(t_logical)
+    blk = jnp.take_along_axis(block_tables, (pos // Bs)[:, None],
+                              axis=1)[:, 0]
+    write_blk = jnp.where(active, blk, 0)      # dead lanes -> scratch
+    write_off = jnp.where(active, pos % Bs, 0)
+    lanes = jnp.arange(S)
+    h = (jnp.take(params["embed"], tok, axis=0)
+         + jnp.take(params["pos"], pos, axis=0))
+
+    def write(pool, scales, rows):
+        cur_s = jnp.take(scales, write_blk, axis=0)            # [S]
+        cur = _kv_q_dequant(jnp.take(pool, write_blk, axis=0), cur_s)
+        rows32 = rows.astype(jnp.float32)
+        cur = cur.at[lanes, write_off].set(rows32)
+        # entering a fresh block (offset 0) drops the prior occupant's
+        # scale; otherwise scales only grow within an occupancy
+        base = jnp.where(write_off == 0, 0.0, cur_s)
+        new_s = jnp.maximum(base,
+                            jnp.max(jnp.abs(rows32), axis=-1) / _KV_QMAX)
+        return (pool.at[write_blk].set(_kv_q_requant(cur, new_s)),
+                scales.at[write_blk].set(new_s))
+
+    for i in range(cfg.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["layers"])
+        x = _rmsnorm(h, layer["ln1_g"])
+        q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
+        kp, ks = write(k_pool[i], k_scales[i], k)
+        vp, vs = write(v_pool[i], v_scales[i], v)
+        k_pool, k_scales = k_pool.at[i].set(kp), k_scales.at[i].set(ks)
+        v_pool, v_scales = v_pool.at[i].set(vp), v_scales.at[i].set(vs)
+        kv_shape = (S, M * Bs, -1)
+        kc = _kv_q_dequant(
+            jnp.take(k_pool[i], block_tables, axis=0),
+            jnp.take(k_scales[i], block_tables, axis=0)
+        ).astype(h.dtype).reshape(kv_shape)
+        vc = _kv_q_dequant(
+            jnp.take(v_pool[i], block_tables, axis=0),
+            jnp.take(v_scales[i], block_tables, axis=0)
+        ).astype(h.dtype).reshape(kv_shape)
+        h = h + _cached_attention(
+            q, kc[:, :T], vc[:, :T], cfg.n_heads, pos) @ layer["w_o"]
+        x = _rmsnorm(h, layer["ln2_g"])
+        h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+    h = _rmsnorm(h, params["ln_f_g"])
+    out = jnp.einsum("sd,vd->sv", h, params["embed"],
+                     preferred_element_type=jnp.float32)
+    nxt = jnp.argmax(out, axis=-1).astype(tok.dtype)
+    nxt = jnp.where(active, nxt, jnp.zeros_like(nxt))
+    pos = jnp.where(active, pos + 1, pos)
+    return k_pool, v_pool, k_scales, v_scales, nxt, pos
+
+
+def prefill_chunk_paged_q(cfg: TransformerConfig, params: Dict[str, Any],
+                          k_pool: jax.Array, v_pool: jax.Array,
+                          k_scales: jax.Array, v_scales: jax.Array,
+                          block_tables: jax.Array, slot: jax.Array,
+                          tokens: jax.Array, offset: jax.Array,
+                          length: jax.Array, t_logical: Optional[int] = None
+                          ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                     jax.Array, jax.Array]:
+    """Quantized :func:`prefill_chunk_paged`: the chunk's writes span
+    several blocks of ONE slot, so the kernel works on the slot's whole
+    table row — gather all M blocks, dequantize, scatter the chunk's
+    rows into the flat [M*Bs, D] view (invalid lanes get out-of-range
+    indices and DROP — the paged pad contract without the scratch
+    detour), fold per-block scale contributions in with a scatter-max,
+    requantize the row, scatter it back. Untouched blocks requantize to
+    their exact old bytes (identity), so the row-wide scatter is safe.
+
+    Returns ``(k_pool, v_pool, k_scales, v_scales, last_logits)``.
+    """
+    C = tokens.shape[0]
+    Bs = k_pool.shape[2]
+    M = block_tables.shape[1]
+    T = M * Bs if t_logical is None else int(t_logical)
+    bt_row = jax.lax.dynamic_index_in_dim(block_tables, slot, 0,
+                                          keepdims=False)        # [M]
+    pos_ix = offset + jnp.arange(C)
+    valid = jnp.arange(C) < length
+    flat_ix = jnp.where(valid, pos_ix, M * Bs)       # OOB lanes drop
+    blk_local = jnp.where(valid, jnp.clip(pos_ix // Bs, 0, M - 1), M)
+    fresh = (valid & (pos_ix % Bs == 0)).astype(jnp.float32)
+    h = (jnp.take(params["embed"], tokens, axis=0)
+         + jnp.take(params["pos"], pos_ix, axis=0))
+
+    def write(pool, scales, rows):
+        row_s = jnp.take(scales, bt_row, axis=0)                 # [M]
+        flat = _kv_q_dequant(jnp.take(pool, bt_row, axis=0),
+                             row_s).reshape(M * Bs, -1)
+        rows32 = rows.astype(jnp.float32)
+        flat = flat.at[flat_ix].set(rows32, mode="drop")
+        reset = jnp.zeros((M,), jnp.float32).at[blk_local].max(
+            fresh, mode="drop") > 0
+        contrib = jnp.zeros((M,), jnp.float32).at[blk_local].max(
+            jnp.where(valid, jnp.max(jnp.abs(rows32), axis=-1), 0.0),
+            mode="drop")
+        new_s = jnp.maximum(jnp.where(reset, 0.0, row_s),
+                            contrib / _KV_QMAX)
+        new_q = _kv_q_requant(flat.reshape(M, Bs, -1), new_s)
+        return (pool.at[bt_row].set(new_q), scales.at[bt_row].set(new_s),
+                _kv_q_dequant(new_q, new_s).reshape(M * Bs, -1))
+
+    for i in range(cfg.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["layers"])
+        x = _rmsnorm(h, layer["ln1_g"])
+        q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
+        kp, ks, kc = write(k_pool[i], k_scales[i], k)
+        vp, vs, vc = write(v_pool[i], v_scales[i], v)
+        k_pool, k_scales = k_pool.at[i].set(kp), k_scales.at[i].set(ks)
+        v_pool, v_scales = v_pool.at[i].set(vp), v_scales.at[i].set(vs)
+        h = h + _chunk_attention(
+            q, kc[:T].astype(h.dtype), vc[:T].astype(h.dtype),
+            cfg.n_heads, offset) @ layer["w_o"]
+        x = _rmsnorm(h, layer["ln2_g"])
+        h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+    h = _rmsnorm(h, params["ln_f_g"])
+    last = jnp.take(h, length - 1, axis=0)
+    logits = jnp.einsum("d,vd->v", last, params["embed"],
+                        preferred_element_type=jnp.float32)
+    return k_pool, v_pool, k_scales, v_scales, logits
+
+
+def verify_step_paged_q(cfg: TransformerConfig, params: Dict[str, Any],
+                        k_pool: jax.Array, v_pool: jax.Array,
+                        k_scales: jax.Array, v_scales: jax.Array,
+                        block_tables: jax.Array, toks: jax.Array,
+                        pos: jax.Array, active: jax.Array,
+                        n_valid: jax.Array, t_logical: Optional[int] = None
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                   jax.Array, jax.Array]:
+    """Quantized :func:`verify_step_paged`: the whole-row form of
+    :func:`prefill_chunk_paged_q` per slot — a window can write several
+    positions of one block, so per-position block scatters would race;
+    instead every slot's full table row round-trips through fp32. Blocks
+    a slot does not validly write (shared prefix blocks visible from
+    several rows, scratch padding) requantize to their exact old bytes,
+    so the cross-slot duplicate scatters all carry identical values.
+
+    Returns ``(k_pool, v_pool, k_scales, v_scales, out_tok [S, K1])``.
+    """
+    S, K1 = toks.shape
+    Bs = k_pool.shape[2]
+    M = block_tables.shape[1]
+    T = M * Bs if t_logical is None else int(t_logical)
+    pos_ix = pos[:, None] + jnp.arange(K1)[None, :]            # [S, K1]
+    valid = (jnp.arange(K1)[None, :] < n_valid[:, None]) & active[:, None]
+    flat_ix = jnp.where(valid, pos_ix, M * Bs)       # OOB lanes drop
+    blk_local = jnp.where(valid, jnp.clip(pos_ix // Bs, 0, M - 1), M)
+    fresh = (valid & (pos_ix % Bs == 0)).astype(jnp.float32)
+    lanes = jnp.arange(S)[:, None]
+    h = (jnp.take(params["embed"], toks, axis=0)
+         + jnp.take(params["pos"], pos_ix, axis=0))
+
+    def write(pool, scales, rows):
+        rows_s = jnp.take(scales, block_tables, axis=0)        # [S, M]
+        flat = _kv_q_dequant(jnp.take(pool, block_tables, axis=0),
+                             rows_s).reshape(S, M * Bs, -1)
+        rows32 = rows.astype(jnp.float32)
+        flat = flat.at[lanes, flat_ix].set(rows32, mode="drop")
+        reset = jnp.zeros((S, M), jnp.float32).at[lanes, blk_local].max(
+            fresh, mode="drop") > 0
+        contrib = jnp.zeros((S, M), jnp.float32).at[lanes, blk_local].max(
+            jnp.where(valid, jnp.max(jnp.abs(rows32), axis=-1), 0.0),
+            mode="drop")
+        new_s = jnp.maximum(jnp.where(reset, 0.0, rows_s),
+                            contrib / _KV_QMAX)
+        new_q = _kv_q_requant(flat.reshape(S, M, Bs, -1), new_s)
+        return (pool.at[block_tables].set(new_q),
+                scales.at[block_tables].set(new_s),
+                _kv_q_dequant(new_q, new_s).reshape(S, M * Bs, -1))
+
+    for i in range(cfg.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["layers"])
+        x = _rmsnorm(h, layer["ln1_g"])
+        q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
+        kp, ks, kc = write(k_pool[i], k_scales[i], k)
+        vp, vs, vc = write(v_pool[i], v_scales[i], v)
+        k_pool, k_scales = k_pool.at[i].set(kp), k_scales.at[i].set(ks)
+        v_pool, v_scales = v_pool.at[i].set(vp), v_scales.at[i].set(vs)
+        h = h + _verify_attention(
+            q, kc[:, :T].astype(h.dtype), vc[:, :T].astype(h.dtype),
+            cfg.n_heads, pos) @ layer["w_o"]
+        x = _rmsnorm(h, layer["ln2_g"])
+        h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+    h = _rmsnorm(h, params["ln_f_g"])
+    out = jnp.einsum("skd,vd->skv", h, params["embed"],
+                     preferred_element_type=jnp.float32)
+    nxt = jnp.argmax(out, axis=-1).astype(toks.dtype)
+    return (k_pool, v_pool, k_scales, v_scales,
+            jnp.where(valid, nxt, jnp.zeros_like(nxt)))
+
+
+def cache_insert_paged_q(k_pool: jax.Array, v_pool: jax.Array,
+                         k_scales: jax.Array, v_scales: jax.Array,
+                         block_tables: jax.Array, ks: jax.Array,
+                         vs: jax.Array
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                    jax.Array]:
+    """Quantized :func:`cache_insert_paged`: b whole prompts' fp32 K/V
+    [L, b, P, D] quantize through per-row block tables. Positions write
+    from 0, so every written block's offset 0 is covered — its scale
+    resets from the fresh data (the reallocation contract). Pad rows
+    point at scratch, where order-undefined duplicates stay unobservable.
+    """
+    L, b, P, _ = ks.shape
+    Bs = k_pool.shape[2]
+    M = block_tables.shape[1]
+    p_ix = jnp.arange(P)
+    loc = jnp.clip(p_ix // Bs, 0, M - 1)
+    flat_ix = jnp.broadcast_to(loc * Bs + p_ix % Bs, (b, P))
+    fresh = jnp.broadcast_to((p_ix % Bs == 0).astype(jnp.float32), (b, P))
+    rows_ix = jnp.arange(b)[:, None]
+    loc_b = jnp.broadcast_to(loc, (b, P))
+
+    def write(pool, scales, rows):
+        rows_s = jnp.take(scales, block_tables, axis=0)        # [b, M]
+        flat = _kv_q_dequant(jnp.take(pool, block_tables, axis=0),
+                             rows_s).reshape(b, M * Bs, -1)
+        rows32 = rows.astype(jnp.float32)
+        flat = flat.at[rows_ix, flat_ix].set(rows32)
+        reset = jnp.zeros((b, M), jnp.float32).at[rows_ix, loc_b].max(
+            fresh) > 0
+        contrib = jnp.zeros((b, M), jnp.float32).at[rows_ix, loc_b].max(
+            jnp.max(jnp.abs(rows32), axis=-1))
+        new_s = jnp.maximum(jnp.where(reset, 0.0, rows_s),
+                            contrib / _KV_QMAX)
+        new_q = _kv_q_requant(flat.reshape(b, M, Bs, -1), new_s)
+        return (pool.at[block_tables].set(new_q),
+                scales.at[block_tables].set(new_s))
+
+    for i in range(L):
+        kp, ksc = write(k_pool[i], k_scales[i], ks[i])
+        vp, vsc = write(v_pool[i], v_scales[i], vs[i])
+        k_pool, k_scales = k_pool.at[i].set(kp), k_scales.at[i].set(ksc)
+        v_pool, v_scales = v_pool.at[i].set(vp), v_scales.at[i].set(vsc)
+    return k_pool, v_pool, k_scales, v_scales
+
+
+def admit_insert_paged_q(cfg: TransformerConfig, params: Dict[str, Any],
+                         k_pool: jax.Array, v_pool: jax.Array,
+                         k_scales: jax.Array, v_scales: jax.Array,
+                         block_tables: jax.Array, tokens: jax.Array,
+                         lengths: jax.Array
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                    jax.Array, jax.Array]:
+    """Quantized :func:`admit_insert_paged`: the fp32 whole-prompt
+    prefill and first-token argmax are unchanged (the first token is
+    computed BEFORE quantization, like the chunked path's final-chunk
+    logits); only the cache insert quantizes."""
+    logits, ks, vs = prefill(cfg, params, tokens)
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    first = jnp.argmax(last, axis=-1).astype(tokens.dtype)
+    k_pool, v_pool, k_scales, v_scales = cache_insert_paged_q(
+        k_pool, v_pool, k_scales, v_scales, block_tables, ks, vs)
+    return first, k_pool, v_pool, k_scales, v_scales
+
+
+def cow_block_copy_q(k_pool: jax.Array, v_pool: jax.Array,
+                     k_scales: jax.Array, v_scales: jax.Array,
+                     src: jax.Array, dst: jax.Array
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                jax.Array]:
+    """Quantized :func:`cow_block_copy`: the duplicate carries its
+    source's int8 bytes AND its scale column — content-identical by
+    construction."""
+    return (k_pool.at[:, dst].set(k_pool[:, src]),
+            v_pool.at[:, dst].set(v_pool[:, src]),
+            k_scales.at[:, dst].set(k_scales[:, src]),
+            v_scales.at[:, dst].set(v_scales[:, src]))
+
+
+# -- serving: quantized decode param snapshots --------------------------------
+
+
+def _is_quant_param_leaf(x: Any) -> bool:
+    return isinstance(x, dict) and set(x.keys()) == {"q", "s"}
+
+
+def dequantize_decode_params(qparams: Any, dtype=jnp.float32) -> Any:
+    """Traced inverse of :func:`serving.snapshot.quantize_decode_params`:
+    each ``{"q": int8, "s": fp32}`` leaf multiplies out to ``dtype``.
+    Expressed as ordinary jnp ops at the TOP of a jitted decode program,
+    so XLA folds the dequant into the compiled module — per-device param
+    residency is the int8 pytree, and the program's one-trace accounting
+    never notices (``decode_step_retraces`` stays 0)."""
+    return jax.tree.map(
+        lambda leaf: (leaf["q"].astype(jnp.float32)
+                      * leaf["s"]).astype(dtype),
+        qparams, is_leaf=_is_quant_param_leaf)
+
+
+def decode_param_quant_shardings(mesh, tp_axis: str = DECODE_TP_AXIS
+                                 ) -> Dict[str, Any]:
+    """Decode-mesh shardings for the QUANTIZED param pytree: each leaf's
+    ``q`` carries the weight's :func:`decode_param_shardings` spec (same
+    shape as the weight, so the spec applies unchanged) and the tiny
+    ``s`` scales REPLICATE — a keepdims per-column scale has a size-1
+    dim exactly where the row-parallel specs shard, so replication is
+    the only layout that fits every leaf (and costs ~nothing)."""
+    rep = NamedSharding(mesh, P())
+    return jax.tree.map(lambda s: {"q": s, "s": rep},
+                        decode_param_shardings(mesh, tp_axis))
+
+
 def make_sharded_decode_programs(cfg: TransformerConfig, mesh,
                                  t_logical: int, donate: bool = False,
-                                 tp_axis: str = DECODE_TP_AXIS
+                                 tp_axis: str = DECODE_TP_AXIS,
+                                 kv_quant: str = "none",
+                                 param_quant: str = "none"
                                  ) -> Dict[str, Any]:
     """Pre-partitioned decode-mesh variants of the paged serving programs.
 
@@ -850,27 +1234,88 @@ def make_sharded_decode_programs(cfg: TransformerConfig, mesh,
     through these programs never re-enters the spmd partitioner after
     the first compile — the construction-time contract ``DecodeEngine``
     builds these under (``__init__``/``warmup`` only; RT106).
+
+    ``kv_quant="int8"`` returns the quantized program set instead: each
+    program additionally takes/returns the fp32 ``k_scales``/``v_scales``
+    [L, N] operands (REPLICATED — they are KBs next to the pools' MBs,
+    and the per-block scale multiplies the full ``D`` of its block, so a
+    head-shard would buy nothing), with the int8 pools still sharded
+    over the head slice of ``D``. ``param_quant="int8"`` makes every
+    program accept the quantized param pytree
+    (:func:`serving.snapshot.quantize_decode_params` leaves), sharded per
+    :func:`decode_param_quant_shardings`, with
+    :func:`dequantize_decode_params` folded in at compile time. Both
+    default off; the default programs are exactly the pre-quantization
+    ones.
     """
-    ps = decode_param_shardings(mesh, tp_axis)
+    if param_quant == "int8":
+        ps = decode_param_quant_shardings(mesh, tp_axis)
+        pf = lambda p: dequantize_decode_params(p, cfg.dtype)
+    else:
+        ps = decode_param_shardings(mesh, tp_axis)
+        pf = lambda p: p
     pool = kv_pool_sharding(mesh, tp_axis)
     rep = NamedSharding(mesh, P())
     T = int(t_logical)
+    if kv_quant == "int8":
+        # pools at positions 1-2, scales at 3-4: donate all four (the
+        # scales round-trip every program exactly like the pools)
+        kv_donate = (1, 2, 3, 4) if donate else ()
+        step = jax.jit(
+            lambda params, kc, vc, ksc, vsc, bt, tok, pos, active:
+            decode_step_paged_q(cfg, pf(params), kc, vc, ksc, vsc, bt,
+                                tok, pos, active, t_logical=T),
+            in_shardings=(ps, pool, pool, rep, rep, rep, rep, rep, rep),
+            out_shardings=(pool, pool, rep, rep, rep, rep),
+            donate_argnums=kv_donate)
+        chunk = jax.jit(
+            lambda params, kc, vc, ksc, vsc, bt, slot, toks, off, n:
+            prefill_chunk_paged_q(cfg, pf(params), kc, vc, ksc, vsc, bt,
+                                  slot, toks, off, n, t_logical=T),
+            in_shardings=(ps, pool, pool, rep, rep, rep, rep, rep, rep,
+                          rep),
+            out_shardings=(pool, pool, rep, rep, rep),
+            donate_argnums=kv_donate)
+        admit = jax.jit(
+            lambda params, kc, vc, ksc, vsc, bts, toks, lens:
+            admit_insert_paged_q(cfg, pf(params), kc, vc, ksc, vsc, bts,
+                                 toks, lens),
+            in_shardings=(ps, pool, pool, rep, rep, rep, rep, rep),
+            out_shardings=(rep, pool, pool, rep, rep),
+            donate_argnums=kv_donate)
+        cow = jax.jit(
+            lambda kc, vc, ksc, vsc, src, dst: cow_block_copy_q(
+                kc, vc, ksc, vsc, src, dst),
+            in_shardings=(pool, pool, rep, rep, rep, rep),
+            out_shardings=(pool, pool, rep, rep),
+            donate_argnums=(0, 1, 2, 3) if donate else ())
+        verify = jax.jit(
+            lambda params, kc, vc, ksc, vsc, bt, toks, pos, active, nv:
+            verify_step_paged_q(cfg, pf(params), kc, vc, ksc, vsc, bt,
+                                toks, pos, active, nv, t_logical=T),
+            in_shardings=(ps, pool, pool, rep, rep, rep, rep, rep, rep,
+                          rep),
+            out_shardings=(pool, pool, rep, rep, rep),
+            donate_argnums=kv_donate)
+        return {"step": step, "chunk": chunk, "admit": admit,
+                "cow": cow, "verify": verify, "param_shardings": ps,
+                "pool_sharding": pool}
     kv_donate = (1, 2) if donate else ()
     step = jax.jit(
         lambda params, kc, vc, bt, tok, pos, active: decode_step_paged(
-            cfg, params, kc, vc, bt, tok, pos, active, t_logical=T),
+            cfg, pf(params), kc, vc, bt, tok, pos, active, t_logical=T),
         in_shardings=(ps, pool, pool, rep, rep, rep, rep),
         out_shardings=(pool, pool, rep, rep),
         donate_argnums=kv_donate)
     chunk = jax.jit(
         lambda params, kc, vc, bt, slot, toks, off, n: prefill_chunk_paged(
-            cfg, params, kc, vc, bt, slot, toks, off, n, t_logical=T),
+            cfg, pf(params), kc, vc, bt, slot, toks, off, n, t_logical=T),
         in_shardings=(ps, pool, pool, rep, rep, rep, rep, rep),
         out_shardings=(pool, pool, rep),
         donate_argnums=kv_donate)
     admit = jax.jit(
         lambda params, kc, vc, bts, toks, lens: admit_insert_paged(
-            cfg, params, kc, vc, bts, toks, lens),
+            cfg, pf(params), kc, vc, bts, toks, lens),
         in_shardings=(ps, pool, pool, rep, rep, rep),
         out_shardings=(rep, pool, pool),
         donate_argnums=kv_donate)
@@ -891,8 +1336,8 @@ def make_sharded_decode_programs(cfg: TransformerConfig, mesh,
     # spec_k=0 engine never dispatches it and its cache stays empty.
     verify = jax.jit(
         lambda params, kc, vc, bt, toks, pos, active, nv:
-        verify_step_paged(cfg, params, kc, vc, bt, toks, pos, active, nv,
-                          t_logical=T),
+        verify_step_paged(cfg, pf(params), kc, vc, bt, toks, pos, active,
+                          nv, t_logical=T),
         in_shardings=(ps, pool, pool, rep, rep, rep, rep, rep),
         out_shardings=(pool, pool, rep),
         donate_argnums=kv_donate)

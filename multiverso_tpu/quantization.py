@@ -130,3 +130,53 @@ class SparseFilter:
         before = sum(np.asarray(b).nbytes for b in blobs)
         after = sum(np.asarray(b).nbytes for b in filtered)
         return after / max(before, 1)
+
+
+# -- int8 symmetric quantization ----------------------------------------------
+#
+# The byte-budget levers the serving stack shares (docs/SERVING.md
+# "Quantized KV & params"): symmetric max-abs int8 with an fp32 scale.
+# These are the HOST-side halves — param-snapshot pins
+# (serving/snapshot.py) and the param-plane wire codec
+# (serving/param_plane.py). The paged KV pools' traced
+# quantize-on-write / dequantize-on-gather forms live next to the
+# kernels in models/transformer.py (scales are jit operands there, never
+# host values).
+
+INT8_QMAX = 127.0
+
+
+def quantize_int8(arr: np.ndarray, axis: Optional[int] = None):
+    """Symmetric max-abs int8: ``(q int8, scale fp32)``.
+
+    ``axis=None`` -> one per-tensor scale (shape ``(1,)`` — an ndarray,
+    so it rides any wire/pytree path uniformly); an int ``axis`` ->
+    per-slice scales with ``keepdims`` (the per-column form for
+    Megatron-split matrices: the scale broadcasts over the quantized
+    axis AND keeps the tensor's rank, so a sharding spec written for
+    the weight applies to its scale unchanged). A zero slice gets
+    scale 0 and dequantizes to exact zeros."""
+    arr = np.asarray(arr)
+    a = arr.astype(np.float32, copy=False)
+    if axis is None:
+        amax = np.max(np.abs(a), initial=0.0)
+        scale = np.asarray([amax / INT8_QMAX], np.float32)
+        safe = scale[0] if scale[0] > 0 else 1.0
+        q = np.clip(np.rint(a / safe), -INT8_QMAX, INT8_QMAX)
+        return q.astype(np.int8), scale
+    amax = np.max(np.abs(a), axis=axis, keepdims=True)
+    scale = (amax / INT8_QMAX).astype(np.float32)
+    safe = np.where(scale > 0, scale, 1.0)
+    q = np.clip(np.rint(a / safe), -INT8_QMAX, INT8_QMAX)
+    return q.astype(np.int8), scale
+
+
+def dequantize_int8(q: np.ndarray, scale: np.ndarray,
+                    dtype=np.float32) -> np.ndarray:
+    """Inverse of :func:`quantize_int8` (scale broadcasts; a ``(1,)``
+    per-tensor scale multiplies through)."""
+    q = np.asarray(q, np.float32)
+    scale = np.asarray(scale, np.float32)
+    if scale.size == 1:
+        return (q * scale.reshape(())).astype(dtype)
+    return (q * scale).astype(dtype)

@@ -74,13 +74,22 @@ SCRATCH_BLOCK = 0
 
 
 def kv_bytes_per_block(n_layers: int, d_model: int, block_size: int,
-                       dtype=np.float32) -> int:
-    """Device bytes one block costs across BOTH pools (K and V)."""
+                       dtype=np.float32, quant: str = "none") -> int:
+    """Device bytes one block costs across BOTH pools (K and V).
+
+    ``quant="int8"`` reports the REAL quantized footprint: int8 payload
+    plus the per-(layer, block) fp32 scale each pool carries
+    (``models.transformer`` ``_q`` kernels) — the honest number the
+    pool-byte budget divides by, so the bench's equal-bytes A/B cannot
+    flatter quantization by forgetting its scales."""
+    if quant == "int8":
+        return 2 * n_layers * (block_size * d_model + 4)
     return 2 * n_layers * block_size * d_model * np.dtype(dtype).itemsize
 
 
 def blocks_for_bytes(budget_bytes: int, n_layers: int, d_model: int,
-                     block_size: int, dtype=np.float32) -> int:
+                     block_size: int, dtype=np.float32,
+                     quant: str = "none") -> int:
     """Usable blocks a KV-bytes budget buys (scratch block excluded:
     its bytes ride along, but it holds no sequence).
 
@@ -88,7 +97,7 @@ def blocks_for_bytes(budget_bytes: int, n_layers: int, d_model: int,
     result feeds ``kv_pool_blocks``, where ``0`` means AUTO-size — a
     silent 0 here would turn "tiny budget" into "contiguous-equivalent
     pool", a many-fold device-memory overshoot."""
-    per = kv_bytes_per_block(n_layers, d_model, block_size, dtype)
+    per = kv_bytes_per_block(n_layers, d_model, block_size, dtype, quant)
     n = budget_bytes // per - 1
     if n < 1:
         raise ValueError(

@@ -161,8 +161,8 @@ from . import kv_transfer
 from .block_pool import (SCRATCH_BLOCK, BlockPool, chain_hashes,
                          kv_bytes_per_block)
 from .flight_recorder import FlightRecorder
-from .snapshot import (SnapshotManager, replicate_for_decode,
-                       shard_for_decode)
+from .snapshot import (SnapshotManager, quantize_decode_params,
+                       replicate_for_decode, shard_for_decode)
 from .watchdog import EngineWatchdog, WatchdogConfig
 from .workloads import _jit_cache_size
 
@@ -205,6 +205,17 @@ class DecodeEngineConfig:
     # spec_k tokens per live slot via n-gram prompt lookup and verifies
     # them in one fused fixed-K step (needs the paged KV cache)
     spec_k: Optional[int] = None
+    # int8 per-block-scaled paged KV pools (None = the -kv_quant flag).
+    # "none" is today's fp pools bit-for-bit; "int8" stores the pools
+    # as int8 with per-(layer, block) fp32 scales riding every program
+    # as traced data — ~4x KV capacity at equal bytes, lossy (the bench
+    # archives the argmax-match rate against the fp32 oracle). Needs
+    # the paged KV cache.
+    kv_quant: Optional[str] = None
+    # int8 decode param snapshot pins (None = the -decode_param_quant
+    # flag): pins quantize host-side once per version (~4x smaller
+    # replica copies) and the compiled programs fold the dequant in
+    decode_param_quant: Optional[str] = None
     # overload-graceful serving (None = the matching flags): optimistic
     # prompt-only reservation + grow-at-decode + preemption-with-
     # recompute (paged + chunked only; False = worst-case up-front
@@ -624,13 +635,19 @@ class DecodeEngine:
 
     def __init__(self, name: str, lm, config: Optional[DecodeEngineConfig]
                  = None) -> None:
-        from ..models.transformer import (admit_insert_paged, cache_insert,
-                                          cow_block_copy, decode_step,
+        from ..models.transformer import (admit_insert_paged,
+                                          admit_insert_paged_q,
+                                          cache_insert, cow_block_copy,
+                                          cow_block_copy_q, decode_step,
                                           decode_step_paged,
+                                          decode_step_paged_q,
+                                          dequantize_decode_params,
                                           make_sharded_decode_programs,
                                           prefill, prefill_chunk,
                                           prefill_chunk_paged,
-                                          verify_step_paged)
+                                          prefill_chunk_paged_q,
+                                          verify_step_paged,
+                                          verify_step_paged_q)
 
         self.name = name
         self.config = config or DecodeEngineConfig()
@@ -676,6 +693,31 @@ class DecodeEngine:
             self._blocks_per_seq = 0
             self._pool = None
             self._block_tables = None
+
+        # -- quantized serving knobs ----------------------------------------
+        # int8 per-(layer, block)-scaled KV pools: the pools store int8
+        # and a pair of [L, n_blocks + 1] fp32 scale arrays rides every
+        # program call as TRACED data — same one-trace accounting as the
+        # block tables. kv_quant="none" (default) keeps the fp pools and
+        # is bit-identical to the pre-quant engine.
+        self._kv_quant_mode = str(ec._resolved("kv_quant"))
+        if self._kv_quant_mode not in ("none", "int8"):
+            Log.fatal(f"DecodeEngine {name!r}: kv_quant must be 'none' or "
+                      f"'int8', got {self._kv_quant_mode!r}")
+        self._kv_quant = self._kv_quant_mode == "int8"
+        if self._kv_quant and not self._paged:
+            Log.fatal(f"DecodeEngine {name!r}: kv_quant=int8 needs the "
+                      f"paged KV cache (kv_block_size > 0) — the scales "
+                      f"are per (layer, block), and a contiguous strip "
+                      f"has no blocks to scale")
+        # int8 decode param pins: the pin quantizes host-side ONCE per
+        # snapshot version (snapshot.quantize_decode_params) and the
+        # compiled programs fold the dequant in — pin device_put bytes
+        # drop ~4x, per-token traces stay 1.
+        self._param_quant = str(ec._resolved("decode_param_quant"))
+        if self._param_quant not in ("none", "int8"):
+            Log.fatal(f"DecodeEngine {name!r}: decode_param_quant must be "
+                      f"'none' or 'int8', got {self._param_quant!r}")
 
         # -- decode mesh (tensor-parallel serving) --------------------------
         # decode_tp=1 (default) reduces exactly to the single-device
@@ -735,6 +777,9 @@ class DecodeEngine:
         # second compiled trace — measured 2.4 ms -> 22 ms per fused step
         # — so the engine only donates off-CPU.
         donate = (1, 2) if jax.default_backend() != "cpu" else ()
+        # quant programs thread (kc, vc, ksc, vsc) after params — the
+        # donate tuple shifts to cover all four pool arrays
+        q_donate = (1, 2, 3, 4) if donate else ()
 
         # -- jitted programs ------------------------------------------------
         # chunked admission budget: a fixed-size chunk prefilled straight
@@ -810,7 +855,9 @@ class DecodeEngine:
             # sharding intact. Copy-on-write rides the same mesh: the
             # one write that can touch a shared block stays one site.
             progs = make_sharded_decode_programs(
-                cfg, self._decode_mesh, T, donate=bool(donate))
+                cfg, self._decode_mesh, T, donate=bool(donate),
+                kv_quant=self._kv_quant_mode,
+                param_quant=self._param_quant)
             self._param_shardings = progs["param_shardings"]
             self._cache_sharding = progs["pool_sharding"]
             self._admit_fn = progs["admit"]
@@ -823,22 +870,38 @@ class DecodeEngine:
             # compiled trace exactly like the step
             self._verify_fn = progs["verify"] if self._spec else None
         else:
-            if self._paged:
+            # param-dequant fold (decode_param_quant=int8): the pinned
+            # pytree arrives as {"q": int8, "s": fp32} leaves and every
+            # program dequantizes at COMPILE time — the call signatures,
+            # donation and trace counts are exactly the fp path's
+            pf = ((lambda p: dequantize_decode_params(p, cfg.dtype))
+                  if self._param_quant == "int8" else (lambda p: p))
+            if self._paged and self._kv_quant:
+                # quant admission threads both pools' scale arrays as
+                # traced data right after the pools themselves
+                def _admit_insert(params, kc, vc, ksc, vsc, bts, toks,
+                                  lengths):
+                    return admit_insert_paged_q(cfg, pf(params), kc, vc,
+                                                ksc, vsc, bts, toks,
+                                                lengths)
+            elif self._paged:
                 # the ONE paged admission body (prefill + last-real-
                 # position gather + table-scatter insert) lives in
                 # transformer.admit_insert_paged — the sharded variant
                 # jits the same function, so the two paths cannot drift
                 def _admit_insert(params, kc, vc, bts, toks, lengths):
-                    return admit_insert_paged(cfg, params, kc, vc, bts,
-                                              toks, lengths)
+                    return admit_insert_paged(cfg, pf(params), kc, vc,
+                                              bts, toks, lengths)
             else:
                 def _admit_insert(params, kc, vc, slots, toks, lengths):
-                    logits, ks, vs = prefill(cfg, params, toks)
+                    logits, ks, vs = prefill(cfg, pf(params), toks)
                     first = _first_tokens(logits, lengths, toks.dtype)
                     kc, vc = cache_insert(kc, vc, slots, ks, vs)
                     return first, kc, vc
 
-            self._admit_fn = jax.jit(_admit_insert, donate_argnums=donate)
+            self._admit_fn = jax.jit(
+                _admit_insert,
+                donate_argnums=q_donate if self._kv_quant else donate)
             if self._prefix:
                 # copy-on-write: duplicate one block (both pools) before
                 # a write lands in a shared one. src/dst are traced
@@ -850,13 +913,49 @@ class DecodeEngine:
                 # engine's compile cache on one handle (jit caches key
                 # on the function object), breaking the per-engine
                 # one-trace accounting
-                self._cow_fn = jax.jit(
-                    lambda kc, vc, src, dst: cow_block_copy(
-                        kc, vc, src, dst),
-                    donate_argnums=(0, 1) if donate else ())
+                if self._kv_quant:
+                    # the scale columns duplicate WITH the block — a
+                    # CoW'd block must dequantize identically to its src
+                    self._cow_fn = jax.jit(
+                        lambda kc, vc, ksc, vsc, src, dst:
+                        cow_block_copy_q(kc, vc, ksc, vsc, src, dst),
+                        donate_argnums=(0, 1, 2, 3) if donate else ())
+                else:
+                    self._cow_fn = jax.jit(
+                        lambda kc, vc, src, dst: cow_block_copy(
+                            kc, vc, src, dst),
+                        donate_argnums=(0, 1) if donate else ())
             else:
                 self._cow_fn = None
-            if self._paged:
+            if self._paged and self._kv_quant:
+                # the quant programs mirror the fp paged ones exactly —
+                # block tables AND scale arrays ride as fixed-shape
+                # data, so the one-trace-per-config invariant survives
+                # quantization the same way it survived paging
+                self._chunk_fn = jax.jit(
+                    lambda params, kc, vc, ksc, vsc, bt, slot, toks,
+                    off, n:
+                    prefill_chunk_paged_q(cfg, pf(params), kc, vc, ksc,
+                                          vsc, bt, slot, toks, off, n,
+                                          t_logical=T),
+                    donate_argnums=q_donate)
+                self._step_fn = jax.jit(
+                    lambda params, kc, vc, ksc, vsc, bt, tok, pos, active:
+                    decode_step_paged_q(cfg, pf(params), kc, vc, ksc,
+                                        vsc, bt, tok, pos, active,
+                                        t_logical=T),
+                    donate_argnums=q_donate)
+                if self._spec:
+                    self._verify_fn = jax.jit(
+                        lambda params, kc, vc, ksc, vsc, bt, toks, pos,
+                        active, nv:
+                        verify_step_paged_q(cfg, pf(params), kc, vc, ksc,
+                                            vsc, bt, toks, pos, active,
+                                            nv, t_logical=T),
+                        donate_argnums=q_donate)
+                else:
+                    self._verify_fn = None
+            elif self._paged:
                 # block tables ride every call as DATA ([S, M] int32,
                 # fixed shape): which blocks a slot owns never touches an
                 # aval, so the one-trace-per-config invariant survives
@@ -865,13 +964,13 @@ class DecodeEngine:
                 # bit-identical to the contiguous layout's.
                 self._chunk_fn = jax.jit(
                     lambda params, kc, vc, bt, slot, toks, off, n:
-                    prefill_chunk_paged(cfg, params, kc, vc, bt, slot,
+                    prefill_chunk_paged(cfg, pf(params), kc, vc, bt, slot,
                                         toks, off, n, t_logical=T),
                     donate_argnums=donate)
                 self._step_fn = jax.jit(
                     lambda params, kc, vc, bt, tok, pos, active:
-                    decode_step_paged(cfg, params, kc, vc, bt, tok, pos,
-                                      active, t_logical=T),
+                    decode_step_paged(cfg, pf(params), kc, vc, bt, tok,
+                                      pos, active, t_logical=T),
                     donate_argnums=donate)
                 if self._spec:
                     # the fixed-K verify step: the [S, spec_k + 1]
@@ -881,8 +980,9 @@ class DecodeEngine:
                     # (fresh lambda per engine, same as the step)
                     self._verify_fn = jax.jit(
                         lambda params, kc, vc, bt, toks, pos, active, nv:
-                        verify_step_paged(cfg, params, kc, vc, bt, toks,
-                                          pos, active, nv, t_logical=T),
+                        verify_step_paged(cfg, pf(params), kc, vc, bt,
+                                          toks, pos, active, nv,
+                                          t_logical=T),
                         donate_argnums=donate)
                 else:
                     self._verify_fn = None
@@ -891,14 +991,14 @@ class DecodeEngine:
                 self._chunk_fn = jax.jit(
                     lambda params, kc, vc, slot, toks, off, n:
                     prefill_chunk(
-                        cfg, params, kc, vc, slot, toks, off, n),
+                        cfg, pf(params), kc, vc, slot, toks, off, n),
                     donate_argnums=donate)
                 # THE fused step: all shapes fixed by the engine config
                 # -> exactly one compiled trace no matter which slots
                 # are live
                 self._step_fn = jax.jit(
                     lambda params, kc, vc, tok, pos, active: decode_step(
-                        cfg, params, kc, vc, tok, pos, active),
+                        cfg, pf(params), kc, vc, tok, pos, active),
                     donate_argnums=donate)
 
         # -- KV transfer plane (disaggregated prefill/decode) ---------------
@@ -914,7 +1014,30 @@ class DecodeEngine:
         # like the step/CoW (it reassigns both pools); fetch cannot
         # donate (the pools survive it). Fresh lambdas per engine for
         # the same per-engine compile-cache accounting as the CoW above.
-        if self._prefix:
+        if self._prefix and self._kv_quant:
+            # quant fetch/splice move the block's scale columns with its
+            # int8 bytes — same traced block id, same one-trace count;
+            # the [L] scale row updates in-place via the rank-reduced DUS
+            self._fetch_fn = jax.jit(
+                lambda kc, vc, ksc, vsc, b: (
+                    jax.lax.dynamic_index_in_dim(kc, b, axis=1,
+                                                 keepdims=False),
+                    jax.lax.dynamic_index_in_dim(vc, b, axis=1,
+                                                 keepdims=False),
+                    jax.lax.dynamic_index_in_dim(ksc, b, axis=1,
+                                                 keepdims=False),
+                    jax.lax.dynamic_index_in_dim(vsc, b, axis=1,
+                                                 keepdims=False)))
+            self._splice_fn = jax.jit(
+                lambda kc, vc, ksc, vsc, b, k, v, ks, vs: (
+                    jax.lax.dynamic_update_index_in_dim(kc, k, b, axis=1),
+                    jax.lax.dynamic_update_index_in_dim(vc, v, b, axis=1),
+                    jax.lax.dynamic_update_index_in_dim(ksc, ks, b,
+                                                        axis=1),
+                    jax.lax.dynamic_update_index_in_dim(vsc, vs, b,
+                                                        axis=1)),
+                donate_argnums=(0, 1, 2, 3) if donate else ())
+        elif self._prefix:
             self._fetch_fn = jax.jit(
                 lambda kc, vc, b: (
                     jax.lax.dynamic_index_in_dim(kc, b, axis=1,
@@ -946,10 +1069,34 @@ class DecodeEngine:
         self._cache_target = (self._cache_sharding
                               if self._cache_sharding is not None
                               else jax.devices()[0])
+        cache_dtype = jnp.int8 if self._kv_quant else cfg.dtype
         self._k_cache = jax.device_put(
-            jnp.zeros(cache_shape, cfg.dtype), self._cache_target)
+            jnp.zeros(cache_shape, cache_dtype), self._cache_target)
         self._v_cache = jax.device_put(
-            jnp.zeros(cache_shape, cfg.dtype), self._cache_target)
+            jnp.zeros(cache_shape, cache_dtype), self._cache_target)
+        if self._kv_quant:
+            # per-(layer, block) fp32 scales, one array per pool. Zeros
+            # from birth: scale 0 marks a never-written block (the
+            # kernels' zero-divide guard dequantizes it as exact zeros),
+            # which is also what quant_scale_blocks counts against. On a
+            # sharded engine the scales REPLICATE — [L, N] has no head
+            # slice to shard, and every shard needs every block's scale
+            scale_shape = (L, self._pool.capacity + 1)
+            if self._cache_sharding is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                self._scale_target = NamedSharding(self._decode_mesh,
+                                                   PartitionSpec())
+            else:
+                self._scale_target = jax.devices()[0]
+            self._k_scales = jax.device_put(
+                jnp.zeros(scale_shape, jnp.float32), self._scale_target)
+            self._v_scales = jax.device_put(
+                jnp.zeros(scale_shape, jnp.float32), self._scale_target)
+        else:
+            self._scale_target = None
+            self._k_scales = None
+            self._v_scales = None
         # -- host state -----------------------------------------------------
         self._slot_req: List[Optional[_Request]] = [None] * S
         # explicit free-slot set, maintained at admit/complete (the loop
@@ -1068,10 +1215,17 @@ class DecodeEngine:
                               if self._decode_mesh is not None else 1))
             if self._spec:
                 self.recorder.meta["spec_k"] = self._spec
+            if self._kv_quant:
+                self.recorder.meta["kv_quant"] = self._kv_quant_mode
         # admit-span mesh annotation (trace_summary ships the column):
         # only sharded engines carry it, so replicated reports stay flat
         self._mesh_attrs = ({"decode_tp": self._tp} if self._tp > 1
                             else {})
+        if self._kv_quant:
+            # quant engines annotate every admit span too (the
+            # trace_summary quant column; off-quant spans stay flat —
+            # the metrics-regression byte-identity contract)
+            self._mesh_attrs["kv_quant"] = self._kv_quant_mode
         # per-iteration scratch the recorder drains (reused, not realloc'd)
         self._it_admitted: List[int] = []
         self._it_completed: List[int] = []
@@ -1118,6 +1272,12 @@ class DecodeEngine:
         self.preemptions = 0
         self.preempted = 0
         self.deadline_drops = 0
+        # quant quality headline: argmax-match rate vs an fp32 oracle,
+        # measured and recorded by the harness/bench (the engine cannot
+        # compute it alone — it needs the oracle's outputs); -1 = never
+        # measured. Quant engines surface it in stats() as _info-grade
+        # data, off-quant engines' stats stay byte-identical
+        self._argmax_match = -1.0
         # window base for the pool's monotonic eviction counter, so
         # stats()["prefix_evictions"] resets with its sibling mirrors
         self._evictions_base = 0
@@ -1632,7 +1792,26 @@ class DecodeEngine:
             self._snap.version if self._snap is not None else -1,
             tuple(self._it_admitted), tuple(self._it_completed),
             self._it_spec_proposed if self._spec else -1,
-            self._it_spec_accepted if self._spec else -1))
+            self._it_spec_accepted if self._spec else -1,
+            (1 if self._kv_quant else 0) if self._paged else -1,
+            # written-block occupancy PROXY (live + cached pool blocks)
+            # — the real nonzero-scale count lives on the device, and
+            # the recorder's cost posture forbids a per-iteration sync
+            (self._pool.n_live + self._pool.n_cached)
+            if self._kv_quant else -1))
+
+    def _seed_for(self, version: int) -> bytes:
+        """Hash-chain seed for a pinned snapshot version. kv_quant tags
+        the seed: cached K/V bytes are a function of (token prefix,
+        params version, POOL ENCODING) — an int8 block and an fp block
+        for the same prefix hold different bytes, so their chain
+        identities must differ. This is also what makes cross-mode KV
+        transfer degrade cleanly: a quant payload arriving at a
+        kv_quant=none replica fails the seed/dtype checks and the
+        receiver re-prefills locally (chaos-tested)."""
+        if self._kv_quant:
+            return f"{int(version)}/int8".encode()
+        return str(int(version)).encode()
 
     def _maybe_refresh(self, hold: bool = False) -> None:
         """Move the pinned snapshot only while NO generation is in
@@ -1667,12 +1846,20 @@ class DecodeEngine:
                 # the pre-partitioned programs' in_shardings exactly
                 with trace.span("snapshot.pin", engine=self.name,
                                 version=snap.version):
+                    # decode_param_quant=int8: quantize HOST-side before
+                    # the device_put — the pin ships ~4x fewer bytes and
+                    # the programs dequantize at compile time. Host
+                    # numpy on purpose: this runs on the loop thread,
+                    # where building a jit would be an RT106 hazard.
+                    value = (quantize_decode_params(snap.value)
+                             if self._param_quant == "int8"
+                             else snap.value)
                     if self._tp > 1:
                         self._pinned = shard_for_decode(
-                            snap.value, self._decode_mesh,
+                            value, self._decode_mesh,
                             self._param_shardings)
                     else:
-                        self._pinned = replicate_for_decode(snap.value)
+                        self._pinned = replicate_for_decode(value)
                 self._pinned_version = snap.version
                 self.pin_copies += 1
             self._snap = snap
@@ -1682,7 +1869,7 @@ class DecodeEngine:
                 # garbage to the new version — flush them (the version
                 # seed alone would keep them resident but unreachable,
                 # silently shrinking effective capacity)
-                seed = str(snap.version).encode()
+                seed = self._seed_for(snap.version)
                 if seed != self._hash_seed:
                     self._hash_seed = seed
                     self._pool.flush_cache()
@@ -1728,9 +1915,16 @@ class DecodeEngine:
             if req.full_hit and not req.pf_only:
                 shared_last = matched[-1]
                 dup = self._pool.alloc(1)[0]
-                self._k_cache, self._v_cache = self._cow_fn(
-                    self._k_cache, self._v_cache,
-                    np.int32(shared_last), np.int32(dup))
+                if self._kv_quant:
+                    (self._k_cache, self._v_cache, self._k_scales,
+                     self._v_scales) = self._cow_fn(
+                        self._k_cache, self._v_cache, self._k_scales,
+                        self._v_scales, np.int32(shared_last),
+                        np.int32(dup))
+                else:
+                    self._k_cache, self._v_cache = self._cow_fn(
+                        self._k_cache, self._v_cache,
+                        np.int32(shared_last), np.int32(dup))
                 self._pool.decref([shared_last])
                 matched[-1] = dup
                 full_hit_cow = True
@@ -1876,7 +2070,13 @@ class DecodeEngine:
         toks[: n] = req.prompt[off: off + n]
         tracing = trace.enabled()
         t0 = time.monotonic() if tracing else 0.0
-        if self._paged:
+        if self._paged and self._kv_quant:
+            (self._k_cache, self._v_cache, self._k_scales,
+             self._v_scales, logits) = self._chunk_fn(
+                self._pinned, self._k_cache, self._v_cache,
+                self._k_scales, self._v_scales, self._block_tables,
+                np.int32(req.slot), toks, np.int32(off), np.int32(n))
+        elif self._paged:
             self._k_cache, self._v_cache, logits = self._chunk_fn(
                 self._pinned, self._k_cache, self._v_cache,
                 self._block_tables, np.int32(req.slot), toks,
@@ -1987,10 +2187,15 @@ class DecodeEngine:
         and resolve the future with the payload instead of tokens. Runs
         on the loop thread: the caches are loop-thread-owned."""
         hashes = self._req_hashes(req)
+        # a quantized source ships the pool's native int8 bytes + each
+        # block's per-layer scale columns; the payload dtype tells the
+        # receiver which splice contract applies (the seed check already
+        # scoped the hashes to the same encoding)
         payload = kv_transfer.new_payload(
             len(req.prompt), self._block_size, req.version,
             (self._model_cfg.n_layers, self._block_size,
-             self._model_cfg.d_model), self._model_cfg.dtype)
+             self._model_cfg.d_model),
+            np.int8 if self._kv_quant else self._model_cfg.dtype)
         shipped = 0
         for i, h in enumerate(hashes):
             hx = h.hex()
@@ -1999,10 +2204,18 @@ class DecodeEngine:
                 # prefix — the hash rides, the bytes stay home
                 kv_transfer.add_block(payload, hx)
                 continue
-            k, v = self._fetch_fn(self._k_cache, self._v_cache,
-                                  np.int32(req.blocks[i]))
-            kv_transfer.add_block(payload, hx, np.asarray(k),
-                                  np.asarray(v))
+            if self._kv_quant:
+                k, v, ks, vs = self._fetch_fn(
+                    self._k_cache, self._v_cache, self._k_scales,
+                    self._v_scales, np.int32(req.blocks[i]))
+                kv_transfer.add_block(payload, hx, np.asarray(k),
+                                      np.asarray(v), np.asarray(ks),
+                                      np.asarray(vs))
+            else:
+                k, v = self._fetch_fn(self._k_cache, self._v_cache,
+                                      np.int32(req.blocks[i]))
+                kv_transfer.add_block(payload, hx, np.asarray(k),
+                                      np.asarray(v))
             shipped += 1
         nbytes = kv_transfer.payload_bytes(payload)
         dedup = int(payload["dedup_blocks"])
@@ -2057,7 +2270,7 @@ class DecodeEngine:
         # see its first transfer before its first request), then check
         # the payload's version against OUR hash-chain seed
         self._maybe_refresh()
-        if str(int(payload["snapshot_version"])).encode() != \
+        if self._seed_for(int(payload["snapshot_version"])) != \
                 self._hash_seed:
             info["skipped"] = (
                 f"snapshot version {payload['snapshot_version']} != "
@@ -2073,8 +2286,16 @@ class DecodeEngine:
             info["skipped"] = f"block shape {shape} mismatch"
             return info
         dtype = np.dtype(payload["dtype"])
-        if dtype != np.dtype(cfg.dtype):
-            info["skipped"] = f"dtype {dtype} != {np.dtype(cfg.dtype)}"
+        # the pool's NATIVE dtype, not the model's: an int8 engine
+        # splices int8 bytes. The encoding-tagged hash seed means a
+        # cross-mode payload normally fails the seed check above; this
+        # check is the belt to that suspender (same-version payloads
+        # from a differently-configured fleet must still degrade to a
+        # local re-prefill, never splice mis-typed bytes)
+        expect = (np.dtype(np.int8) if self._kv_quant
+                  else np.dtype(cfg.dtype))
+        if dtype != expect:
+            info["skipped"] = f"dtype {dtype} != {expect}"
             return info
         per_block = kv_transfer.block_nbytes(shape, dtype)
         blocks = payload.get("blocks") or {}
@@ -2088,11 +2309,24 @@ class DecodeEngine:
                 break
             try:
                 k, v = kv_transfer.unpack_block(rec, shape, dtype)
+                scales = (kv_transfer.unpack_scales(rec, cfg.n_layers)
+                          if self._kv_quant else None)
             except ValueError:
                 break
+            if self._kv_quant and scales is None:
+                # int8 bytes without their scales are undecodable —
+                # stop the walk (prefix semantics) and re-prefill
+                break
             blk = self._pool.alloc(1)[0]
-            self._k_cache, self._v_cache = self._splice_fn(
-                self._k_cache, self._v_cache, np.int32(blk), k, v)
+            if self._kv_quant:
+                (self._k_cache, self._v_cache, self._k_scales,
+                 self._v_scales) = self._splice_fn(
+                    self._k_cache, self._v_cache, self._k_scales,
+                    self._v_scales, np.int32(blk), k, v,
+                    scales[0], scales[1])
+            else:
+                self._k_cache, self._v_cache = self._splice_fn(
+                    self._k_cache, self._v_cache, np.int32(blk), k, v)
             self._pool.register(blk, h)
             self._pool.decref([blk])
             info["xfer_blocks"] += 1
@@ -2148,7 +2382,13 @@ class DecodeEngine:
                 self.prefill_tok_counter.inc(len(req.prompt))
                 self._it_prefill += len(req.prompt)
                 self._it_admitted.append(req.rid)
-            if self._paged:
+            if self._paged and self._kv_quant:
+                (first, self._k_cache, self._v_cache, self._k_scales,
+                 self._v_scales) = self._admit_fn(
+                    self._pinned, self._k_cache, self._v_cache,
+                    self._k_scales, self._v_scales, jnp.asarray(bts),
+                    jnp.asarray(toks), jnp.asarray(lens))
+            elif self._paged:
                 first, self._k_cache, self._v_cache = self._admit_fn(
                     self._pinned, self._k_cache, self._v_cache,
                     jnp.asarray(bts), jnp.asarray(toks), jnp.asarray(lens))
@@ -2403,10 +2643,23 @@ class DecodeEngine:
             # acceptance is decided below on the host from the argmax
             # chain (traced data in, plain ints out — never a shape)
             self.spec_steps += 1
-            self._k_cache, self._v_cache, nxt = self._verify_fn(
+            if self._kv_quant:
+                (self._k_cache, self._v_cache, self._k_scales,
+                 self._v_scales, nxt) = self._verify_fn(
+                    self._pinned, self._k_cache, self._v_cache,
+                    self._k_scales, self._v_scales, self._block_tables,
+                    spec_toks, self._pos, self._active, n_valid)
+            else:
+                self._k_cache, self._v_cache, nxt = self._verify_fn(
+                    self._pinned, self._k_cache, self._v_cache,
+                    self._block_tables, spec_toks, self._pos,
+                    self._active, n_valid)
+        elif self._paged and self._kv_quant:
+            (self._k_cache, self._v_cache, self._k_scales,
+             self._v_scales, nxt, _) = self._step_fn(
                 self._pinned, self._k_cache, self._v_cache,
-                self._block_tables, spec_toks, self._pos, self._active,
-                n_valid)
+                self._k_scales, self._v_scales, self._block_tables,
+                self._tok, self._pos, self._active)
         elif self._paged:
             self._k_cache, self._v_cache, nxt, _ = self._step_fn(
                 self._pinned, self._k_cache, self._v_cache,
@@ -2661,6 +2914,65 @@ class DecodeEngine:
                     jax.device_put(jnp.zeros(shape, dtype),
                                    self._cache_target))
 
+        def scratch_scales():
+            # quant engines: scratch scale arrays on the scales' own
+            # placement (replicated on a sharded engine) — same
+            # committed-placement reasoning as scratch()
+            sshape = self._k_scales.shape
+            return (jax.device_put(jnp.zeros(sshape, jnp.float32),
+                                   self._scale_target),
+                    jax.device_put(jnp.zeros(sshape, jnp.float32),
+                                   self._scale_target))
+
+        if self._paged and self._kv_quant:
+            # quant warmup mirrors the fp paged warmup exactly, with
+            # the scale arrays threaded through every program — the
+            # traces built here ARE the quant serving traces
+            M = self._blocks_per_seq
+            bt = np.full((S, M), SCRATCH_BLOCK, np.int32)
+            if self._budget > 0:
+                kc, vc = scratch()
+                ks, vs = scratch_scales()
+                self._chunk_fn(params, kc, vc, ks, vs, bt, np.int32(0),
+                               np.ones(self._budget, np.int32),
+                               np.int32(0), np.int32(1))
+            else:
+                for pb in self._prompt_buckets:
+                    for bb in self._batch_buckets:
+                        kc, vc = scratch()
+                        ks, vs = scratch_scales()
+                        self._admit_fn(
+                            params, kc, vc, ks, vs,
+                            np.full((bb, M), SCRATCH_BLOCK, np.int32),
+                            np.ones((bb, pb), np.int32),
+                            np.ones(bb, np.int32))
+            if self._prefix:
+                kc, vc = scratch()
+                ks, vs = scratch_scales()
+                jax.block_until_ready(self._cow_fn(
+                    kc, vc, ks, vs, np.int32(0), np.int32(0)))
+                kc, vc = scratch()
+                ks, vs = scratch_scales()
+                k, v, bks, bvs = self._fetch_fn(kc, vc, ks, vs,
+                                                np.int32(0))
+                k, v = np.asarray(k), np.asarray(v)
+                bks, bvs = np.asarray(bks), np.asarray(bvs)
+                jax.block_until_ready(self._splice_fn(
+                    kc, vc, ks, vs, np.int32(0), k, v, bks, bvs)[0])
+            if self._spec:
+                kc, vc = scratch()
+                ks, vs = scratch_scales()
+                jax.block_until_ready(self._verify_fn(
+                    params, kc, vc, ks, vs, bt,
+                    np.zeros((S, self._spec + 1), np.int32),
+                    np.zeros(S, np.int32), np.zeros(S, bool),
+                    np.ones(S, np.int32)))
+            kc, vc = scratch()
+            ks, vs = scratch_scales()
+            jax.block_until_ready(self._step_fn(
+                params, kc, vc, ks, vs, bt, np.zeros(S, np.int32),
+                np.zeros(S, np.int32), np.zeros(S, bool)))
+            return
         if self._paged:
             # all-scratch block tables: warmup writes park in the
             # sentinel block of the scratch pools — placement is data,
@@ -2755,11 +3067,20 @@ class DecodeEngine:
         self.preemptions = 0
         self.preempted = 0
         self.deadline_drops = 0
+        self._argmax_match = -1.0
         if self._paged:
             self._evictions_base = self._pool.evictions
         self.t_first = None
         self._occ_sum = 0.0
         self._occ_n = 0
+
+    def record_argmax_match(self, rate: float) -> None:
+        """Attach an externally measured argmax-match rate (quant output
+        vs an fp32 oracle on the same prompts) to this engine's stats
+        surface — the quant quality headline the bench archives. The
+        harness computes it because only the harness holds both
+        engines' outputs."""
+        self._argmax_match = float(rate)
 
     def stats(self) -> dict:
         t_first = self.t_first
@@ -2776,10 +3097,14 @@ class DecodeEngine:
                  # shard over the head slice of D, so each device holds
                  # 1/tp of the KV bytes — the number that decides
                  # whether a model + pool fits the hardware
+                 # quant-aware: an int8 pool's per-block cost counts its
+                 # int8 K/V bytes PLUS the per-(layer, block) fp32
+                 # scales — the footprint must not flatter quantization
                  "kv_bytes_per_device": (
                      (self._pool.capacity + 1) * kv_bytes_per_block(
                          self._model_cfg.n_layers, self._model_cfg.d_model,
-                         self._block_size, np.dtype(self._model_cfg.dtype))
+                         self._block_size, np.dtype(self._model_cfg.dtype),
+                         quant=self._kv_quant_mode)
                      // self._tp),
                  "kv_blocks_free": self._pool.n_free,
                  "kv_blocks_live": self._pool.n_live,
@@ -2801,6 +3126,27 @@ class DecodeEngine:
                 - self._evictions_base,
                 "cow_copies": self.cow_copies,
             })
+        if self._kv_quant:
+            # quant surface, present only on kv_quant=int8 engines (an
+            # off-quant engine's stats dict stays byte-for-byte — the
+            # metrics regression contract). quant_scale_blocks here IS
+            # the real device count (one sync, stats are not the hot
+            # loop); the per-iteration recorder uses the pool proxy
+            try:
+                nz = int((np.maximum(
+                    np.asarray(self._k_scales),
+                    np.asarray(self._v_scales)).max(axis=0) > 0).sum())
+            except RuntimeError:
+                # donated-away buffer (stats raced a dispatch): the
+                # count is a diagnostic, not an invariant — degrade
+                nz = -1
+            pool.update({
+                "kv_quant": self._kv_quant_mode,
+                "quant_scale_blocks": nz,
+                "argmax_match_rate": self._argmax_match,
+            })
+        if self._param_quant == "int8":
+            pool["decode_param_quant"] = self._param_quant
         if self._prefix:
             # KV transfer plane (disaggregated serving), prefix-cache
             # engines only — the plane's gate, so a prefix_cache=off

@@ -44,6 +44,11 @@ Record schema (:data:`FIELDS`, positional):
                         ``spec_k=0``); accepted/proposed per time bucket
                         is the acceptance-rate strip
                         ``tools/engine_timeline.py`` renders
+``kv_quant``            1 when the paged pools are int8-quantized, 0 for
+                        fp paged pools, -1 for contiguous caches
+``quant_scale_blocks``  pool blocks carrying a nonzero quant scale (a
+                        written-block occupancy proxy; -1 when
+                        ``kv_quant`` != 1)
 ======================  =====================================================
 
 Timestamps are monotonic; the recorder captures a wall/mono anchor at
@@ -82,7 +87,8 @@ from typing import Any, Dict, List, Optional
 FIELDS = ("it", "ts", "busy_ms", "step_ms", "live", "reserved", "queue",
           "queue_age_ms", "prefill_toks", "decode_toks", "pool_free",
           "pool_live", "pool_shared", "version", "admitted", "completed",
-          "spec_proposed", "spec_accepted")
+          "spec_proposed", "spec_accepted", "kv_quant",
+          "quant_scale_blocks")
 
 
 def window_digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:

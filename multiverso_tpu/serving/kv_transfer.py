@@ -66,23 +66,41 @@ WIRE_VERSION = 1
 def block_nbytes(shape: Sequence[int], dtype) -> int:
     """Raw bytes ONE block moves across both pools (K and V) given the
     payload's per-block ``shape`` — ``(n_layers, block_size, d_model)``
-    as the engine fetches it."""
+    as the engine fetches it. An int8 (quantized-source) payload also
+    carries each pool's per-layer fp32 scales, counted here for the
+    same reason ``block_pool.kv_bytes_per_block`` counts them: the wire
+    accounting must not flatter quantization by forgetting its scales."""
     n = 1
     for d in shape:
         n *= int(d)
-    return 2 * n * np.dtype(dtype).itemsize
+    raw = 2 * n * np.dtype(dtype).itemsize
+    if np.dtype(dtype) == np.dtype(np.int8):
+        raw += 2 * int(shape[0]) * 4          # [L] fp32 scales per pool
+    return raw
 
 
-def pack_block(k: np.ndarray, v: np.ndarray) -> Dict[str, str]:
+def pack_block(k: np.ndarray, v: np.ndarray,
+               k_scale: Optional[np.ndarray] = None,
+               v_scale: Optional[np.ndarray] = None) -> Dict[str, str]:
     """One block's K/V slices as a JSON-safe record: base64 of the raw
     C-order bytes. Shape/dtype ride ONCE in the payload header — every
-    block of a payload shares them by construction."""
-    return {
+    block of a payload shares them by construction. A quantized source
+    pool additionally ships each pool's per-layer fp32 scale column
+    (``k_scale``/``v_scale`` [n_layers]) under ``ks``/``vs`` — the K/V
+    bytes themselves stay int8, which is where the ~4x
+    ``kv_bytes_moved`` drop comes from."""
+    rec = {
         "k": base64.b64encode(
             np.ascontiguousarray(k).tobytes()).decode("ascii"),
         "v": base64.b64encode(
             np.ascontiguousarray(v).tobytes()).decode("ascii"),
     }
+    if k_scale is not None:
+        rec["ks"] = base64.b64encode(np.ascontiguousarray(
+            k_scale, np.float32).tobytes()).decode("ascii")
+        rec["vs"] = base64.b64encode(np.ascontiguousarray(
+            v_scale, np.float32).tobytes()).decode("ascii")
+    return rec
 
 
 def unpack_block(rec: Dict[str, str], shape: Sequence[int], dtype):
@@ -123,16 +141,36 @@ def new_payload(prompt_len: int, block_size: int, snapshot_version: int,
 
 def add_block(payload: Dict[str, Any], hex_hash: str,
               k: Optional[np.ndarray] = None,
-              v: Optional[np.ndarray] = None) -> None:
+              v: Optional[np.ndarray] = None,
+              k_scale: Optional[np.ndarray] = None,
+              v_scale: Optional[np.ndarray] = None) -> None:
     """Append one full block to the chain. ``k``/``v`` given = ship the
     bytes; ``k=None`` = source-side dedup (the receiver advertised this
     chain prefix) — the hash still holds its chain position so
-    arrival-side splicing can claim the warm prefix past it."""
+    arrival-side splicing can claim the warm prefix past it. A
+    quantized source passes its per-layer scale columns too
+    (:func:`pack_block`)."""
     payload["hashes"].append(hex_hash)
     if k is None:
         payload["dedup_blocks"] += 1
     else:
-        payload["blocks"][hex_hash] = pack_block(k, v)
+        payload["blocks"][hex_hash] = pack_block(k, v, k_scale, v_scale)
+
+
+def unpack_scales(rec: Dict[str, str], n_layers: int):
+    """The quantized record's per-layer fp32 scale columns ->
+    ``(k_scale, v_scale)`` each ``[n_layers]``, or ``None`` when the
+    record shipped unquantized. Size-checked for the same reason
+    :func:`unpack_block` is: a truncated scale blob must fail loudly."""
+    if "ks" not in rec:
+        return None
+    ks = np.frombuffer(base64.b64decode(rec["ks"]), dtype=np.float32)
+    vs = np.frombuffer(base64.b64decode(rec["vs"]), dtype=np.float32)
+    if ks.size != int(n_layers) or vs.size != int(n_layers):
+        raise ValueError(
+            f"kv_transfer: scale record has {ks.size}/{vs.size} entries, "
+            f"expected {int(n_layers)}")
+    return ks, vs
 
 
 def payload_bytes(payload: Dict[str, Any]) -> int:

@@ -51,10 +51,99 @@ from ..log import Log
 from ..parallel.async_ps import (DENSE, KEYED, KV, STATE, EpochFence,
                                  _deserialize, _kv_get_int, _serialize,
                                  claim_epoch)
+from ..quantization import SparseFilter, dequantize_int8, quantize_int8
 from .faultinject import FaultPlan
 
 LABEL = "mvparam"
 TRAINER_RANK = 0
+
+# -- wire codec ---------------------------------------------------------------
+#
+# Delta records cross the DCN, not ICI, so their bytes are the one part
+# of the publish stream worth shrinking (the reference's SparseFilter
+# motivation unchanged). Two encodings ride behind the existing array
+# framing, self-describing by ARRAY COUNT AND TRAILING DTYPE so the
+# subscriber needs no flag agreement with the publisher:
+#
+# * DENSE   raw=[delta]            filtered=[blob, size int64]
+#           quant=[q int8, scale fp32]
+# * KEYED   raw=[ids, vals]        filtered=[ids, blob, size int64]
+#           quant=[ids, q int8, scale fp32]
+#
+# A filtered payload always ends with the SparseFilter's int64
+# size-info blob; the int8 codec always ends with an fp32 scale — the
+# two can never collide, and the raw forms have strictly fewer arrays.
+# Filtering (``-param_wire_compress``, default on) is lossless;
+# ``-param_wire_quant int8`` is LOSSY (symmetric per-tensor int8) and
+# therefore default off — it is the knob for delta streams whose
+# consumers already tolerate quantization noise (e.g. feeding
+# int8-pinned decode replicas). STATE rebases always ship raw: they
+# are once-per-restart absolute values, exactly where lossy wire
+# encoding must never apply.
+
+_SIZE_INFO_DTYPE = np.dtype(np.int64)
+_WIRE_FILTERS: Dict[Any, SparseFilter] = {}
+
+
+def _filter_for(dtype) -> SparseFilter:
+    """Memoized per-dtype :class:`SparseFilter` (filter instances are
+    typed, and the publish path must not allocate one per record)."""
+    dt = np.dtype(dtype)
+    filt = _WIRE_FILTERS.get(dt)
+    if filt is None:
+        filt = _WIRE_FILTERS[dt] = SparseFilter(dtype=dt)
+    return filt
+
+
+def encode_dense(host: np.ndarray, compress: bool,
+                 quant: str) -> list:
+    """DENSE delta -> wire arrays (see the codec table above). Pure, so
+    benches can measure codec bytes without a transport."""
+    if quant == "int8":
+        q, s = quantize_int8(host)
+        return [q, s]
+    if compress:
+        return _filter_for(host.dtype).filter_in([host])
+    return [host]
+
+
+def decode_dense(arrays, dtype, shape) -> np.ndarray:
+    """Invert :func:`encode_dense` -> the dense delta, table-shaped."""
+    if len(arrays) == 1:
+        dense = np.asarray(arrays[0], dtype)
+    elif np.asarray(arrays[-1]).dtype == _SIZE_INFO_DTYPE:
+        dense = np.asarray(
+            _filter_for(dtype).filter_out(list(arrays))[0], dtype)
+    else:
+        dense = dequantize_int8(arrays[0], arrays[1], dtype)
+    return dense.reshape(shape)
+
+
+def encode_keyed(ids: np.ndarray, vals: np.ndarray, compress: bool,
+                 quant: str) -> list:
+    """KEYED delta -> wire arrays; only ``vals`` is encoded (ids are
+    already the sparse half of the record)."""
+    if quant == "int8":
+        q, s = quantize_int8(vals)
+        return [ids, q, s]
+    if compress:
+        return [ids] + _filter_for(vals.dtype).filter_in([vals])
+    return [ids, vals]
+
+
+def decode_keyed(arrays, dtype):
+    """Invert :func:`encode_keyed` -> ``(ids, vals)`` with ``vals``
+    row-aligned to ``ids`` (the filtered form ships flat)."""
+    ids = np.asarray(arrays[0], np.int32)
+    if len(arrays) == 2:
+        return ids, np.asarray(arrays[1])
+    if np.asarray(arrays[-1]).dtype == _SIZE_INFO_DTYPE:
+        vals = np.asarray(
+            _filter_for(dtype).filter_out(list(arrays[1:]))[0], dtype)
+        if ids.size and vals.size != ids.size:
+            vals = vals.reshape(ids.size, -1)
+        return ids, vals
+    return ids, dequantize_int8(arrays[1], arrays[2], dtype)
 
 
 class ParamPublisher:
@@ -69,11 +158,30 @@ class ParamPublisher:
     def __init__(self, client: Any, size: int, label: str = LABEL,
                  epoch: Optional[int] = None,
                  chaos: Optional[FaultPlan] = None,
-                 kill_fn: Optional[Callable[[], None]] = None) -> None:
+                 kill_fn: Optional[Callable[[], None]] = None,
+                 wire_compress: Optional[bool] = None,
+                 wire_quant: Optional[str] = None) -> None:
         from ..parallel.p2p import P2PTransport
 
         self._client = client
         self._label = label
+        self.wire_compress = (
+            bool(config.get_flag("param_wire_compress"))
+            if wire_compress is None else bool(wire_compress))
+        self.wire_quant = (
+            str(config.get_flag("param_wire_quant"))
+            if wire_quant is None else str(wire_quant))
+        if self.wire_quant not in ("none", "int8"):
+            Log.fatal(f"param plane: unknown param_wire_quant "
+                      f"{self.wire_quant!r} (none|int8)")
+        # wire-codec ledger: payload bytes actually sent vs what the
+        # raw (uncoded) delta arrays would have cost — the
+        # wire_compressed_ratio denominator. Delta records only; STATE
+        # rebases ship raw by contract and count into both sides
+        # equally via publish_record's payload tally.
+        self.publish_bytes = 0
+        self._delta_raw_bytes = 0
+        self._delta_wire_bytes = 0
         self.epoch = (claim_epoch(client, f"{label}/epoch")
                       if epoch is None else int(epoch))
         if epoch is not None:
@@ -91,6 +199,8 @@ class ParamPublisher:
         self._seq = 0
         self.publishes = 0
         self._counter = Dashboard.get_or_create_counter("PARAM_PUBLISHES")
+        self._bytes_counter = Dashboard.get_or_create_counter(
+            "PARAM_PUBLISH_BYTES")
         Log.info("param plane: publisher up (epoch %d, %d subscriber "
                  "slot(s))", self.epoch, int(size) - 1)
 
@@ -111,17 +221,28 @@ class ParamPublisher:
         (``version`` defaults to the table's current = post-apply
         version; single-writer trainer contract)."""
         host = np.asarray(delta, dtype=table.dtype).reshape(table.shape)
+        arrays = encode_dense(host, self.wire_compress, self.wire_quant)
+        self._note_delta_bytes([host], arrays)
         self.publish_record(
-            DENSE, table.table_id, [host], option=option,
+            DENSE, table.table_id, arrays, option=option,
             version=table.version if version is None else int(version))
 
     def publish_keyed(self, table, ids, vals, option=None,
                       version: Optional[int] = None) -> None:
+        ids = np.asarray(ids, np.int32).ravel()
+        vals = np.asarray(vals)
+        arrays = encode_keyed(ids, vals, self.wire_compress,
+                              self.wire_quant)
+        self._note_delta_bytes([ids, vals], arrays)
         self.publish_record(
-            KEYED, table.table_id,
-            [np.asarray(ids, np.int32).ravel(), np.asarray(vals)],
-            option=option,
+            KEYED, table.table_id, arrays, option=option,
             version=table.version if version is None else int(version))
+
+    def _note_delta_bytes(self, raw, encoded) -> None:
+        self._delta_raw_bytes += sum(
+            np.asarray(a).nbytes for a in raw)
+        self._delta_wire_bytes += sum(
+            np.asarray(a).nbytes for a in encoded)
 
     def publish_kv(self, table, keys, vals,
                    version: Optional[int] = None) -> None:
@@ -149,11 +270,17 @@ class ParamPublisher:
         self._transport.send(self._seq, payload)
         self._seq += 1
         self.publishes = k
+        self.publish_bytes += len(payload)
         self._counter.inc()
+        self._bytes_counter.inc(len(payload))
         sp.end(bytes=len(payload))
 
     def stats(self) -> Dict[str, Any]:
         return {"epoch": self.epoch, "publishes": self.publishes,
+                "publish_bytes": self.publish_bytes,
+                "wire_compressed_ratio": (
+                    self._delta_wire_bytes
+                    / max(self._delta_raw_bytes, 1)),
                 "chaos": self.chaos.stats()}
 
     def stop(self) -> None:
@@ -306,11 +433,11 @@ class ParamSubscriber:
             self.states_applied += 1
         elif kind == DENSE:
             table._apply_remote_dense(
-                np.asarray(arrays[0], table.dtype).reshape(table.shape),
-                option)
+                decode_dense(arrays, table.dtype, table.shape), option)
             self._pin_version(table, version, epoch)
         elif kind == KEYED:
-            table._apply_remote_keyed(arrays[0], arrays[1], option)
+            ids, vals = decode_keyed(arrays, table.dtype)
+            table._apply_remote_keyed(ids, vals, option)
             self._pin_version(table, version, epoch)
         elif kind == KV:
             table._apply_remote_kv(arrays[0], arrays[1])

@@ -133,6 +133,8 @@ class InferenceServer:
                          decode_tp: Optional[int] = None,
                          prefix_cache: Optional[bool] = None,
                          spec_k: Optional[int] = None,
+                         kv_quant: Optional[str] = None,
+                         decode_param_quant: Optional[str] = None,
                          preempt: Optional[bool] = None,
                          preempt_budget: Optional[int] = None,
                          sched_lookahead: Optional[int] = None,
@@ -175,7 +177,16 @@ class InferenceServer:
         by one fused fixed-K step per iteration — up to ``spec_k + 1``
         tokens per iteration, outputs token-identical to plain greedy
         decode (docs/SERVING.md "Speculative decoding"; needs the
-        paged KV cache). ``preempt`` (None = the ``-preempt`` flag,
+        paged KV cache). ``kv_quant`` (None = the ``-kv_quant`` flag,
+        default "none") stores the paged K/V pools as int8 with
+        per-(layer, block) fp32 scales — ~4x the KV capacity at equal
+        pool bytes, lossy (the bench archives the argmax-match rate);
+        "none" keeps today's fp pools bit-for-bit.
+        ``decode_param_quant`` (None = the ``-decode_param_quant``
+        flag, default "none") pins int8-quantized decode param
+        snapshots and folds the dequant into the compiled programs —
+        ~4x smaller pin copies (docs/SERVING.md "Quantized KV &
+        params"). ``preempt`` (None = the ``-preempt`` flag,
         default on; paged + chunked only) switches paged admission to
         OPTIMISTIC prompt-only reservation with grow-at-decode and
         preemption-with-recompute under pool pressure —
@@ -200,7 +211,9 @@ class InferenceServer:
             prefill_token_budget=prefill_token_budget,
             kv_block_size=kv_block_size, kv_pool_blocks=kv_pool_blocks,
             decode_tp=decode_tp, prefix_cache=prefix_cache,
-            spec_k=spec_k, preempt=preempt, preempt_budget=preempt_budget,
+            spec_k=spec_k, kv_quant=kv_quant,
+            decode_param_quant=decode_param_quant,
+            preempt=preempt, preempt_budget=preempt_budget,
             sched_lookahead=sched_lookahead,
             watchdog=watchdog, debug_dump_dir=debug_dump_dir,
             slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)

@@ -117,6 +117,35 @@ def shard_for_decode(value: Any, decode_mesh, shardings: Optional[Any]
     return jax.device_put(value, shardings)
 
 
+def quantize_decode_params(value: Any) -> Any:
+    """int8 symmetric snapshot of a params pytree for decode pinning.
+
+    Every leaf becomes ``{"q": int8, "s": fp32 scale}`` — per-COLUMN
+    (reduce the input axis, keepdims) for matrices so the Megatron-split
+    weights keep per-output-channel resolution, per-tensor for vectors.
+    Runs in HOST numpy, deliberately: the pin path
+    (``DecodeEngine._maybe_refresh``) is reachable from the engine loop,
+    where constructing a jit would be an RT106 hazard — and the quant
+    runs ONCE per pinned snapshot version (``pin_copies`` memoization),
+    so host arithmetic is off the per-token path entirely. The pinned
+    pytree then rides :func:`replicate_for_decode` /
+    :func:`shard_for_decode` as ~4x fewer bytes per device_put, and the
+    decode programs fold
+    :func:`models.transformer.dequantize_decode_params` in at compile
+    time."""
+    import jax
+    import numpy as np
+
+    from ..quantization import quantize_int8
+
+    def quant(leaf) -> Any:
+        host = np.asarray(leaf)
+        q, s = quantize_int8(host, axis=-2 if host.ndim >= 2 else None)
+        return {"q": q, "s": s}
+
+    return jax.tree.map(quant, value)
+
+
 class SnapshotManager:
     """Publishes/refreshes snapshots of one source (table or model).
 

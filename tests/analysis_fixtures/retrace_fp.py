@@ -183,6 +183,40 @@ class FpShardedEngine:
         return self._step(1.0) + self._chunk(2.0)
 
 
+def _build_fp_quant_programs(fn):
+    """Quantized-pool program builder: ONE step/chunk pair whose scale
+    arrays are traced OPERANDS (threaded through every call like the
+    pools themselves), built at construction by the engine below (the
+    kv_quant one-trace contract)."""
+    step = jax.jit(fn, donate_argnums=(0,))
+    chunk = jax.jit(fn)
+    return step, chunk
+
+
+class FpQuantEngine:
+    """RT106: the quantized-KV contract upheld — the int8 step/chunk
+    programs are built once in __init__/warmup through a module-level
+    builder, and the iteration path DISPATCHES them with the scale
+    arrays riding along as traced data (a scale update is a new operand
+    value, never a new program)."""
+
+    def __init__(self, fn):
+        self._step, self._chunk = _build_fp_quant_programs(fn)
+
+    def warmup(self):
+        # warmup may rebuild the quant programs (e.g. after a pool
+        # resize changes the scale-array shape) — still construction
+        self._step, self._chunk = _build_fp_quant_programs(lambda x: x)
+        return self._step(0.0)
+
+    def _loop(self):
+        while True:
+            self._iterate()
+
+    def _iterate(self):
+        return self._step(1.0) + self._chunk(2.0)
+
+
 def _build_fp_xfer_programs(fn):
     """KV-transfer fetch/splice program builders: ONE host-gather and
     ONE donating scatter per pool layout, built at construction by the

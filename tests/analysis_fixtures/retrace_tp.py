@@ -163,6 +163,35 @@ class Rt106SpecEngine:
         return verify(4, 1.0)
 
 
+def _build_quant_step(fn, scales):
+    """A quantized-pool step-program builder: folding the per-block
+    scale LAYOUT (not the values) into the compiled step at
+    construction time IS its job (sanctioned at module level; hazardous
+    only when the iteration path rebuilds it — see Rt106QuantEngine)."""
+    return jax.jit(lambda x: fn(x) * scales.shape[0])
+
+
+class Rt106QuantEngine:
+    """RT106 via the quantized KV plane: rebuilding the step program
+    per iteration because the scale arrays changed (e.g. baking the
+    CURRENT scales in as compile-time constants instead of passing them
+    as traced operands) recompiles on every written block — scales must
+    ride the program as traced data, the program built once per pool
+    layout."""
+
+    def __init__(self, fn, scales):
+        self._fn = fn
+        self._scales = scales
+
+    def _loop(self):
+        while True:
+            self._iterate()
+
+    def _iterate(self):
+        step = _build_quant_step(self._fn, self._scales)   # RT106 builder
+        return step(1.0)
+
+
 def _build_xfer_fetch(fn):
     """A KV-transfer fetch-program builder: one host-gather program per
     pool layout at construction time IS its job (sanctioned at module

@@ -20,10 +20,12 @@ from tools.engine_timeline import load_ring, main, render, timeline_report
 def _rec(it, ts, busy=1.0, step=0.5, live=1, reserved=0, queue=0,
          queue_age=0.0, prefill=0, decode=1, pool_free=-1, pool_live=-1,
          pool_shared=-1, version=0, admitted=(), completed=(),
-         spec_proposed=-1, spec_accepted=-1):
+         spec_proposed=-1, spec_accepted=-1, kv_quant=-1,
+         quant_scale_blocks=-1):
     return (it, ts, busy, step, live, reserved, queue, queue_age,
             prefill, decode, pool_free, pool_live, pool_shared, version,
-            admitted, completed, spec_proposed, spec_accepted)
+            admitted, completed, spec_proposed, spec_accepted, kv_quant,
+            quant_scale_blocks)
 
 
 # -- ring ---------------------------------------------------------------------
@@ -146,6 +148,20 @@ def test_spec_counter_track_and_legacy_tuple_tolerance():
     assert legacy.summary()["iterations"] == 1
     assert not any(e["name"].endswith("/spec")
                    for e in legacy.chrome_counter_events())
+
+    # pre-quant 18-field tuples (this PR appended kv_quant /
+    # quant_scale_blocks at the END) read cleanly the same way
+    pre_quant = FlightRecorder(capacity=8, name="pq")
+    pre_quant.record(_rec(1, time.monotonic(),
+                          spec_proposed=4, spec_accepted=3)[:18])
+    recs = pre_quant.records()
+    assert "kv_quant" not in recs[0] and recs[0]["spec_proposed"] == 4
+    assert pre_quant.summary()["iterations"] == 1
+    # a quant engine's record carries the columns
+    qr = FlightRecorder(capacity=8, name="q")
+    qr.record(_rec(1, time.monotonic(), kv_quant=1, quant_scale_blocks=7))
+    assert qr.records()[0]["kv_quant"] == 1
+    assert qr.records()[0]["quant_scale_blocks"] == 7
 
 
 # -- engine integration -------------------------------------------------------

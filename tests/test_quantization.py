@@ -121,3 +121,125 @@ def test_float64_filter():
     comp = f.try_compress(blob)
     assert comp is not None and comp.nbytes == 4 + 8
     np.testing.assert_array_equal(f.decompress(comp, 32), blob)
+
+
+def test_float64_pair_byte_boundary():
+    # fp64 pairs cost 12 bytes vs 8 dense: 32 elements = 256 dense
+    # bytes, so 21 pairs (252 B) compress and 22 pairs (264 B) must
+    # not — the EXACT profitability boundary, in bytes not elements.
+    f = SparseFilter(dtype=np.float64)
+    blob = np.zeros(32, np.float64)
+    blob[:21] = 1.0
+    comp = f.try_compress(blob)
+    assert comp is not None and comp.nbytes == 21 * 12
+    np.testing.assert_array_equal(f.decompress(comp, 32), blob)
+    blob[21] = 1.0
+    assert f.try_compress(blob) is None
+
+
+def test_float16_pair_byte_boundary():
+    # fp16 pairs cost 6 bytes vs 2 dense: 60 elements = 120 dense
+    # bytes, 19 pairs (114 B) compress, 20 pairs (120 B) tie -> dense
+    # (the rule is strictly-cheaper).
+    f = SparseFilter(dtype=np.float16)
+    blob = np.zeros(60, np.float16)
+    blob[:19] = 1.0
+    comp = f.try_compress(blob)
+    assert comp is not None and comp.nbytes == 19 * 6
+    np.testing.assert_array_equal(f.decompress(comp, 60), blob)
+    blob[19] = 1.0
+    assert f.try_compress(blob) is None
+
+
+def test_option_blob_with_all_dense_payload_roundtrips():
+    # skip_option_blob + every payload blob dense: the wire is blobs +
+    # size-info with ALL -1 sentinels, and filter_out must hand back
+    # each blob (including the option) byte-for-byte.
+    f = SparseFilter(skip_option_blob=True)
+    dense_a = np.arange(1, 17, dtype=np.float32)
+    dense_b = np.arange(17, 33, dtype=np.float32)
+    option = np.array([7, 1], np.int32)
+    wire = f.filter_in([dense_a, dense_b, option])
+    assert len(wire) == 4
+    size_info = wire[-1]
+    assert list(size_info) == [-1, -1, -1]
+    out = f.filter_out(wire)
+    np.testing.assert_array_equal(out[0], dense_a)
+    np.testing.assert_array_equal(out[1], dense_b)
+    np.testing.assert_array_equal(out[2], option)
+    assert out[2].dtype == np.int32
+
+
+def test_decompress_rejects_truncated_blob():
+    # the OTHER corrupt-blob fatal: a byte count that does not factor
+    # into (index, value) pairs (a mid-pair truncation on the wire).
+    from multiverso_tpu.log import FatalError
+
+    f = SparseFilter()
+    blob = np.zeros(100, np.float32)
+    blob[50] = 1.0
+    comp = f.try_compress(blob)
+    truncated = np.frombuffer(comp.tobytes()[:-3], np.uint8)
+    with pytest.raises(FatalError):
+        f.decompress(truncated, 100)
+
+
+def test_filter_out_rejects_mismatched_size_info():
+    from multiverso_tpu.log import FatalError
+
+    f = SparseFilter()
+    wire = f.filter_in([np.zeros(8, np.float32)])
+    wire.insert(0, np.arange(4, dtype=np.float32))  # extra payload blob
+    with pytest.raises(FatalError):
+        f.filter_out(wire)
+
+
+def test_int8_roundtrip_per_tensor():
+    from multiverso_tpu.quantization import dequantize_int8, quantize_int8
+
+    rng = np.random.default_rng(7)
+    arr = rng.standard_normal((5, 9)).astype(np.float32)
+    q, s = quantize_int8(arr)
+    assert q.dtype == np.int8 and s.dtype == np.float32 and s.shape == (1,)
+    out = dequantize_int8(q, s)
+    assert out.dtype == np.float32
+    # symmetric int8: error bounded by half a quant step per element
+    np.testing.assert_allclose(out, arr, atol=float(s[0]) / 2 + 1e-7)
+
+
+def test_int8_roundtrip_per_axis():
+    from multiverso_tpu.quantization import dequantize_int8, quantize_int8
+
+    rng = np.random.default_rng(8)
+    arr = rng.standard_normal((6, 4)).astype(np.float32)
+    arr[:, 1] *= 100.0  # per-axis scales must isolate the hot column
+    q, s = quantize_int8(arr, axis=0)
+    assert s.shape == (1, 4)
+    out = dequantize_int8(q, s)
+    for j in range(4):
+        np.testing.assert_allclose(out[:, j], arr[:, j],
+                                   atol=float(s[0, j]) / 2 + 1e-7)
+
+
+def test_int8_identity_requant_no_drift():
+    # the KV write-path identity: values that ARE quantized points
+    # round-trip exactly (round(q*s/s) == q), so rewriting a block at
+    # an unchanged scale never drifts.
+    from multiverso_tpu.quantization import dequantize_int8, quantize_int8
+
+    rng = np.random.default_rng(9)
+    arr = rng.standard_normal(64).astype(np.float32)
+    q, s = quantize_int8(arr)
+    deq = dequantize_int8(q, s)
+    q2, s2 = quantize_int8(deq)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q2))
+    np.testing.assert_allclose(np.asarray(s), np.asarray(s2), rtol=1e-6)
+
+
+def test_int8_zero_array_yields_zero_scale():
+    from multiverso_tpu.quantization import dequantize_int8, quantize_int8
+
+    q, s = quantize_int8(np.zeros(16, np.float32))
+    assert float(s[0]) == 0.0
+    np.testing.assert_array_equal(dequantize_int8(q, s),
+                                  np.zeros(16, np.float32))

@@ -56,6 +56,7 @@ EXPECTED_TP = {
     ("RT106", "Rt106ShardedEngine._iterate"),    # builder on the hot path
     ("RT106", "Rt106SpecEngine._iterate"),       # verify-step builder
     ("RT106", "Rt106XferEngine._iterate"),       # kv-transfer fetch builder
+    ("RT106", "Rt106QuantEngine._iterate"),      # quant-step builder
 }
 
 

@@ -99,6 +99,11 @@ from typing import Dict, List, Tuple
 # advertisement exist to shrink them), and the fraction of blocks that
 # dedup'd instead of shipping regresses DOWN. The saturated tok/s of
 # each leg archives as _info — it measures the trace mix, not the code.
+# publish_bytes is the mvparam wire's cousin of kv_bytes_moved: bytes a
+# publisher shipped per delta stream (post SparseFilter/int8 codec) —
+# regressing UP means the wire compression stopped paying. Its ratio
+# sibling wire_compressed_ratio archives as *_info (ratio would hit the
+# higher-better rule backwards: smaller is better there).
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
                   "capacity_seqs", "prefill_tokens_saved",
                   "prefix_hit_rate", "accepted_per_step",
@@ -110,7 +115,7 @@ _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
                  "output_mismatches", "recovery_time_s",
                  "updates_lost", "epoch_fence_rejections_unexpected",
                  "preempt_output_mismatches", "starved_requests",
-                 "deadline_drops", "kv_bytes_moved")
+                 "deadline_drops", "kv_bytes_moved", "publish_bytes")
 
 
 def metric_direction(name: str) -> int:

@@ -701,6 +701,117 @@ def _prefix_cache_ab(server, lm_model, quick: bool) -> dict:
     }
 
 
+def _quant_kv_ab(server, lm_model, quick: bool) -> dict:
+    """Quantized-KV A/B: int8 per-block-scaled pools vs fp32 pools at
+    EQUAL device KV bytes on the shared-prefix zipf trace.
+
+    Both engines get the same byte budget; ``blocks_for_bytes`` turns
+    it into a block count per encoding, so the int8 side's ~4x cheaper
+    blocks (int8 payload + per-(layer, block) fp32 scales — the scales
+    are IN the budget) buy ~4x the usable pool. At a budget sized for
+    ~2 fp reservations the fp side throttles on pool pressure while the
+    int8 side packs several times more CONCURRENT sequences —
+    ``capacity_seqs`` (gated up, >= 2x) is the headline. Quantization
+    is lossy, so the harness REPLAYS the identical trace through both
+    engines and archives the per-request argmax agreement
+    (``argmax_match_rate_info``, also pushed into the quant engine's
+    stats via ``record_argmax_match``) next to the capacity win: the
+    quality cost ships with the number that pays for it. tok/s rides as
+    ``_info`` (scheduling noise); the one-trace invariant is gated on
+    BOTH sides (quantized programs compile once, scales ride as traced
+    data).
+    """
+    from multiverso_tpu.serving.block_pool import (blocks_for_bytes,
+                                                   kv_bytes_per_block)
+
+    block_size = 8
+    prefix_len, tail_max, cap, min_new = 64, 8, 24, 12
+    max_prompt = prefix_len + tail_max
+    mcfg = lm_model.config
+    # budget = ~2 uncached fp reservations (22 usable fp blocks)
+    budget = 23 * kv_bytes_per_block(mcfg.n_layers, mcfg.d_model,
+                                     block_size, mcfg.dtype)
+    pool_blocks = {
+        "fp32": blocks_for_bytes(budget, mcfg.n_layers, mcfg.d_model,
+                                 block_size, mcfg.dtype),
+        "int8": blocks_for_bytes(budget, mcfg.n_layers, mcfg.d_model,
+                                 block_size, mcfg.dtype, quant="int8"),
+    }
+    n = 24 if quick else 48
+    vocab = mcfg.vocab_size
+    rng = np.random.default_rng(23)
+    prefixes = [rng.integers(1, vocab, prefix_len).astype(np.int32)
+                for _ in range(4)]
+    trace, t = [], 0.0
+    for _ in range(n):
+        t += float(rng.exponential(0.002))
+        head = prefixes[min(int(rng.zipf(1.8)) - 1, len(prefixes) - 1)]
+        tail = rng.integers(1, vocab,
+                            int(rng.integers(1, tail_max + 1))).astype(
+            np.int32)
+        n_new = int(min(cap, min_new + rng.zipf(1.6)))
+        trace.append((t, np.concatenate([head, tail]), n_new))
+    useful = sum(n_new for _, _, n_new in trace)
+
+    rows, outputs, engines = {}, {}, {}
+    for label, quant in (("fp32", "none"), ("int8", "int8")):
+        engine = server.register_decoder(
+            f"lm_qkv_{label}", lm_model, slots=24, max_prompt=max_prompt,
+            max_new=cap, max_queue=max(64, n),
+            prompt_buckets=(max_prompt,), kv_block_size=block_size,
+            kv_pool_blocks=pool_blocks[label], prefill_token_budget=32,
+            kv_quant=quant)
+        engine.warmup()
+        _play_decode_trace(server, f"lm_qkv_{label}",
+                           [(0.0, np.ones(4, np.int32), 2)] * 4, True)
+        engine.reset_stats()
+        results, elapsed = _play_decode_trace(server, f"lm_qkv_{label}",
+                                              trace, True)
+        outputs[label] = [np.asarray(r["result"]) for r in results]
+        engines[label] = engine
+        s = engine.stats()
+        rows[label] = {
+            "kv_pool_blocks": pool_blocks[label],
+            "kv_bytes_per_device_info": s["kv_bytes_per_device"],
+            "capacity_seqs": s["peak_live_seqs"],
+            "prefill_tokens_saved_info": s["prefill_tokens_saved"],
+            "tokens_per_s_info": round(useful / elapsed, 1),
+            "ttft_p50_ms_info": round(s["ttft_p50_ms"], 3),
+            "shed_rate_info": round(s["shed_rate"], 4),
+            "step_traces": s["step_traces"],
+            "prefill_traces": s["prefill_traces"],
+            "decode_step_retraces": s["decode_step_retraces"],
+        }
+        if quant == "int8":
+            rows[label]["quant_scale_blocks_info"] = \
+                s["quant_scale_blocks"]
+    # quality: per-request argmax agreement vs the fp32 engine on the
+    # IDENTICAL trace, pushed into the quant engine's stats surface so
+    # flight dumps and dashboards carry it too
+    matches = []
+    for a, b in zip(outputs["fp32"], outputs["int8"]):
+        m = max(a.size, b.size)
+        k = min(a.size, b.size)
+        matches.append(float((a[:k] == b[:k]).sum()) / m if m else 1.0)
+    rate = round(float(np.mean(matches)), 4)
+    engines["int8"].record_argmax_match(rate)
+    fp_row, q_row = rows["fp32"], rows["int8"]
+    return {
+        "requests": n,
+        "useful_tokens": useful,
+        "kv_budget_bytes": budget,
+        "shared_prefix_len": prefix_len,
+        "fp32": fp_row,
+        "int8": q_row,
+        "capacity_ratio": (round(q_row["capacity_seqs"]
+                                 / fp_row["capacity_seqs"], 2)
+                           if fp_row["capacity_seqs"] else float("inf")),
+        "blocks_ratio_info": round(q_row["kv_pool_blocks"]
+                                   / fp_row["kv_pool_blocks"], 2),
+        "argmax_match_rate_info": rate,
+    }
+
+
 def _spec_decode_ab(server, lm_model, quick: bool) -> dict:
     """Speculative-decoding A/B: n-gram prompt-lookup drafting
     (spec_k=4) vs the plain one-token engine (spec_k=0) — same model,
@@ -1540,6 +1651,7 @@ def _trainer_chaos_ab(quick: bool) -> dict:
                     "stale_peak": stale_peak,
                     "replayed": replayed,
                     "restored_step": restored_step,
+                    "pub_stats": pub.stats(),
                 }
                 fence_stats[label] = {
                     "rejections": sub._fence.rejections,
@@ -1579,6 +1691,14 @@ def _trainer_chaos_ab(quick: bool) -> dict:
         "staleness_peak_s_info": round(on["stale_peak"], 4),
         "wal_replay_records_info": on["replayed"],
         "checkpoint_step_info": on["restored_step"],
+        # the mvparam wire ledger (fault-free leg: the full stream went
+        # through ONE publisher, so the byte count is deterministic):
+        # bytes shipped post-codec regress UP; the compressed/raw ratio
+        # is _info (dense random deltas don't compress — the ratio
+        # documents the traffic, the SparseFilter tests gate the codec)
+        "publish_bytes": off["pub_stats"]["publish_bytes"],
+        "wire_compressed_ratio_info": round(
+            off["pub_stats"]["wire_compressed_ratio"], 4),
     }
 
 
@@ -1682,6 +1802,14 @@ def run(duration_s: float = 2.0, clients: int = 32,
                                n_layers=2, d_ff=256, max_seq=96)
     out["workloads"]["lm_prefix_cache"] = _prefix_cache_ab(
         server, TransformerLM(pc_cfg), quick)
+    # quantized-KV A/B right after it: the same capacity-led posture
+    # (gated numbers are peak live sequences and trace counts at an
+    # equal byte budget), plus the replayed-trace argmax-match quality
+    # number that must be measured while the box is still quiet
+    qkv_cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                                n_layers=2, d_ff=256, max_seq=96)
+    out["workloads"]["lm_quant_kv"] = _quant_kv_ab(
+        server, TransformerLM(qkv_cfg), quick)
     # speculative-decoding A/B fourth: tok/s-led (its gated numbers are
     # a genuine schedule speedup on the repetitive trace, plus the
     # accepted_per_step amortization metric) — run before the box

@@ -210,6 +210,12 @@ def request_report(spans, device_events=None):
         # served each row (replicated engines omit it — no column)
         if admits and "decode_tp" in admits[0]["args"]:
             row["decode_tp"] = admits[0]["args"]["decode_tp"]
+        # quantized-KV engines annotate the admit span with the pool
+        # encoding: the report then says which requests were served off
+        # int8 pools (fp engines omit it — no column), the first thing
+        # to check when a fleet's outputs drift between replicas
+        if admits and "kv_quant" in admits[0]["args"]:
+            row["kv_quant"] = admits[0]["args"]["kv_quant"]
         # preempted-and-resumed requests: decode.preempt spans count the
         # evictions and the resume's admit span carries the running
         # total — a fat total_ms next to a nonzero preempt column says
@@ -249,6 +255,7 @@ def print_request_report(rows, top: int, sort: str,
     has_blocks = any("blocks" in r for r in rows)
     has_prefix = any("prefix_hit_blocks" in r for r in rows)
     has_tp = any("decode_tp" in r for r in rows)
+    has_quant = any("kv_quant" in r for r in rows)
     has_preempt = any("preempted" in r for r in rows)
     has_xfer = any("xfer_blocks" in r for r in rows)
     has_keep = any(r.get("keep") for r in rows)
@@ -273,6 +280,8 @@ def print_request_report(rows, top: int, sort: str,
         hdr += f" {'pfxhit':>7} {'saved':>6}"
     if has_tp:
         hdr += f" {'tp':>3}"
+    if has_quant:
+        hdr += f" {'quant':>6}"
     if has_preempt:
         hdr += f" {'preempt':>8}"
     if has_xfer:
@@ -298,6 +307,8 @@ def print_request_report(rows, top: int, sort: str,
                      f"{str(r.get('prefill_tokens_saved', '-')):>6}")
         if has_tp:
             line += f" {str(r.get('decode_tp', '-')):>3}"
+        if has_quant:
+            line += f" {str(r.get('kv_quant', '-')):>6}"
         if has_preempt:
             line += f" {str(r.get('preempted', '-')):>8}"
         if has_xfer:
