@@ -498,3 +498,34 @@ define_bool("lockwatch", False,
             "(latent deadlock) increments LOCK_ORDER_VIOLATIONS and "
             "trips engine watchdogs with kind 'lock_order' "
             "(docs/ANALYSIS.md; always on in the test suite)")
+define_bool("cost_ledger", False,
+            "per-tenant cost attribution (serving/accounting.py): each "
+            "decode request carries a host-only resource vector (queue "
+            "wait, prefill/decode tokens, KV block-seconds, device step "
+            "ms, transfer bytes, preemption recompute) finalized into "
+            "per-tenant aggregates + lazy TENANT_*[engine.tenant] "
+            "instruments the obs plane merges fleet-wide "
+            "(docs/OBSERVABILITY.md 'Tenant accounting'); off = today's "
+            "metrics surface byte-for-byte")
+define_string("default_tenant", "default",
+              "tenant id charged when a request carries none (back-"
+              "compat: pre-tenant clients, archived wire payloads)")
+define_int("tenant_max", 64,
+           "per-engine tenant cardinality cap: past this many distinct "
+           "tenant ids, new ones fold into the '~other' bucket — lazy "
+           "keyed instruments stay bounded however hostile the ids")
+define_float("cost_token", 1.0,
+             "cost-weight: units per token computed (prefill + decode); "
+             "the 1.0 default makes cost == tokens, deterministic and "
+             "reconcilable to the engine counters")
+define_float("cost_token_ms", 0.0,
+             "cost-weight: units per device-step millisecond attributed "
+             "by active-lane share; 0 = device time rides the vector "
+             "but is not priced")
+define_float("cost_block_byte_s", 0.0,
+             "cost-weight: units per KV byte-second of residency "
+             "(kv_block_s x the engine's per-block K/V bytes); 0 = "
+             "residency rides the vector but is not priced")
+define_float("cost_xfer_byte", 0.0,
+             "cost-weight: units per raw KV transfer byte that crossed "
+             "the engine boundary (fetched out or spliced in)")

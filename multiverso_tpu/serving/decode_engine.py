@@ -157,6 +157,7 @@ from ..dashboard import Dashboard
 from ..log import Log
 from .batcher import (DeadlineExceededError, OverloadedError, bucket_for,
                       shape_buckets)
+from . import accounting
 from . import kv_transfer
 from .block_pool import (SCRATCH_BLOCK, BlockPool, chain_hashes,
                          kv_bytes_per_block)
@@ -237,6 +238,11 @@ class DecodeEngineConfig:
     debug_dump_dir: Optional[str] = None
     slo_ttft_ms: Optional[float] = None
     slo_itl_ms: Optional[float] = None
+    # per-tenant cost attribution (None = the -cost_ledger flag): a
+    # host-only CostLedger accumulating each request's resource vector
+    # at the existing instrumentation sites (serving/accounting.py;
+    # False = today's metrics surface byte-for-byte)
+    cost_ledger: Optional[bool] = None
 
     def _resolved(self, field: str, flag: Optional[str] = None):
         value = getattr(self, field)
@@ -559,12 +565,13 @@ class _Request:
                  "n_hit", "full_hit", "saved", "pf_reg", "ttft_pending",
                  "drafter", "priority", "deadline", "preempts",
                  "resumed", "skips", "prompt0", "pf_only", "known",
-                 "xfer")
+                 "xfer", "tenant", "usage")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  ctx: Optional[trace.SpanContext] = None,
                  priority: int = DEFAULT_PRIORITY,
-                 deadline: Optional[float] = None) -> None:
+                 deadline: Optional[float] = None,
+                 tenant: Optional[str] = None) -> None:
         self.rid = next(_RIDS)
         self.prompt = prompt
         self.max_new = max_new
@@ -621,6 +628,12 @@ class _Request:
         self.pf_only = False
         self.known: frozenset = frozenset()
         self.xfer: Optional[Dict[str, int]] = None
+        # per-tenant cost attribution: the submitted tenant id (None =
+        # the ledger's default tenant) and the request's host-only
+        # resource vector — None on ledger-off engines, so every
+        # attribution site is a single is-None check there
+        self.tenant = tenant
+        self.usage: Optional[accounting.ResourceUsage] = None
 
 
 class DecodeEngine:
@@ -1226,6 +1239,18 @@ class DecodeEngine:
             # trace_summary quant column; off-quant spans stay flat —
             # the metrics-regression byte-identity contract)
             self._mesh_attrs["kv_quant"] = self._kv_quant_mode
+        # per-tenant cost attribution (the -cost_ledger gate): pure
+        # host state on the loop thread — attaching it can never add a
+        # compiled trace (step/prefill traces stay 1, retraces 0) and
+        # off-ledger engines keep today's metrics surface byte-for-byte
+        self.ledger: Optional[accounting.CostLedger] = None
+        if bool(ec._resolved("cost_ledger")):
+            self.ledger = accounting.CostLedger(
+                name,
+                block_bytes=(kv_bytes_per_block(
+                    cfg.n_layers, cfg.d_model, self._block_size,
+                    np.dtype(cfg.dtype), quant=self._kv_quant_mode)
+                    if self._paged else 0))
         # per-iteration scratch the recorder drains (reused, not realloc'd)
         self._it_admitted: List[int] = []
         self._it_completed: List[int] = []
@@ -1316,7 +1341,8 @@ class DecodeEngine:
                ctx: Optional[trace.SpanContext] = None,
                priority: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               xfer_info: Optional[Dict[str, int]] = None) -> Future:
+               xfer_info: Optional[Dict[str, int]] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue one prompt; fast-rejects at the admission-queue cap,
         and (paged KV) when ``prompt + max_new`` needs more blocks than
         the whole pool holds — such a request could NEVER be admitted
@@ -1331,7 +1357,11 @@ class DecodeEngine:
         before any prefill runs. ``xfer_info`` (disaggregated serving)
         is the :meth:`splice` accounting of the KV transfer that warmed
         this prompt's prefix, threaded onto the admit span so the trace
-        attributes the cache hit to the wire."""
+        attributes the cache hit to the wire. ``tenant`` (None = the
+        ``-default_tenant`` fallback) names who pays: on a
+        ``-cost_ledger`` engine the request carries a resource vector
+        finalized into that tenant's aggregates
+        (docs/OBSERVABILITY.md "Tenant accounting")."""
         self.validate(prompt, max_new)
         prio = DEFAULT_PRIORITY if priority is None else int(priority)
         if not 0 <= prio <= MAX_PRIORITY:
@@ -1345,9 +1375,11 @@ class DecodeEngine:
             deadline = time.monotonic() + float(deadline_s)
         p = np.asarray(prompt, np.int32).ravel()
         req = _Request(p, int(max_new or self.config.max_new), ctx,
-                       priority=prio, deadline=deadline)
+                       priority=prio, deadline=deadline, tenant=tenant)
         if xfer_info:
             req.xfer = dict(xfer_info)
+        if self.ledger is not None:
+            req.usage = self.ledger.usage(tenant)
         with self._cv:
             if self._stop.is_set():
                 raise RuntimeError(f"decode engine {self.name!r} is stopped")
@@ -1357,6 +1389,8 @@ class DecodeEngine:
                     self.shed += 1
                     self.shed_counter.inc()
                     self._shed_class(prio)
+                    if req.usage is not None:
+                        self.ledger.finalize(req.usage, "shed")
                     raise OverloadedError(self.name, need,
                                           self._pool.capacity,
                                           what="kv block pool",
@@ -1365,6 +1399,8 @@ class DecodeEngine:
                 self.shed += 1
                 self.shed_counter.inc()
                 self._shed_class(prio)
+                if req.usage is not None:
+                    self.ledger.finalize(req.usage, "shed")
                 raise OverloadedError(self.name, len(self._q),
                                       self.config.max_queue)
             if self.t_first is None:
@@ -1385,7 +1421,8 @@ class DecodeEngine:
 
     def submit_prefill(self, prompt: np.ndarray,
                        known_hashes: Sequence[str] = (),
-                       ctx: Optional[trace.SpanContext] = None) -> Future:
+                       ctx: Optional[trace.SpanContext] = None,
+                       tenant: Optional[str] = None) -> Future:
         """Enqueue a PREFILL-ONLY admission (the disaggregated fleet's
         stage 1): the prompt chunk-prefills into paged blocks exactly
         like a normal admission, but instead of going live the request
@@ -1407,9 +1444,11 @@ class DecodeEngine:
         p = np.asarray(prompt, np.int32).ravel()
         # max_new=1 keeps the reservation arithmetic in-range; the
         # pf_only reservation is prompt-only regardless (nothing decodes)
-        req = _Request(p, 1, ctx)
+        req = _Request(p, 1, ctx, tenant=tenant)
         req.pf_only = True
         req.known = frozenset(str(h) for h in known_hashes)
+        if self.ledger is not None:
+            req.usage = self.ledger.usage(tenant)
         with self._cv:
             if self._stop.is_set():
                 raise RuntimeError(f"decode engine {self.name!r} is stopped")
@@ -1418,6 +1457,8 @@ class DecodeEngine:
                 self.shed += 1
                 self.shed_counter.inc()
                 self._shed_class(req.priority)
+                if req.usage is not None:
+                    self.ledger.finalize(req.usage, "shed")
                 raise OverloadedError(self.name, need,
                                       self._pool.capacity,
                                       what="kv block pool",
@@ -1426,6 +1467,8 @@ class DecodeEngine:
                 self.shed += 1
                 self.shed_counter.inc()
                 self._shed_class(req.priority)
+                if req.usage is not None:
+                    self.ledger.finalize(req.usage, "shed")
                 raise OverloadedError(self.name, len(self._q),
                                       self.config.max_queue)
             if self.t_first is None:
@@ -1521,6 +1564,11 @@ class DecodeEngine:
             out["cached_chains"] = [
                 h.hex() for h in self._pool.indexed_hashes(
                     limit=_CHAIN_ADVERT_CAP)]
+        if self.ledger is not None:
+            # per-tenant cost, top-N bounded: rides replica heartbeats
+            # so the router (and its replica_rows surface) can see who
+            # is burning a replica without an obs-plane round trip
+            out["tenants"] = self.ledger.heartbeat_rows()
         return out
 
     def pool_drift(self) -> Optional[str]:
@@ -1635,6 +1683,10 @@ class DecodeEngine:
         for req in dropped:
             self.deadline_drops += 1
             self.deadline_counter.inc()
+            if req.usage is not None:
+                # the whole life was queue wait; attribution closes here
+                req.usage.queue_wait_ms += (now - req.usage.t_wait0) * 1e3
+                self._finalize_usage(req, "deadline", now)
             if trace.enabled() and req.ctx is not None:
                 trace.record_span("queue.wait", req.ctx, req.t_enq, now,
                                   cause="deadline")
@@ -1773,6 +1825,15 @@ class DecodeEngine:
         self.iters_total += 1
         self.iters_counter.inc()
         self._last_progress = now
+        it_block_s = 0.0
+        if self.ledger is not None:
+            # KV residency integrates here: every admitted sequence is
+            # charged reserved-blocks x this iteration's wall (host
+            # floats only — same cost posture as the recorder itself)
+            dt = now - t_work0
+            reqs = self._admitted_requests()
+            self.ledger.charge_iteration(reqs, dt)
+            it_block_s = dt * sum(len(r.blocks) for r in reqs)
         recorder = self.recorder
         if recorder is None:
             return
@@ -1798,7 +1859,13 @@ class DecodeEngine:
             # — the real nonzero-scale count lives on the device, and
             # the recorder's cost posture forbids a per-iteration sync
             (self._pool.n_live + self._pool.n_cached)
-            if self._kv_quant else -1))
+            if self._kv_quant else -1,
+            # tenant accounting tail (FIELDS append at the END; -1 =
+            # ledger off): this iteration's KV block-seconds charge and
+            # the live tenant cardinality
+            round(it_block_s, 6) if self.ledger is not None else -1.0,
+            (self.ledger.tenant_count() if self.ledger is not None
+             else -1)))
 
     def _seed_for(self, version: int) -> bytes:
         """Hash-chain seed for a pinned snapshot version. kv_quant tags
@@ -1940,6 +2007,12 @@ class DecodeEngine:
             self.prefix_hits += req.n_hit
             self.prefix_misses += len(hashes) - req.n_hit
             self.prefill_tokens_saved += req.saved
+            if req.usage is not None:
+                # same commit point as the engine mirror, so the
+                # per-tenant saved sum reconciles exactly (requeue-on-
+                # race never reaches here; a preempted resume recommits
+                # on both sides alike)
+                req.usage.prefill_tokens_saved += req.saved
         row = self._block_tables[slot]
         row[:] = SCRATCH_BLOCK
         row[: total] = req.blocks
@@ -1995,6 +2068,9 @@ class DecodeEngine:
             return
         req.pf_chunks = 0
         req.t_admit = time.monotonic()   # queue.wait ends here
+        if req.usage is not None:
+            req.usage.queue_wait_ms += (req.t_admit
+                                        - req.usage.t_wait0) * 1e3
         if self._spec:
             # prompt-lookup drafting indexes the prompt up front; every
             # emitted token extends the index incrementally from here
@@ -2098,6 +2174,15 @@ class DecodeEngine:
         self.prefill_tokens += n
         self.prefill_tok_counter.inc(n)
         self._it_prefill += n
+        if req.usage is not None:
+            req.usage.prefill_tokens += n
+            if req.resumed:
+                # preemption-with-recompute: a resume life's prefill
+                # re-computes work a first life already paid for — the
+                # vector carries it separately so showback can see the
+                # preemption tax (still counted in prefill_tokens: the
+                # conservation identity tracks FLOPs actually spent)
+                req.usage.recompute_tokens += n
         if self._prefix:
             # every prompt block this chunk COMPLETED gains its content
             # identity now, not at release: a concurrent same-prefix
@@ -2142,6 +2227,8 @@ class DecodeEngine:
         self.tokens += 1
         self.decode_tok_counter.inc()
         self._it_decode += 1
+        if req.usage is not None:
+            req.usage.decode_tokens += 1
         req.out.append(tok0)
         if req.drafter is not None:
             req.drafter.extend((tok0,))
@@ -2196,6 +2283,10 @@ class DecodeEngine:
             (self._model_cfg.n_layers, self._block_size,
              self._model_cfg.d_model),
             np.int8 if self._kv_quant else self._model_cfg.dtype)
+        if req.tenant:
+            # the receiving engine's ledger charges the splice-in bytes
+            # to the originating tenant; absent key = default tenant
+            payload["tenant"] = req.tenant
         shipped = 0
         for i, h in enumerate(hashes):
             hx = h.hex()
@@ -2226,6 +2317,8 @@ class DecodeEngine:
         self.xfer_bytes_counter.inc(nbytes)
         if dedup:
             self.xfer_dedup_counter.inc(dedup)
+        if req.usage is not None:
+            req.usage.xfer_bytes += nbytes
         now = time.monotonic()
         if trace.enabled() and req.ctx is not None:
             trace.record_span("queue.wait", req.ctx, req.t_enq,
@@ -2239,6 +2332,7 @@ class DecodeEngine:
                 prefill_tokens_saved=req.saved, prefill_only=True,
                 xfer_blocks=shipped, xfer_bytes=nbytes,
                 dedup_blocks=dedup, **self._mesh_attrs)
+        self._finalize_usage(req, "completed", now)
         self._release_seq(req)
         self.completed += 1
         self._it_completed.append(req.rid)
@@ -2339,6 +2433,14 @@ class DecodeEngine:
             self.xfer_bytes_counter.inc(info["xfer_bytes"])
         if info["dedup_blocks"]:
             self.xfer_dedup_counter.inc(info["dedup_blocks"])
+        if self.ledger is not None and info["xfer_bytes"]:
+            # splice-in bytes charge directly (no request exists yet to
+            # carry them): the payload's optional "tenant" tag names
+            # who pays, a legacy payload bills the default tenant —
+            # same site, same amount as the engine mirror above, so
+            # the per-tenant xfer sum reconciles exactly
+            self.ledger.charge(payload.get("tenant"),
+                               xfer_bytes=info["xfer_bytes"])
         return info
 
     def _admit(self, arrivals: List[_Request]) -> None:
@@ -2382,6 +2484,12 @@ class DecodeEngine:
                 self.prefill_tok_counter.inc(len(req.prompt))
                 self._it_prefill += len(req.prompt)
                 self._it_admitted.append(req.rid)
+                if req.usage is not None:
+                    req.usage.queue_wait_ms += (
+                        t_admit - req.usage.t_wait0) * 1e3
+                    req.usage.prefill_tokens += len(req.prompt)
+                    if req.resumed:
+                        req.usage.recompute_tokens += len(req.prompt)
             if self._paged and self._kv_quant:
                 (first, self._k_cache, self._v_cache, self._k_scales,
                  self._v_scales) = self._admit_fn(
@@ -2414,6 +2522,8 @@ class DecodeEngine:
                 self.tokens += 1
                 self.decode_tok_counter.inc()
                 self._it_decode += 1
+                if req.usage is not None:
+                    req.usage.decode_tokens += 1
                 req.out.append(tok0)
                 if req.drafter is not None:
                     req.drafter.extend((tok0,))
@@ -2565,6 +2675,10 @@ class DecodeEngine:
         req.pf_off = req.pf_chunks = req.pf_reg = 0
         req.ttft_pending = False
         req.drafter = None
+        if req.usage is not None:
+            # a fresh queue-wait interval opens: the victim re-enters
+            # its lane and the next admission closes the clock again
+            req.usage.t_wait0 = time.monotonic()
         if trace.enabled() and req.ctx is not None:
             trace.record_span(
                 "decode.preempt", req.ctx, t0, time.monotonic(),
@@ -2623,7 +2737,8 @@ class DecodeEngine:
         # is off this loop allocates nothing trace-related (guarded by
         # test_observability's overhead test)
         tracing = trace.enabled()
-        t_it0 = time.monotonic() if tracing else 0.0
+        ledger_on = self.ledger is not None
+        t_it0 = time.monotonic() if (tracing or ledger_on) else 0.0
         spec_toks = n_valid = None
         if self._spec:
             spec_toks, n_valid = self._propose_drafts()
@@ -2671,6 +2786,15 @@ class DecodeEngine:
         nxt = np.array(nxt)       # [S] or [S, K+1]; the host sync point
         now = time.monotonic()
         self.steps_counter.inc()
+        if ledger_on:
+            # device time attributed by active-lane share: the step's
+            # wall (dispatch to sync, growth/drafting included) divides
+            # evenly over the sequences it served — charged BEFORE the
+            # per-slot loop so a sequence completing this very step
+            # still pays for it
+            self.ledger.charge_step(
+                [r for r in self._slot_req if r is not None],
+                (now - t_it0) * 1e3)
         n_active = 0
         for s in range(self.config.slots):
             req = self._slot_req[s]
@@ -2727,6 +2851,8 @@ class DecodeEngine:
                 self.tokens += 1
                 self.decode_tok_counter.inc()
                 self._it_decode += 1
+                if req.usage is not None:
+                    req.usage.decode_tokens += 1
                 if req.ttft_pending:
                     # fully-cached admission: THIS is the request's
                     # first token — it belongs in TTFT, not ITL
@@ -2770,7 +2896,40 @@ class DecodeEngine:
         eos = self.config.eos_id
         return (eos is not None and tok == eos) or len(req.out) >= req.max_new
 
+    def _finalize_usage(self, req: _Request, outcome: str,
+                        now: Optional[float] = None) -> None:
+        """Fold one finished request's resource vector into its
+        tenant's aggregates, exactly once (the vector detaches here —
+        overlapping failure paths cannot double-fold), and record the
+        post-hoc ``acct.request`` span carrying tenant + cost + the
+        vector: the source of trace_summary's tenant/cost columns."""
+        usage = req.usage
+        if usage is None:
+            return
+        req.usage = None
+        if now is None:
+            now = time.monotonic()
+        usage.preemptions = req.preempts
+        lat_ms = ((now - req.t_enq) * 1e3 if outcome == "completed"
+                  else None)
+        cost = self.ledger.finalize(usage, outcome, lat_ms)
+        if trace.enabled() and req.ctx is not None:
+            trace.record_span(
+                "acct.request", req.ctx, req.t_enq, now,
+                tenant=usage.tenant, cost=round(cost, 6),
+                outcome=outcome,
+                prefill_tokens=usage.prefill_tokens,
+                prefill_tokens_saved=usage.prefill_tokens_saved,
+                decode_tokens=usage.decode_tokens,
+                kv_block_s=round(usage.kv_block_s, 6),
+                device_step_ms=round(usage.device_step_ms, 3),
+                queue_wait_ms=round(usage.queue_wait_ms, 3),
+                xfer_bytes=usage.xfer_bytes,
+                recompute_tokens=usage.recompute_tokens,
+                preemptions=usage.preemptions)
+
     def _resolve(self, req: _Request) -> None:
+        self._finalize_usage(req, "completed")
         self.completed += 1
         self._it_completed.append(req.rid)
         if req.future.set_running_or_notify_cancel():
@@ -2822,6 +2981,10 @@ class DecodeEngine:
             if id(req) in seen or req.future.done():
                 continue            # e.g. an arrival already resolved
             seen.add(id(req))
+            # whatever this request consumed before the engine died is
+            # still attributed (outcome "failed") — the conservation
+            # identity survives an engine failure by construction
+            self._finalize_usage(req, "failed")
             if req.future.set_running_or_notify_cancel():
                 req.future.set_exception(exc)
 
@@ -3070,6 +3233,8 @@ class DecodeEngine:
         self._argmax_match = -1.0
         if self._paged:
             self._evictions_base = self._pool.evictions
+        if self.ledger is not None:
+            self.ledger.reset()
         self.t_first = None
         self._occ_sum = 0.0
         self._occ_n = 0
@@ -3147,6 +3312,18 @@ class DecodeEngine:
             })
         if self._param_quant == "int8":
             pool["decode_param_quant"] = self._param_quant
+        if self.ledger is not None:
+            # tenant-accounting surface, present only on -cost_ledger
+            # engines (off-ledger stats stay byte-for-byte — the
+            # metrics regression contract). accounting_drift is the
+            # conservation residual |sum over tenants - engine mirror|
+            # over the integer fields: exactly zero at quiescence, and
+            # the bench's zero-baseline gate holds it there
+            pool.update({
+                **self.ledger.stats(),
+                "accounting_drift": self.ledger.drift(
+                    self.prefill_tokens, self.tokens, self.xfer_bytes),
+            })
         if self._prefix:
             # KV transfer plane (disaggregated serving), prefix-cache
             # engines only — the plane's gate, so a prefix_cache=off

@@ -49,6 +49,11 @@ Record schema (:data:`FIELDS`, positional):
 ``quant_scale_blocks``  pool blocks carrying a nonzero quant scale (a
                         written-block occupancy proxy; -1 when
                         ``kv_quant`` != 1)
+``kv_block_s``          KV block-seconds charged to tenant usage vectors
+                        THIS pass (the cost ledger's residency integral;
+                        -1 when ``-cost_ledger`` is off)
+``tenants_live``        live tenant cardinality in the cost ledger's
+                        aggregate table (-1 when ``-cost_ledger`` is off)
 ======================  =====================================================
 
 Timestamps are monotonic; the recorder captures a wall/mono anchor at
@@ -88,7 +93,7 @@ FIELDS = ("it", "ts", "busy_ms", "step_ms", "live", "reserved", "queue",
           "queue_age_ms", "prefill_toks", "decode_toks", "pool_free",
           "pool_live", "pool_shared", "version", "admitted", "completed",
           "spec_proposed", "spec_accepted", "kv_quant",
-          "quant_scale_blocks")
+          "quant_scale_blocks", "kv_block_s", "tenants_live")
 
 
 def window_digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -256,6 +261,13 @@ class FlightRecorder:
                                "ts": ts, "pid": pid, "tid": 0,
                                "args": {"proposed": r[16],
                                         "accepted": r[17]}})
+            # tenant-accounting track: only cost-ledger engines emit it
+            # (len guard: pre-ledger tuples are 20 fields)
+            if len(r) > 21 and r[21] >= 0:
+                events.append({"name": f"{prefix}/tenants", "ph": "C",
+                               "ts": ts, "pid": pid, "tid": 0,
+                               "args": {"kv_block_s": r[20],
+                                        "live": r[21]}})
         return events
 
     def merge_chrome(self, doc: dict) -> dict:

@@ -79,6 +79,18 @@ from ..log import Log
 
 WIRE_VERSION = 1
 
+# the cost ledger's keyed per-tenant instruments
+# (``TENANT_REQUESTS[engine.tenant]`` etc, serving/accounting.py):
+# counter prefix -> tenant_rows() field
+_TENANT_COUNTER_FIELDS = (
+    ("TENANT_REQUESTS[", "requests"),
+    ("TENANT_PREFILL_TOKENS[", "prefill_tokens"),
+    ("TENANT_DECODE_TOKENS[", "decode_tokens"),
+    ("TENANT_XFER_BYTES[", "xfer_bytes"),
+    ("TENANT_KV_BLOCK_S[", "kv_block_s"),
+    ("TENANT_COST[", "cost"),
+)
+
 
 def _slo_source(name: str) -> str:
     """``SLO_P99[SERVE_TTFT[lm]]`` -> ``SERVE_TTFT[lm]`` (the histogram
@@ -541,6 +553,108 @@ class ObsCollector:
                             "snapshot_version": snap_v,
                             "preemptions": preempts, "node": node})
         return out
+
+    def tenant_rows(self) -> List[Dict[str, Any]]:
+        """Fleet-merged per-tenant accounting rows assembled from the
+        engine cost ledgers' keyed instruments
+        (``TENANT_*[engine.tenant]`` counters +
+        ``TENANT_LAT_MS[engine.tenant]`` latency histograms,
+        serving/accounting.py) wherever a node's shipped registry
+        carries them: latest cumulative value per node summed across
+        nodes (the exact counter contract — deltas never compound
+        error), completion-latency p99 and SLO breach fraction from
+        the bucket-merged fleet windows against the engine's
+        ``TENANT_SLO_MS[engine]`` gauge (``breach_frac`` renders -1.0
+        when no SLO is registered or no window samples exist — the
+        archive-tolerance convention). Rows sort by cost, biggest
+        spender first."""
+        with self._lock:
+            per_node = [(node, dict(st["rows"]), dict(st["buckets"]))
+                        for node, st in sorted(self._nodes.items())]
+        agg: Dict[str, Dict[str, Any]] = {}
+        slo_ms: Dict[str, float] = {}
+        lat_exports: Dict[str, List[Any]] = {}
+
+        def ent_for(key: str) -> Dict[str, Any]:
+            ent = agg.get(key)
+            if ent is None:
+                # bundle keys are "{engine}.{tenant}"; engine names
+                # never contain dots (tenant ids may)
+                eng, _, ten = key.partition(".")
+                ent = agg[key] = {
+                    "tenant": ten or key, "engine": eng,
+                    "requests": 0, "prefill_tokens": 0,
+                    "decode_tokens": 0, "xfer_bytes": 0,
+                    "kv_block_s": 0.0, "cost": 0.0, "nodes": set()}
+            return ent
+
+        for node, rows, buckets in per_node:
+            for name, row in rows.items():
+                if not name.endswith("]"):
+                    continue
+                if (name.startswith("TENANT_SLO_MS[")
+                        and row.get("type") == "gauge"):
+                    eng = name[len("TENANT_SLO_MS["):-1]
+                    slo_ms[eng] = max(slo_ms.get(eng, 0.0),
+                                      float(row.get("value", 0.0)))
+                    continue
+                if (name.startswith("TENANT_LAT_MS[")
+                        and row.get("type") == "histogram"):
+                    key = name[len("TENANT_LAT_MS["):-1]
+                    ent_for(key)["nodes"].add(node)
+                    exp = buckets.get(name)
+                    if exp is not None:
+                        lat_exports.setdefault(key, []).append(exp)
+                    continue
+                if row.get("type") != "counter":
+                    continue
+                for prefix, field in _TENANT_COUNTER_FIELDS:
+                    if name.startswith(prefix):
+                        key = name[len(prefix):-1]
+                        ent = ent_for(key)
+                        ent[field] += row.get("value", 0)
+                        ent["nodes"].add(node)
+                        break
+        out: List[Dict[str, Any]] = []
+        for key, ent in agg.items():
+            merged = merge_buckets(lat_exports.get(key) or [])
+            window_n = merged["zero"] + sum(merged["counts"].values())
+            target = slo_ms.get(ent["engine"], 0.0)
+            ent["lat_p99_ms"] = (bucket_percentile(merged, 99)
+                                 if window_n else 0.0)
+            ent["breach_frac"] = (bucket_breach_frac(merged, target)
+                                  if target > 0 and window_n else -1.0)
+            ent["nodes"] = len(ent["nodes"])
+            for field in ("requests", "prefill_tokens", "decode_tokens",
+                          "xfer_bytes"):
+                ent[field] = int(ent[field])
+            ent["kv_block_s"] = round(float(ent["kv_block_s"]), 6)
+            ent["cost"] = float(ent["cost"])
+            out.append(ent)
+        out.sort(key=lambda r: (-r["cost"], r["engine"], r["tenant"]))
+        return out
+
+    def tenants_table(self) -> str:
+        """The ``opscenter --tenants`` rendering of
+        :meth:`tenant_rows`: one line per (engine, tenant), biggest
+        spender first (empty string when no ledger rows shipped)."""
+        rows = self.tenant_rows()
+        if not rows:
+            return ""
+        lines = [
+            f"{'tenant':<16} {'engine':<10} {'reqs':>7} {'prefill':>9} "
+            f"{'decode':>9} {'kvblk_s':>9} {'xfer_B':>10} {'cost':>11} "
+            f"{'p99_ms':>8} {'breach':>7} {'nodes':>5}"]
+        for r in rows:
+            breach = ("-" if r["breach_frac"] < 0
+                      else f"{r['breach_frac']:.2f}")
+            lines.append(
+                f"{r['tenant']:<16} {r['engine']:<10} {r['requests']:>7} "
+                f"{r['prefill_tokens']:>9} {r['decode_tokens']:>9} "
+                f"{r['kv_block_s']:>9.3f} {r['xfer_bytes']:>10} "
+                f"{r['cost']:>11.3f} {r['lat_p99_ms']:>8.2f} "
+                f"{breach:>7} {r['nodes']:>5}")
+        return "\n".join(lines)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

@@ -124,9 +124,11 @@ class ReplicaServer:
             params = inspect.signature(engine.submit).parameters
             self._engine_prio = "priority" in params
             self._engine_xfer_kw = "xfer_info" in params
+            self._engine_tenant = "tenant" in params
         except (TypeError, ValueError):   # builtins/partials: assume new
             self._engine_prio = True
             self._engine_xfer_kw = True
+            self._engine_tenant = True
         # transfer-plane capability: an inbound payload only splices
         # when the engine can (the fakes keep the classic surface —
         # the payload is then ignored and the prompt prefills locally;
@@ -314,9 +316,11 @@ class ReplicaServer:
             # of tokens ("known" = chain hashes the decode side already
             # holds — those ride as metadata, zero bytes)
             try:
+                pkw = ({"tenant": msg.get("tenant")}
+                       if self._engine_tenant else {})
                 fut = self.engine.submit_prefill(
                     prompt, msg.get("known") or (),
-                    ctx=sp.context if parent else None)
+                    ctx=sp.context if parent else None, **pkw)
             except Exception as exc:
                 sp.end(error=type(exc).__name__)
                 self.failed += 1
@@ -346,6 +350,10 @@ class ReplicaServer:
             kw = {"priority": msg.get("prio"), "deadline_s": deadline_s}
         if xfer_info is not None and self._engine_xfer_kw:
             kw["xfer_info"] = xfer_info
+        if self._engine_tenant:
+            # absent on the wire (old router, archived payload) decodes
+            # as None -> the engine ledger's -default_tenant
+            kw["tenant"] = msg.get("tenant")
         try:
             fut = self.engine.submit(prompt, msg.get("max_new"),
                                      ctx=sp.context if parent else None,

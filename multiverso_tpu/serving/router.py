@@ -164,17 +164,19 @@ class _FleetRequest:
     __slots__ = ("rid", "prompt", "max_new", "session", "deadline",
                  "attempts", "future", "replica", "t_enq", "root",
                  "dispatch_span", "redispatched", "exclude", "priority",
-                 "stage", "decode_rank", "xfer", "xfer_span")
+                 "stage", "decode_rank", "xfer", "xfer_span", "tenant")
 
     def __init__(self, prompt: np.ndarray, max_new: Optional[int],
                  session: Optional[str], deadline: float, root,
-                 priority: int = 1) -> None:
+                 priority: int = 1,
+                 tenant: Optional[str] = None) -> None:
         self.rid = uuid.uuid4().hex[:16]
         self.prompt = np.asarray(prompt, np.int32).ravel()
         self.max_new = max_new
         self.session = session
         self.deadline = deadline
         self.priority = int(priority)
+        self.tenant = tenant
         self.attempts = 0
         self.future: Future = Future()
         self.replica: Optional[int] = None
@@ -408,7 +410,8 @@ class FleetRouter:
     def submit(self, prompt: np.ndarray, max_new: Optional[int] = None,
                session: Optional[str] = None,
                deadline_s: Optional[float] = None,
-               priority: Optional[int] = None) -> Future:
+               priority: Optional[int] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue one prompt for the fleet; resolves to the reply dict
         ``{"result", "snapshot_version", "staleness_s", "replica"}``.
         ``session`` keys affinity (multi-turn conversations hit the
@@ -422,7 +425,10 @@ class FleetRouter:
         ``OverloadedError``) instead of being rejected itself; only
         when nothing lower is queued does the arrival shed
         (``retriable=True`` either way — fleet overload is
-        transient)."""
+        transient). ``tenant`` is the accounting id the replica
+        engines' cost ledgers attribute usage to (rides the wire only
+        when set — absent keys fall back to each replica's
+        ``-default_tenant``, so old replicas keep working)."""
         root = trace.start_span("serve.request", root=True,
                                 model=self.name, fleet=True)
         deadline = time.monotonic() + float(
@@ -432,7 +438,7 @@ class FleetRouter:
             root.end(error="ValueError")
             raise ValueError(f"priority {prio} outside [0, 7]")
         req = _FleetRequest(prompt, max_new, session, deadline, root,
-                            priority=prio)
+                            priority=prio, tenant=tenant)
         victim: Optional[_FleetRequest] = None
         with self._lock:
             stopped = self._stop.is_set()
@@ -484,9 +490,10 @@ class FleetRouter:
     def predict(self, prompt: np.ndarray, max_new: Optional[int] = None,
                 session: Optional[str] = None,
                 timeout_s: float = 60.0,
-                priority: Optional[int] = None) -> dict:
-        return self.submit(prompt, max_new, session,
-                           priority=priority).result(timeout=timeout_s)
+                priority: Optional[int] = None,
+                tenant: Optional[str] = None) -> dict:
+        return self.submit(prompt, max_new, session, priority=priority,
+                           tenant=tenant).result(timeout=timeout_s)
 
     # -- wire death hook -----------------------------------------------------
     def _on_wire_dead(self, ranks) -> None:
@@ -978,6 +985,10 @@ class FleetRouter:
                 # sees the same class and the same urgency
                 "prio": req.priority,
                 "deadline_ms": max(0.0, (req.deadline - now) * 1e3),
+                # tenant rides only when set: absent keys decode as
+                # the replica's -default_tenant, so pre-ledger
+                # replicas (and archived payloads) stay valid
+                **({"tenant": req.tenant} if req.tenant else {}),
                 **extra})
 
     # -- outbound ------------------------------------------------------------

@@ -47,17 +47,19 @@ class _DecoderEntry:
                ctx: Optional[trace.SpanContext] = None) -> Future:
         """Payload: a 1-D prompt id array, or a dict with ``prompt`` and
         optional per-request ``max_new``, ``priority`` (tenant class,
-        0..7, higher = more important) and ``deadline_s`` (seconds from
+        0..7, higher = more important), ``deadline_s`` (seconds from
         now past which the reply is worthless — expired requests drop
         at queue-pop time with ``DeadlineExceededError``, before any
-        prefill runs)."""
+        prefill runs) and ``tenant`` (accounting id for the cost
+        ledger; absent = the ``-default_tenant`` flag)."""
         if isinstance(payload, dict):
             if "prompt" not in payload:
                 raise ValueError("decoder payload dict needs a 'prompt' key")
             return self.engine.submit(payload["prompt"],
                                       payload.get("max_new"), ctx=ctx,
                                       priority=payload.get("priority"),
-                                      deadline_s=payload.get("deadline_s"))
+                                      deadline_s=payload.get("deadline_s"),
+                                      tenant=payload.get("tenant"))
         return self.engine.submit(payload, ctx=ctx)
 
 
@@ -141,7 +143,8 @@ class InferenceServer:
                          watchdog: Optional[bool] = None,
                          debug_dump_dir: Optional[str] = None,
                          slo_ttft_ms: Optional[float] = None,
-                         slo_itl_ms: Optional[float] = None
+                         slo_itl_ms: Optional[float] = None,
+                         cost_ledger: Optional[bool] = None
                          ) -> DecodeEngine:
         """Attach a continuous-batching decode engine under ``name``.
 
@@ -203,6 +206,14 @@ class InferenceServer:
         ``slo_itl_ms`` register rolling-window p99 SLOs whose burn
         status rides every ``Dashboard.snapshot()``
         (docs/OBSERVABILITY.md "Flight recorder" / "Watchdog").
+        ``cost_ledger`` (None = the ``-cost_ledger`` flag, default
+        off) attaches a host-only per-tenant :class:`CostLedger`:
+        every request accumulates a resource vector (queue wait,
+        prefill/decode tokens, KV block-seconds, device step ms,
+        transfer bytes, recompute) attributed to its ``tenant``
+        payload key and folded into bounded-cardinality per-tenant
+        aggregates and cost units at completion
+        (docs/OBSERVABILITY.md "Tenant accounting").
         """
         cfg = DecodeEngineConfig(
             slots=slots, max_prompt=max_prompt, max_new=max_new,
@@ -216,7 +227,8 @@ class InferenceServer:
             preempt=preempt, preempt_budget=preempt_budget,
             sched_lookahead=sched_lookahead,
             watchdog=watchdog, debug_dump_dir=debug_dump_dir,
-            slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms)
+            slo_ttft_ms=slo_ttft_ms, slo_itl_ms=slo_itl_ms,
+            cost_ledger=cost_ledger)
         with self._lock:
             if self._stopped:
                 Log.fatal(f"serving: register_decoder({name!r}) on a "

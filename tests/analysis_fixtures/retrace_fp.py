@@ -217,6 +217,30 @@ class FpQuantEngine:
         return self._step(1.0) + self._chunk(2.0)
 
 
+class FpLedgerEngine:
+    """RT106/RT102: the cost-ledger contract upheld — per-iteration
+    accounting is pure HOST state (float adds into a usage vector,
+    len() over host containers, host-int bookkeeping; the
+    serving/accounting.py CostLedger pattern). The loop path only
+    DISPATCHES the prebuilt step; the ledger work that rides it must
+    never read as a retrace or a device sync."""
+
+    def __init__(self, fn):
+        self._step = jax.jit(fn)
+        self._usage = {"decode_tokens": 0, "kv_block_s": 0.0}
+        self._blocks = [3, 7]
+
+    def _loop(self):
+        while True:
+            self._iterate()
+
+    def _iterate(self):
+        out = self._step(1.0)
+        self._usage["decode_tokens"] += 1
+        self._usage["kv_block_s"] += 0.001 * len(self._blocks)
+        return out
+
+
 def _build_fp_xfer_programs(fn):
     """KV-transfer fetch/splice program builders: ONE host-gather and
     ONE donating scatter per pool layout, built at construction by the

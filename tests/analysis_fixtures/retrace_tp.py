@@ -192,6 +192,32 @@ class Rt106QuantEngine:
         return step(1.0)
 
 
+def _build_cost_reducer(fn):
+    """A cost-vector reduction program builder: jitting a fold IS its
+    job at construction time (sanctioned at module level; hazardous
+    only when the iteration path calls it — see Rt106CostEngine)."""
+    return jax.jit(fn)
+
+
+class Rt106CostEngine:
+    """RT106 via the accounting plane: "speeding up" the per-iteration
+    usage fold by jitting the cost reducer from the hot path builds a
+    fresh program every pass — the ledger is HOST state by contract
+    (plain float adds under a lock, serving/accounting.py); device
+    math has no business on the accounting path."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def _loop(self):
+        while True:
+            self._iterate()
+
+    def _iterate(self):
+        reduce_cost = _build_cost_reducer(self._fn)   # RT106 builder
+        return reduce_cost(1.0)
+
+
 def _build_xfer_fetch(fn):
     """A KV-transfer fetch-program builder: one host-gather program per
     pool layout at construction time IS its job (sanctioned at module

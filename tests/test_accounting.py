@@ -1,0 +1,630 @@
+"""Per-tenant cost attribution (serving/accounting.py) end to end.
+
+The acceptance contract of the tenant-accounting PR
+(docs/OBSERVABILITY.md "Tenant accounting"):
+
+* **exact conservation** — the per-tenant integer sums reconcile with
+  the engine's own global mirrors to the token, whatever the churn
+  (preemption-with-recompute, speculative windows, full-hit prefix
+  admissions, submit-time sheds); ``drift()`` is the residual and it
+  is ZERO at quiescence;
+* **pure host state** — a ledger-enabled engine still compiles exactly
+  one fused step (``step_traces == 1``, retraces 0);
+* **bounded cardinality** — past ``-tenant_max`` distinct tenants, new
+  ids fold into the ``~other`` overflow bucket (lazily keyed
+  ``TENANT_*[engine.tenant]`` instruments never balloon);
+* **wire back-compat** — ``tenant`` rides the mvserve MSG_REQ only
+  when set; an engine without a ``tenant`` submit kwarg (mixed-version
+  fleet) still serves tagged requests, and an untagged request decodes
+  as the default tenant;
+* **off-ledger byte identity** — an engine without ``-cost_ledger``
+  exposes no tenant surface at all (stats/health unchanged);
+* **fleet merge** — ``ObsCollector.tenant_rows()`` sums the keyed
+  counters exactly across nodes, merges the latency buckets, and
+  breaches against ``TENANT_SLO_MS``; ``opscenter --tenants`` renders
+  the table; ``trace_summary`` reports tenant/cost per request.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+@pytest.fixture(autouse=True)
+def _clean_dashboard():
+    from multiverso_tpu.dashboard import Dashboard
+
+    Dashboard.reset()
+    yield
+    Dashboard.reset()
+
+
+def _ledger(engine="lm", **kw):
+    from multiverso_tpu.serving.accounting import CostLedger
+
+    base = dict(default_tenant="default", max_tenants=8,
+                weights={"cost_token": 1.0, "cost_token_ms": 0.0,
+                         "cost_block_byte_s": 0.0, "cost_xfer_byte": 0.0},
+                slo_lat_ms=0.0)
+    base.update(kw)
+    return CostLedger(engine, **base)
+
+
+# -- the ledger alone ---------------------------------------------------------
+
+def test_cost_weights_fold_the_vector():
+    """cost_of is the documented linear fold: tokens, device ms, KV
+    byte-seconds (block_bytes scales the residency integral), transfer
+    bytes — each under its -cost_* weight — and finalize returns it
+    while folding the identical amount into agg + totals."""
+    led = _ledger(block_bytes=1024,
+                  weights={"cost_token": 2.0, "cost_token_ms": 0.5,
+                           "cost_block_byte_s": 0.001,
+                           "cost_xfer_byte": 0.25})
+    u = led.usage("acme")
+    u.prefill_tokens = 10
+    u.decode_tokens = 5
+    u.device_step_ms = 100.0
+    u.kv_block_s = 2.0
+    u.xfer_bytes = 8
+    expect = (2.0 * 15 + 0.5 * 100.0 + 0.001 * 2.0 * 1024 + 0.25 * 8)
+    assert led.cost_of(u) == pytest.approx(expect)
+    cost = led.finalize(u, "completed", lat_ms=12.0)
+    assert cost == pytest.approx(expect)
+    agg = led.tenants()["acme"]
+    assert agg["requests"] == 1 and agg["completed"] == 1
+    assert agg["cost"] == pytest.approx(expect)
+    assert led.totals.cost == pytest.approx(expect)
+    # with the default weights one cost unit == one token
+    led2 = _ledger()
+    u2 = led2.usage("acme")
+    u2.prefill_tokens, u2.decode_tokens = 3, 4
+    assert led2.finalize(u2, "completed") == pytest.approx(7.0)
+
+
+def test_default_tenant_canonicalization():
+    led = _ledger(default_tenant="anon")
+    assert led.usage(None).tenant == "anon"
+    assert led.usage("").tenant == "anon"
+    assert led.usage("   ").tenant == "anon"
+    assert led.usage("  acme ").tenant == "acme"
+
+
+def test_cardinality_cap_folds_into_overflow_bucket():
+    """Past max_tenants distinct tenants, a new id canonicalizes to
+    ~other at usage() time; a vector issued under a canonical id whose
+    table filled while the request ran folds late at finalize — either
+    way the instrument surface stays bounded and conservation holds."""
+    from multiverso_tpu.serving.accounting import OVERFLOW_TENANT
+
+    led = _ledger(max_tenants=2)
+    for t in ("a", "b"):
+        u = led.usage(t)
+        u.decode_tokens = 1
+        led.finalize(u, "completed")
+    assert led.usage("c").tenant == OVERFLOW_TENANT
+    u = led.usage("c")
+    u.decode_tokens = 5
+    led.finalize(u, "completed")
+    tenants = led.tenants()
+    assert set(tenants) == {"a", "b", OVERFLOW_TENANT}
+    assert tenants[OVERFLOW_TENANT]["decode_tokens"] == 5
+    # an id already in the table stays canonical past the cap
+    assert led.usage("a").tenant == "a"
+    # conservation: the fold never loses tokens
+    assert led.drift(0, 7, 0) == 0
+
+    # the LATE fold: canonical at submit, table fills mid-flight
+    led2 = _ledger(max_tenants=2)
+    u_c = led2.usage("c")            # table empty -> canonical
+    assert u_c.tenant == "c"
+    u_c.decode_tokens = 3
+    for t in ("a", "b"):
+        led2.finalize(led2.usage(t), "completed")
+    led2.finalize(u_c, "completed")
+    assert "c" not in led2.tenants()
+    assert led2.tenants()[OVERFLOW_TENANT]["decode_tokens"] == 3
+    assert led2.drift(0, 3, 0) == 0
+
+
+def test_invalid_outcome_and_cap_raise():
+    led = _ledger()
+    with pytest.raises(ValueError):
+        led.finalize(led.usage("a"), "exploded")
+    with pytest.raises(ValueError):
+        _ledger(max_tenants=0)
+
+
+def test_conservation_sum_over_tenants_equals_totals():
+    """Randomized vectors over four tenants and every outcome: the
+    per-tenant sums equal the totals twin field for field (ints exact,
+    floats to rounding), drift() against the manually-kept mirrors is
+    zero, and charge() lands in the same books."""
+    from multiverso_tpu.serving.accounting import OUTCOMES
+
+    led = _ledger()
+    rng = np.random.default_rng(7)
+    mirror = {"prefill": 0, "decode": 0, "xfer": 0}
+    for i in range(40):
+        t = ("acme", "globex", "initech", None)[int(rng.integers(0, 4))]
+        u = led.usage(t)
+        u.prefill_tokens = int(rng.integers(0, 64))
+        u.prefill_tokens_saved = int(rng.integers(0, 16))
+        u.decode_tokens = int(rng.integers(0, 32))
+        u.xfer_bytes = int(rng.integers(0, 4096))
+        u.kv_block_s = float(rng.random())
+        u.device_step_ms = float(rng.random() * 10)
+        u.queue_wait_ms = float(rng.random())
+        u.recompute_tokens = int(rng.integers(0, 8))
+        u.preemptions = int(rng.integers(0, 3))
+        mirror["prefill"] += u.prefill_tokens
+        mirror["decode"] += u.decode_tokens
+        mirror["xfer"] += u.xfer_bytes
+        led.finalize(u, OUTCOMES[i % len(OUTCOMES)],
+                     lat_ms=float(rng.random() * 50))
+    led.charge("acme", xfer_bytes=512)
+    mirror["xfer"] += 512
+    assert led.drift(mirror["prefill"], mirror["decode"],
+                     mirror["xfer"]) == 0
+    tenants = led.tenants().values()
+    for field in ("requests", "completed", "shed", "deadline", "failed",
+                  "prefill_tokens", "prefill_tokens_saved",
+                  "decode_tokens", "xfer_bytes", "recompute_tokens",
+                  "preemptions"):
+        assert (sum(a[field] for a in tenants)
+                == getattr(led.totals, field)), field
+    for field in ("queue_wait_ms", "kv_block_s", "device_step_ms",
+                  "cost"):
+        assert (sum(a[field] for a in tenants)
+                == pytest.approx(getattr(led.totals, field))), field
+    assert led.totals.requests == 40
+    st = led.stats()
+    assert st["tenant_requests"] == 40 and st["tenants_live"] == 4
+
+
+def test_reset_zeroes_window_monotonic_counters_keep_counting():
+    """reset() clears the resettable window (the reset_stats sibling)
+    while the monotonic TENANT_* counters keep counting — the obs-plane
+    rate contract — and heartbeat_rows ranks by cost, bounded."""
+    from multiverso_tpu.dashboard import Dashboard
+
+    led = _ledger(engine="e")
+    for t, toks in (("acme", 10), ("globex", 4)):
+        u = led.usage(t)
+        u.decode_tokens = toks
+        led.finalize(u, "completed", lat_ms=5.0)
+    c = Dashboard.get_or_create_counter("TENANT_DECODE_TOKENS[e.acme]")
+    assert c.get() == 10
+    assert led.heartbeat_rows(limit=1) == {"acme": 10.0}
+    led.reset()
+    assert led.tenants() == {}
+    assert led.tenant_count() == 0
+    st = led.stats()
+    assert st == {"tenants_live": 0, "tenant_cost_units": 0.0,
+                  "tenant_requests": 0}
+    assert c.get() == 10                 # monotonic survives the reset
+    u = led.usage("acme")
+    u.decode_tokens = 3
+    led.finalize(u, "completed")
+    assert c.get() == 13
+    assert led.tenants()["acme"]["requests"] == 1
+
+
+# -- the engine under churn ---------------------------------------------------
+
+@pytest.mark.parametrize("spec_k", [0, 2])
+def test_engine_conservation_under_preemption_churn(mv_session, spec_k):
+    """The conservation identity on a REAL engine with the pool sized
+    to force preemption-with-recompute (the overload-test geometry),
+    the prefix cache serving full-hit repeat admissions, and (spec_k=2)
+    speculative windows — drift is zero at quiescence, the per-tenant
+    sums equal the engine mirrors field for field, and attaching the
+    ledger added no compiled trace."""
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq=48)
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    # 4 slots x optimistic 2-block reservations fill the 8-block pool:
+    # growth must preempt (asserted below — a quiet run proves nothing)
+    engine = srv.register_decoder(
+        "lm", lm, slots=4, max_prompt=8, max_new=16, kv_block_size=4,
+        kv_pool_blocks=8, prefill_token_budget=4, prefix_cache=True,
+        spec_k=spec_k, max_queue=64, cost_ledger=True)
+    engine.warmup()
+
+    rng = np.random.default_rng(23)
+    tenants = ("acme", "globex", "initech", None)
+    repeat = rng.integers(1, cfg.vocab_size, 6).astype(np.int32)
+    futs = []
+    for i in range(18):
+        # every third submit replays the same prompt: full-hit prefix
+        # admissions must attribute SAVED tokens without drifting
+        prompt = (repeat if i % 3 == 0 else
+                  rng.integers(1, cfg.vocab_size,
+                               int(rng.integers(1, 9))).astype(np.int32))
+        payload = {"prompt": prompt,
+                   "max_new": int(rng.integers(6, 17)),
+                   "tenant": tenants[i % len(tenants)]}
+        if payload["tenant"] is None:
+            del payload["tenant"]
+        futs.append(srv.submit("lm", payload))
+    for fut in futs:
+        fut.result(timeout=180)
+
+    stats = engine.stats()
+    assert stats["preemptions"] > 0, "pool never pressured; geometry bug"
+    assert stats["accounting_drift"] == 0
+    assert stats["step_traces"] == 1
+    assert stats["prefill_traces"] == 1
+    assert engine.step_cache_size() == 1
+    assert stats["completed"] == len(futs)
+    if spec_k:
+        assert stats["spec_proposed"] > 0
+
+    led = engine.ledger
+    tenants_seen = led.tenants()
+    assert set(tenants_seen) == {"acme", "globex", "initech", "default"}
+    assert stats["tenants_live"] == 4
+    vals = tenants_seen.values()
+    assert sum(a["prefill_tokens"] for a in vals) == stats["prefill_tokens"]
+    assert sum(a["decode_tokens"] for a in vals) == stats["tokens"]
+    assert sum(a["prefill_tokens_saved"]
+               for a in vals) == stats["prefill_tokens_saved"]
+    assert sum(a["completed"] for a in vals) == stats["completed"]
+    assert sum(a["preemptions"] for a in vals) == stats["preemptions"]
+    # preempted victims resumed by recompute-from-prompt+emitted: the
+    # recomputed tokens are attributed, not lost
+    assert led.totals.recompute_tokens > 0
+    assert led.totals.device_step_ms > 0.0
+    assert led.totals.kv_block_s > 0.0
+    assert (sum(a["kv_block_s"] for a in vals)
+            == pytest.approx(led.totals.kv_block_s))
+    assert stats["tenant_cost_units"] == pytest.approx(
+        stats["prefill_tokens"] + stats["tokens"])
+    # the top-spender rows ride health() for replica heartbeats
+    hb = engine.health()["tenants"]
+    assert set(hb) <= set(tenants_seen) and len(hb) == 4
+
+
+def test_engine_submit_shed_is_accounted(mv_session):
+    """A submit whose worst case can never fit the pool sheds at the
+    door — the ledger still books the request under its tenant with
+    outcome=shed, and zero tokens keep drift at zero."""
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import InferenceServer, OverloadedError
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq=48)
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    engine = srv.register_decoder(
+        "lm", lm, slots=2, max_prompt=8, max_new=16, kv_block_size=4,
+        kv_pool_blocks=4, preempt=False, cost_ledger=True)
+    engine.warmup()
+    prompt = np.arange(1, 9, dtype=np.int32)
+    with pytest.raises(OverloadedError):
+        srv.submit("lm", {"prompt": prompt, "max_new": 16,
+                          "tenant": "acme"})
+    agg = engine.ledger.tenants()["acme"]
+    assert agg["requests"] == 1 and agg["shed"] == 1
+    assert agg["prefill_tokens"] == 0 and agg["decode_tokens"] == 0
+    assert engine.stats()["accounting_drift"] == 0
+
+
+def test_ledger_off_engine_surface_is_unchanged(mv_session):
+    """Without -cost_ledger the tenant surface does not exist: no
+    ledger, no tenant keys in stats(), no tenants row in health() —
+    the metrics regression contract."""
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import InferenceServer
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq=48)
+    lm = TransformerLM(cfg)
+    srv = InferenceServer("t")
+    engine = srv.register_decoder("lm", lm, slots=2, max_prompt=8,
+                                  max_new=4)
+    engine.warmup()
+    srv.submit("lm", {"prompt": np.arange(1, 5, dtype=np.int32),
+                      "max_new": 2, "tenant": "acme"}).result(timeout=60)
+    assert engine.ledger is None
+    stats = engine.stats()
+    for key in ("tenants_live", "tenant_cost_units", "tenant_requests",
+                "accounting_drift"):
+        assert key not in stats
+    assert "tenants" not in engine.health()
+
+
+# -- the mvserve wire ---------------------------------------------------------
+
+class _KV:
+    """The three client calls the wire uses, over a local dict."""
+
+    def __init__(self):
+        self._d = {}
+        self._cv = threading.Condition()
+
+    def key_value_set(self, key, val, allow_overwrite=False):
+        with self._cv:
+            self._d[key] = val
+            self._cv.notify_all()
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            while key not in self._d:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(f"NOT_FOUND: {key}")
+                self._cv.wait(left)
+            return self._d[key]
+
+    def key_value_try_get(self, key):
+        with self._cv:
+            if key not in self._d:
+                raise KeyError(f"NOT_FOUND: {key}")
+            return self._d[key]
+
+
+class _ClassicEngine:
+    """A pre-tenant engine surface (3-arg submit): the replica's
+    capability probe must skip the tenant kwarg for it."""
+
+    def __init__(self):
+        self.submits = 0
+
+    def submit(self, prompt, max_new=None, ctx=None):
+        self.submits += 1
+        f = Future()
+        p = np.asarray(prompt, np.int32)
+        out = ((p[-1] + 1 + np.arange(max_new or 4)) % 64).astype(np.int32)
+        f.set_result({"result": out, "snapshot_version": 1,
+                      "staleness_s": 0.0})
+        return f
+
+    def health(self):
+        return {"queue_depth": 0, "live_seqs": 0}
+
+    def stats(self):
+        return {"submits": self.submits}
+
+    def stop(self):
+        pass
+
+
+class _TenantRecordingEngine(_ClassicEngine):
+    """A ledger-era engine surface: records what tenant the wire
+    delivered (None = the key was absent on MSG_REQ)."""
+
+    def __init__(self):
+        super().__init__()
+        self.seen = []
+
+    def submit(self, prompt, max_new=None, ctx=None, priority=None,
+               deadline_s=None, tenant=None):
+        self.seen.append(tenant)
+        return super().submit(prompt, max_new, ctx)
+
+
+def _mk_fleet(label, engines):
+    from multiverso_tpu.serving import (FleetConfig, FleetRouter,
+                                        ReplicaServer)
+
+    kv = _KV()
+    size = len(engines) + 1
+    router = FleetRouter(size, kv, label=label, name=label,
+                         fleet_config=FleetConfig(heartbeat_ms=50,
+                                                  deadline_s=30.0))
+    replicas = [ReplicaServer(r + 1, size, kv, engines[r], label=label,
+                              heartbeat_ms=50)
+                for r in range(len(engines))]
+    deadline = time.monotonic() + 20
+    while router.stats()["up"] < len(engines):
+        assert time.monotonic() < deadline, router.replica_rows()
+        time.sleep(0.01)
+    return router, replicas
+
+
+def _stop_fleet(router, replicas):
+    router.stop()
+    for rep in replicas:
+        try:
+            rep.stop()
+        except Exception:
+            pass
+
+
+def test_tenant_rides_the_wire_and_absent_decodes_none():
+    """router.submit(tenant=...) delivers the id to a tenant-capable
+    engine; an untagged submit puts NO key on the wire, so the engine
+    sees None (-> the ledger's default tenant)."""
+    engines = [_TenantRecordingEngine()]
+    router, replicas = _mk_fleet("acct_wire", engines)
+    try:
+        prompt = np.arange(1, 4, dtype=np.int32)
+        router.predict(prompt, 2, tenant="acme")
+        router.predict(prompt, 2)
+        assert engines[0].seen == ["acme", None]
+    finally:
+        _stop_fleet(router, replicas)
+
+
+def test_mixed_version_fleet_serves_tagged_requests():
+    """A replica wrapping a pre-tenant engine (no tenant kwarg) still
+    serves a tenant-tagged request: the capability probe drops the
+    kwarg instead of crashing the submit — rolling upgrades can tag
+    before every engine understands tenancy."""
+    engines = [_ClassicEngine()]
+    router, replicas = _mk_fleet("acct_mixed", engines)
+    try:
+        prompt = np.arange(1, 4, dtype=np.int32)
+        reply = router.predict(prompt, 3, tenant="acme")
+        expect = ((prompt[-1] + 1 + np.arange(3)) % 64).astype(np.int32)
+        np.testing.assert_array_equal(reply["result"], expect)
+        assert engines[0].submits == 1
+    finally:
+        _stop_fleet(router, replicas)
+
+
+# -- fleet merge + tools ------------------------------------------------------
+
+def _report(node, seq, rows=None, buckets=None, spans=None, anchor=None):
+    return {"v": 1, "node": node, "seq": seq, "ts": float(seq),
+            "mono": float(seq), "interval_s": 1.0, "rows": rows or {},
+            "deltas": {}, "buckets": buckets or {},
+            "engines": {}, "spans": spans or [],
+            "spans_missed": 0, "trace_anchor": anchor or [0.0, 0.0]}
+
+
+def _tenant_rows_reports():
+    """Two nodes' worth of ledger instruments for tenant lm.acme /
+    lm.globex: cumulative counters, acme latency buckets (half the
+    samples over the 5 ms SLO), an SLO gauge on node 0."""
+    from multiverso_tpu.dashboard import Histogram
+
+    h0 = Histogram("LAT0", register=False)
+    h1 = Histogram("LAT1", register=False)
+    for v in (1.0,) * 50 + (40.0,) * 25:
+        h0.record(v)
+    for v in (2.0,) * 25:
+        h1.record(v)
+    rows0 = {
+        "TENANT_SLO_MS[lm]": {"type": "gauge", "value": 5.0},
+        "TENANT_REQUESTS[lm.acme]": {"type": "counter", "value": 10},
+        "TENANT_DECODE_TOKENS[lm.acme]": {"type": "counter",
+                                          "value": 100},
+        "TENANT_COST[lm.acme]": {"type": "counter", "value": 50.0},
+        "TENANT_LAT_MS[lm.acme]": {"type": "histogram"},
+    }
+    rows1 = {
+        "TENANT_REQUESTS[lm.acme]": {"type": "counter", "value": 5},
+        "TENANT_LAT_MS[lm.acme]": {"type": "histogram"},
+        "TENANT_REQUESTS[lm.globex]": {"type": "counter", "value": 7},
+        "TENANT_PREFILL_TOKENS[lm.globex]": {"type": "counter",
+                                             "value": 64},
+        "TENANT_COST[lm.globex]": {"type": "counter", "value": 70.0},
+        "TENANT_KV_BLOCK_S[lm.globex]": {"type": "counter",
+                                         "value": 1.25},
+    }
+    return (
+        _report(0, 0, rows=rows0,
+                buckets={"TENANT_LAT_MS[lm.acme]": h0.buckets()}),
+        _report(1, 0, rows=rows1,
+                buckets={"TENANT_LAT_MS[lm.acme]": h1.buckets()}),
+    )
+
+
+def test_collector_tenant_rows_merge_exactly_across_nodes():
+    from multiverso_tpu.serving.obs_plane import ObsCollector
+
+    col = ObsCollector()
+    assert col.tenant_rows() == [] and col.tenants_table() == ""
+    r0, r1 = _tenant_rows_reports()
+    col.ingest(0, r0)
+    col.ingest(1, r1)
+    rows = {(r["engine"], r["tenant"]): r for r in col.tenant_rows()}
+    acme = rows[("lm", "acme")]
+    globex = rows[("lm", "globex")]
+    # exact sums: latest cumulative per node, summed across nodes
+    assert acme["requests"] == 15 and acme["decode_tokens"] == 100
+    assert acme["nodes"] == 2
+    assert globex["requests"] == 7 and globex["prefill_tokens"] == 64
+    assert globex["kv_block_s"] == pytest.approx(1.25)
+    assert globex["nodes"] == 1
+    # sorted by cost, biggest spender first
+    assert [r["tenant"] for r in col.tenant_rows()] == ["globex", "acme"]
+    # breach fraction against the TENANT_SLO_MS gauge over the MERGED
+    # windows: 25 of 100 samples exceed 5 ms
+    assert acme["breach_frac"] == pytest.approx(0.25, abs=0.05)
+    assert acme["lat_p99_ms"] > 5.0
+    # no latency window for globex -> the archive-tolerance sentinel
+    assert globex["breach_frac"] == -1.0 and globex["lat_p99_ms"] == 0.0
+    # a re-ingested row REPLACES (latest cumulative wins)
+    col.ingest(1, _report(1, 1, rows={
+        "TENANT_REQUESTS[lm.acme]": {"type": "counter", "value": 9}}))
+    rows = {r["tenant"]: r for r in col.tenant_rows()}
+    assert rows["acme"]["requests"] == 19
+
+
+def test_tenants_table_renders_breach_and_dash():
+    from multiverso_tpu.serving.obs_plane import ObsCollector
+
+    col = ObsCollector()
+    r0, r1 = _tenant_rows_reports()
+    col.ingest(0, r0)
+    col.ingest(1, r1)
+    table = col.tenants_table()
+    lines = table.splitlines()
+    assert lines[0].split()[:3] == ["tenant", "engine", "reqs"]
+    assert lines[1].split()[0] == "globex"      # biggest spender first
+    assert lines[2].split()[0] == "acme"
+    assert lines[1].split()[-2] == "-"          # no SLO window: dash
+    assert lines[2].split()[-2] == "0.25"
+
+
+def test_opscenter_tenants_cli(tmp_path, capsys):
+    import tools.opscenter as oc
+
+    r0, r1 = _tenant_rows_reports()
+    with_rows = str(tmp_path / "reports.0.jsonl")
+    with open(with_rows, "w") as f:
+        f.write(json.dumps(r0) + "\n")
+        f.write(json.dumps(r1) + "\n")
+    assert oc.main([with_rows, "--tenants"]) == 0
+    out = capsys.readouterr().out
+    assert "acme" in out and "globex" in out and "breach" in out
+    # archives predating the ledger: loud exit 2, not an empty table
+    bare = str(tmp_path / "reports.bare.jsonl")
+    with open(bare, "w") as f:
+        f.write(json.dumps(_report(0, 0, rows={
+            "REQS[x]": {"type": "counter", "value": 3}})) + "\n")
+    assert oc.main([bare, "--tenants"]) == 2
+
+
+def test_trace_summary_reports_tenant_and_cost_columns(tmp_path, capsys):
+    """The acct.request span a ledger engine records per finalized
+    request surfaces as tenant/cost columns in the trace_summary
+    per-request report — and requests without one render dashes."""
+    import tools.trace_summary as ts
+    from multiverso_tpu.serving.obs_plane import ObsCollector
+
+    col = ObsCollector()
+    mk = lambda tid, sid, name, t0, t1, parent=None, attrs=None: {
+        "name": name, "trace_id": tid, "span_id": sid,
+        "parent_id": parent, "t0": t0, "t1": t1, "thread": "T",
+        "attrs": attrs or {}}
+    col.ingest(0, _report(0, 0, anchor=[1000.0, 0.0], spans=[
+        mk(7, 1, "serve.request", 0.0, 0.1),
+        mk(7, 2, "acct.request", 0.0, 0.1, parent=1,
+           attrs={"tenant": "acme", "cost": 3.25,
+                  "outcome": "completed", "decode_tokens": 3}),
+        mk(8, 3, "serve.request", 0.2, 0.25)]))
+    path = str(tmp_path / "merged.json")
+    with open(path, "w") as f:
+        json.dump(col.export_chrome(), f)
+    rows = ts.request_report(ts.load_host_spans(path))
+    by_name = sorted((r for r in rows if r["name"] == "serve.request"),
+                     key=lambda r: r["total_ms"], reverse=True)
+    assert len(by_name) == 2
+    tagged = [r for r in by_name if "tenant" in r]
+    assert len(tagged) == 1
+    assert tagged[0]["tenant"] == "acme"
+    assert tagged[0]["cost"] == pytest.approx(3.25)
+    ts.print_request_report(rows, top=10, sort="total")
+    out = capsys.readouterr().out
+    assert "tenant" in out and "acme" in out

@@ -93,6 +93,14 @@ def test_metric_direction_rules():
     assert metric_direction("itl_p99_ratio") == 1
     assert metric_direction("ttft_p99_ms_disagg_info") == 0
     assert metric_direction("xfer_blocks_info") == 0
+    # tenant accounting (accounting A/B): the conservation residual is
+    # a zero-baseline hard gate — any nonzero drift means tokens were
+    # consumed without attribution; the per-tenant cost columns and the
+    # ledger overhead ride as _info
+    assert metric_direction("accounting_drift") == -1
+    assert metric_direction("cost_acme_info") == 0
+    assert metric_direction("ledger_overhead_frac_info") == 0
+    assert metric_direction("tenants_live_info") == 0
     assert metric_direction("completed") == 0       # informational
     assert metric_direction("jit_traces") == 0
     assert metric_direction("step_traces") == 0
@@ -119,6 +127,24 @@ def test_updates_lost_zero_baseline_gate():
     assert {r["metric"] for r in regs} == {
         "lm_trainer_chaos.updates_lost",
         "lm_trainer_chaos.epoch_fence_rejections_unexpected"}
+
+
+def test_accounting_drift_zero_baseline_gate():
+    """accounting_drift 0 -> nonzero must regress even though the
+    baseline is 0 (the zero-baseline rule): a token consumed without a
+    tenant attribution breaks the conservation identity — an invariant
+    break, not noise — while the per-tenant cost columns archive _info."""
+    clean = {"accounting_drift": 0.0, "requests": 48.0,
+             "cost_acme_info": 120.0, "tenants_live_info": 3.0}
+    base = _line(accounting=clean)
+    good = _line(accounting=json.loads(json.dumps(clean)))
+    regs, _ = compare(base, good)
+    assert regs == []
+    bad = _line(accounting={"accounting_drift": 7.0, "requests": 48.0,
+                            "cost_acme_info": 9000.0,
+                            "tenants_live_info": 3.0})
+    regs, _ = compare(base, bad)
+    assert {r["metric"] for r in regs} == {"accounting.accounting_drift"}
 
 
 def test_preempt_invariants_zero_baseline_gate():

@@ -21,11 +21,11 @@ def _rec(it, ts, busy=1.0, step=0.5, live=1, reserved=0, queue=0,
          queue_age=0.0, prefill=0, decode=1, pool_free=-1, pool_live=-1,
          pool_shared=-1, version=0, admitted=(), completed=(),
          spec_proposed=-1, spec_accepted=-1, kv_quant=-1,
-         quant_scale_blocks=-1):
+         quant_scale_blocks=-1, kv_block_s=-1.0, tenants_live=-1):
     return (it, ts, busy, step, live, reserved, queue, queue_age,
             prefill, decode, pool_free, pool_live, pool_shared, version,
             admitted, completed, spec_proposed, spec_accepted, kv_quant,
-            quant_scale_blocks)
+            quant_scale_blocks, kv_block_s, tenants_live)
 
 
 # -- ring ---------------------------------------------------------------------
@@ -162,6 +162,40 @@ def test_spec_counter_track_and_legacy_tuple_tolerance():
     qr.record(_rec(1, time.monotonic(), kv_quant=1, quant_scale_blocks=7))
     assert qr.records()[0]["kv_quant"] == 1
     assert qr.records()[0]["quant_scale_blocks"] == 7
+
+
+def test_tenant_counter_track_and_pre_ledger_tuple_tolerance():
+    """The tenant-accounting columns ride the END of FIELDS: cost-ledger
+    engines get a ``fr/<name>/tenants`` counter track, -1 columns
+    (``-cost_ledger`` off) emit none, and a pre-ledger 20-field tuple
+    still reads cleanly everywhere (records/summary/chrome skip the
+    absent tail columns — the spec/quant append pattern, continued)."""
+    fr = FlightRecorder(capacity=8, name="eng")
+    fr.record(_rec(1, time.monotonic(), kv_block_s=0.125, tenants_live=3))
+    events = fr.chrome_counter_events()
+    tenants = [e for e in events if e["name"] == "fr/eng/tenants"]
+    assert len(tenants) == 1
+    assert tenants[0]["args"] == {"kv_block_s": 0.125, "live": 3}
+    assert fr.records()[0]["kv_block_s"] == 0.125
+    assert fr.records()[0]["tenants_live"] == 3
+
+    # a ledger-off engine's -1 columns emit no track
+    off = FlightRecorder(capacity=8, name="off")
+    off.record(_rec(1, time.monotonic()))
+    assert not any(e["name"].endswith("/tenants")
+                   for e in off.chrome_counter_events())
+
+    # pre-ledger 20-field tuples (this PR appended kv_block_s /
+    # tenants_live at the END) read cleanly the same way
+    legacy = FlightRecorder(capacity=8, name="old")
+    legacy.record(_rec(1, time.monotonic(),
+                       kv_quant=1, quant_scale_blocks=5)[:20])
+    recs = legacy.records()
+    assert "kv_block_s" not in recs[0] and "tenants_live" not in recs[0]
+    assert recs[0]["quant_scale_blocks"] == 5
+    assert legacy.summary()["iterations"] == 1
+    assert not any(e["name"].endswith("/tenants")
+                   for e in legacy.chrome_counter_events())
 
 
 # -- engine integration -------------------------------------------------------

@@ -57,6 +57,7 @@ EXPECTED_TP = {
     ("RT106", "Rt106SpecEngine._iterate"),       # verify-step builder
     ("RT106", "Rt106XferEngine._iterate"),       # kv-transfer fetch builder
     ("RT106", "Rt106QuantEngine._iterate"),      # quant-step builder
+    ("RT106", "Rt106CostEngine._iterate"),       # cost-reducer builder
 }
 
 

@@ -104,6 +104,13 @@ from typing import Dict, List, Tuple
 # regressing UP means the wire compression stopped paying. Its ratio
 # sibling wire_compressed_ratio archives as *_info (ratio would hit the
 # higher-better rule backwards: smaller is better there).
+# accounting_drift is the cost ledger's conservation residual
+# (|sum-over-tenants - engine counter| over the integer usage fields,
+# serving/accounting.py): the bench archives 0 and the zero-baseline
+# rule makes ANY nonzero candidate value gate — attribution that loses
+# or invents tokens is corruption, not noise (same contract as
+# requests_lost/updates_lost). Per-tenant cost columns archive as
+# *_info: they measure the trace's tenant mix, not the code.
 _HIGHER_BETTER = ("qps", "tokens_per_s", "speedup", "ratio",
                   "capacity_seqs", "prefill_tokens_saved",
                   "prefix_hit_rate", "accepted_per_step",
@@ -115,7 +122,8 @@ _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
                  "output_mismatches", "recovery_time_s",
                  "updates_lost", "epoch_fence_rejections_unexpected",
                  "preempt_output_mismatches", "starved_requests",
-                 "deadline_drops", "kv_bytes_moved", "publish_bytes")
+                 "deadline_drops", "kv_bytes_moved", "publish_bytes",
+                 "accounting_drift")
 
 
 def metric_direction(name: str) -> int:

@@ -30,6 +30,14 @@ questions after the fact:
   predating its gauge (docs/SERVING.md "Serving fleet" / "Overload and
   preemption" / "Disaggregated prefill/decode", docs/DISTRIBUTED.md
   "Durability").
+* ``--tenants`` — the fleet tenant-accounting table: one row per
+  (engine, tenant), biggest spender first, assembled from the engine
+  cost ledgers' ``TENANT_*[engine.tenant]`` instruments
+  (``-cost_ledger``): requests, prefill/decode tokens, KV
+  block-seconds, transfer bytes, folded cost units, fleet-merged
+  completion-latency p99, and the SLO breach fraction against
+  ``TENANT_SLO_MS`` ("-" = no SLO registered or an archive predating
+  the ledger; docs/OBSERVABILITY.md "Tenant accounting").
 * ``--prom`` — the merged registry as one Prometheus text exposition,
   every sample carrying a ``node`` label.
 * ``--trace OUT.json`` — the merged cross-process Perfetto document:
@@ -39,8 +47,8 @@ questions after the fact:
 Usage::
 
     JAX_PLATFORMS=cpu python tools/opscenter.py reports.jsonl.0 \
-        reports.jsonl.1 reports.jsonl.2 [--prom] [--trace merged.json]
-        [--silent-after 2.5]
+        reports.jsonl.1 reports.jsonl.2 [--prom] [--tenants]
+        [--trace merged.json] [--silent-after 2.5]
 
 Reading the table: docs/OBSERVABILITY.md "Fleet plane".
 """
@@ -104,6 +112,10 @@ def main(argv=None) -> int:
     ap.add_argument("--prom", action="store_true",
                     help="print the merged registry as Prometheus text "
                          "(node label per sample) instead of the table")
+    ap.add_argument("--tenants", action="store_true",
+                    help="print the per-tenant cost-attribution table "
+                         "(engine cost ledgers merged fleet-wide) "
+                         "instead of the node table")
     ap.add_argument("--trace", default="",
                     help="write the merged cross-process Perfetto doc "
                          "here (one process track per node)")
@@ -136,6 +148,13 @@ def main(argv=None) -> int:
               f"{doc['otherData']['nodes']} node(s)")
     if args.prom:
         sys.stdout.write(col.prometheus())
+    elif args.tenants:
+        table = col.tenants_table()
+        if not table:
+            print("opscenter: no tenant-ledger rows in the archive(s) "
+                  "(engines run without -cost_ledger?)", file=sys.stderr)
+            return 2
+        print(table)
     else:
         print(col.table(silent_after_s=silent_after))
     return 0

@@ -83,6 +83,16 @@ acks and merges. tok/s columns are ``_info``; the publisher's
 drop with a live, acking collector means the bounded-window/ack
 machinery broke, a bug.
 
+An ``accounting`` A/B prices and PROVES the per-tenant cost ledger
+(``-cost_ledger``): the same warm engine serves the trace with the
+ledger detached vs attached (tok/s ``_info``), then a 3-tenant
+round-robin tagged pass under a real 2-rank obs plane. Gated:
+``accounting_drift`` at ZERO (the conservation identity — per-tenant
+sums reconcile with the engine's own counters to the token) and the
+one-trace/zero-retrace invariants on the ledger-enabled engine; the
+collector's ``tenant_rows()`` (the ``opscenter --tenants`` surface)
+must render all 3 tenants.
+
 An ``lm_fleet_chaos`` A/B prices FAILURE RECOVERY: a 3-replica fleet
 (real decode engines on the real ``mvserve`` wire behind the
 ``FleetRouter``) serves one mixed-length trace fault-free, then again
@@ -241,18 +251,25 @@ def _admission_pulse_trace(cycles: int, cycle_s: float, n_wit: int,
     return out
 
 
-def _play_decode_trace(server, model: str, trace, per_request_max_new: bool):
-    """Open-loop arrival playback; returns (results, elapsed_s)."""
+def _play_decode_trace(server, model: str, trace, per_request_max_new: bool,
+                       tenants=None):
+    """Open-loop arrival playback; returns (results, elapsed_s).
+    ``tenants`` (a name sequence) tags requests round-robin with a
+    ``tenant`` payload key — the cost ledger's attribution id."""
     from multiverso_tpu.serving import OverloadedError
 
     futs = []
     t0 = time.monotonic()
-    for at, prompt, n_new in trace:
+    for i, (at, prompt, n_new) in enumerate(trace):
         delay = at - (time.monotonic() - t0)
         if delay > 0:
             time.sleep(delay)
         payload = ({"prompt": prompt, "max_new": n_new}
                    if per_request_max_new else prompt)
+        if tenants:
+            if not isinstance(payload, dict):
+                payload = {"prompt": payload}
+            payload["tenant"] = tenants[i % len(tenants)]
         while True:
             try:
                 futs.append(server.submit(model, payload))
@@ -1044,9 +1061,14 @@ def _observability_ab(server, lm_model, quick: bool):
                        mean_gap_s=0.0005, vocab=lm_model.config.vocab_size,
                        min_new=8)
     useful = sum(n_new for _, _, n_new in tr)
+    # cost_ledger=True: the accounting A/B downstream rides this same
+    # warm engine (detaching/re-attaching the ledger per leg) — and the
+    # ledger running through THIS leg's passes is itself part of the
+    # proof that accounting is pure host state (step_traces stays 1)
     engine = server.register_decoder(
         "lm_obs", lm_model, slots=8, max_prompt=max_prompt, max_new=cap,
-        max_queue=max(64, n), prompt_buckets=(max_prompt,))
+        max_queue=max(64, n), prompt_buckets=(max_prompt,),
+        cost_ledger=True)
     engine.warmup()
     _play_decode_trace(server, "lm_obs",
                        [(0.0, np.ones(4, np.int32), 2)] * 4, True)
@@ -1228,6 +1250,90 @@ def _obs_plane_ab(server, quick: bool) -> dict:
         "obs_collector_nodes_info": collector_nodes,
         "obs_dropped_reports": agent_stats.get("dropped_reports", 0),
     }
+
+
+def _accounting_ab(server, engine, quick: bool) -> dict:
+    """Prices and PROVES the per-tenant cost ledger (``-cost_ledger``):
+    the SAME warm engine (``lm_obs``, registered with the ledger by the
+    observability A/B) serves one mixed-length trace with the ledger
+    detached vs attached, best-of-2 alternating passes — both tok/s
+    columns are ``_info`` (per-token ledger work is a handful of host
+    float adds; on the 2-CPU container it sits inside the
+    scheduling-noise floor). The gated numbers are the CONSERVATION
+    INVARIANTS, measured on a final 3-tenant round-robin tagged pass:
+    ``accounting_drift`` (|sum-over-tenants - engine counter| over
+    prefill/decode/transfer integer fields, serving/accounting.py) must
+    be 0 — attribution that loses or invents tokens is corruption — and
+    ``decode_step_retraces`` 0 / ``step_traces`` 1 prove the ledger is
+    pure host state (no compile reachable from the loop). The tagged
+    pass runs under a REAL two-rank obs plane (the obs-plane A/B's
+    wire) so the per-tenant keyed instruments ship and the collector's
+    ``tenant_rows()``/``tenants_table()`` — the ``opscenter --tenants``
+    surface — render all 3 tenants; per-tenant cost units archive as
+    ``_info`` (they measure the trace's tenant mix, not the code)."""
+    from multiverso_tpu.serving.obs_plane import ObsAgent
+
+    # full 48-request trace even under --quick (the lockwatch A/B's
+    # rationale: a shorter window turns one scheduler hiccup into a
+    # coin-flip overhead column)
+    max_prompt, cap = 8, 64
+    n = 48
+    tr = _decode_trace(n, seed=53, max_prompt=max_prompt, max_new_cap=cap,
+                       mean_gap_s=0.0005, vocab=256, min_new=8)
+    useful = sum(n_new for _, _, n_new in tr)
+    tenants = ("acme", "globex", "initech")
+    ledger = engine.ledger
+    tps = {"off": 0.0, "on": 0.0}
+    try:
+        for _ in range(2):
+            for label, on in (("off", False), ("on", True)):
+                # detach/re-attach between passes (no requests in
+                # flight): the off leg runs the identical engine with
+                # every ledger hook short-circuited at its None check
+                engine.ledger = ledger if on else None
+                _, elapsed = _play_decode_trace(
+                    server, "lm_obs", tr, True,
+                    tenants=tenants if on else None)
+                tps[label] = max(tps[label], round(useful / elapsed, 1))
+    finally:
+        engine.ledger = ledger
+    # the gated pass: fresh mirrors on both sides of the identity, a
+    # 3-tenant tagged replay under a live 2-rank plane, then the
+    # residual against the engine's own counters
+    engine.reset_stats()
+    kv = _ObsBenchKV()
+    agents = [ObsAgent(rank=r, size=2, client=kv, report_ms=100,
+                       label="bench_acct")
+              for r in range(2)]
+    try:
+        _play_decode_trace(server, "lm_obs", tr, True, tenants=tenants)
+    finally:
+        for a in reversed(agents):       # publisher flushes first
+            a.stop(final_report=True)
+    stats = engine.stats()
+    tenant_rows = agents[0].collector.tenant_rows()
+    table = agents[0].collector.tenants_table()
+    per_tenant = ledger.tenants()
+    row = {
+        "requests": n,
+        "useful_tokens": useful,
+        "tokens_per_s_ledger_off_info": tps["off"],
+        "tokens_per_s_ledger_on_info": tps["on"],
+        "ledger_overhead_frac_info": (
+            round(1.0 - tps["on"] / tps["off"], 4) if tps["off"] else 0.0),
+        "accounting_drift": stats["accounting_drift"],
+        "decode_step_retraces": stats["decode_step_retraces"],
+        "step_traces": stats["step_traces"],
+        "tenants_live_info": stats["tenants_live"],
+        "obs_tenant_rows_info": len(
+            {r["tenant"] for r in tenant_rows}),
+        "obs_tenant_table_lines_info": (len(table.splitlines())
+                                        if table else 0),
+    }
+    for t in tenants:
+        row[f"cost_{t}_info"] = round(
+            (per_tenant.get(t) or {}).get("cost", 0.0), 3)
+    return row
 
 
 def _fleet_chaos_ab(quick: bool) -> dict:
@@ -1840,6 +1946,12 @@ def run(duration_s: float = 2.0, clients: int = 32,
     # 100 ms reports — tok/s _info, the publisher's 0 dropped reports
     # gated (zero-baseline, like watchdog_trips)
     out["workloads"]["obs_plane"] = _obs_plane_ab(server, quick)
+    # per-tenant accounting A/B rides the same warm ledger'd engine:
+    # ledger-detached vs -attached tok/s (_info), then a 3-tenant
+    # tagged pass under a real 2-rank obs plane whose conservation
+    # residual (accounting_drift) rides the zero-baseline gate
+    out["workloads"]["accounting"] = _accounting_ab(server, obs_engine,
+                                                    quick)
     # fleet-chaos A/B before the closed-loop phase: its gated numbers
     # are recovery invariants (counts), but recovery_time_s is a wall
     # clock that should not absorb 32 saturating client threads

@@ -34,6 +34,9 @@ Usage::
 ``--slo-ms`` flags (``!``) and counts requests whose total exceeds the
 objective; on tail-sampled captures (``-trace_tail``) a ``keep`` column
 says why each retained trace survived the sampler (slo/error/head).
+Ledger-enabled captures (``-cost_ledger``) add ``tenant``/``cost``
+columns from each request's ``acct.request`` accounting span —
+attribution and price next to the latency breakdown.
 """
 
 from __future__ import annotations
@@ -238,6 +241,14 @@ def request_report(spans, device_events=None):
             row["xfer_blocks"] = src["xfer_blocks"]
             row["xfer_bytes"] = src.get("xfer_bytes", 0)
             row["dedup_blocks"] = src.get("dedup_blocks", 0)
+        # ledger-enabled engines (-cost_ledger) record one acct.request
+        # span per finalized request: the tenant the usage was
+        # attributed to and the folded cost units — the report then
+        # says WHO each tail outlier belongs to and what it cost
+        accts = [s for s in group if s["name"] == "acct.request"]
+        if accts and "tenant" in accts[0]["args"]:
+            row["tenant"] = accts[0]["args"]["tenant"]
+            row["cost"] = accts[0]["args"].get("cost", 0.0)
         if device:
             w0, w1 = root["ts"], root["ts"] + root["dur"]
             row["device_ms"] = sum(
@@ -258,6 +269,7 @@ def print_request_report(rows, top: int, sort: str,
     has_quant = any("kv_quant" in r for r in rows)
     has_preempt = any("preempted" in r for r in rows)
     has_xfer = any("xfer_blocks" in r for r in rows)
+    has_tenant = any("tenant" in r for r in rows)
     has_keep = any(r.get("keep") for r in rows)
     # the node column ships as soon as the doc holds more than one
     # recording process (an obs-plane merged fleet trace); single-node
@@ -286,6 +298,8 @@ def print_request_report(rows, top: int, sort: str,
         hdr += f" {'preempt':>8}"
     if has_xfer:
         hdr += f" {'xfblk':>6} {'xfkb':>8} {'dedup':>6}"
+    if has_tenant:
+        hdr += f" {'tenant':>10} {'cost':>9}"
     if has_dev:
         hdr += f" {'device':>9}"
     if has_keep:
@@ -318,6 +332,12 @@ def print_request_report(rows, top: int, sort: str,
                          f"{r.get('dedup_blocks', 0):6d}")
             else:
                 line += f" {'-':>6} {'-':>8} {'-':>6}"
+        if has_tenant:
+            if "tenant" in r:
+                line += (f" {str(r['tenant'])[:10]:>10} "
+                         f"{r.get('cost', 0.0):9.3f}")
+            else:
+                line += f" {'-':>10} {'-':>9}"
         if has_dev:
             line += f" {r.get('device_ms', 0.0):9.3f}"
         if has_keep:
