@@ -10,4 +10,16 @@ shard_map = getattr(jax, "shard_map", None)
 if shard_map is None:  # pragma: no cover — jax < 0.8
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+# jax < 0.6 spells the replication-check kwarg ``check_rep``; newer versions
+# renamed it to ``check_vma``. Callers use the new spelling; translate here.
+import inspect as _inspect
+
+if "check_vma" not in _inspect.signature(shard_map).parameters:
+    _raw_shard_map = shard_map
+
+    def shard_map(f, **kwargs):  # type: ignore[no-redef]
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _raw_shard_map(f, **kwargs)
+
 __all__ = ["shard_map"]

@@ -302,6 +302,32 @@ define_bool("prefix_cache", True,
             "prefills only the remainder; needs kv_block_size > 0 and "
             "prefill_token_budget > 0. false = every prompt prefills "
             "from token zero (the A/B baseline)")
+define_bool("prefill_sp", False,
+            "decode engine: sequence-parallel long-prompt prefill over "
+            "the decode mesh — prompts at/above -prefill_sp_threshold "
+            "prefill in prefill_token_budget * decode_tp token chunks "
+            "with the chunk's rows sharded over the tp axis (one "
+            "budget's worth of rows per device per iteration, so a long "
+            "document admits in decode_tp x fewer iterations while the "
+            "per-iteration ITL bound holds); shorter prompts keep the "
+            "single-lane chunk program bit-for-bit. Needs kv_block_size "
+            "> 0 and prefill_token_budget > 0; incompatible with "
+            "kv_quant=int8 (docs/SERVING.md 'Long-context prefill')")
+define_string("prefill_sp_backend", "ring",
+              "decode engine: seqpar prefill collective schedule — "
+              "'ring' rotates K/V shards with decode_tp - 1 ppermute "
+              "steps (no head-count constraint; needs max_prompt + "
+              "max_new divisible by decode_tp), 'ulysses' all_to_all-"
+              "reshards the chunk rows onto the paged pool's native "
+              "head shard (2 collectives total; needs n_heads "
+              "divisible by decode_tp — already required by decode_tp "
+              "itself)")
+define_int("prefill_sp_threshold", 256,
+           "decode engine: minimum prompt length (tokens) routed "
+           "through the sequence-parallel prefill chunk program; "
+           "shorter prompts take the single-lane prefill_chunk_paged "
+           "path, whose outputs (and compiled trace) are exactly "
+           "today's")
 define_int("spec_k", 0,
            "decode engine: speculative decoding draft length — up to "
            "spec_k n-gram prompt-lookup drafts per live slot are scored "

@@ -36,6 +36,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..log import Log
+from ..ops.ring_attention import ring_prefill_attention
+from ..ops.ulysses import ulysses_prefill_attention
 from ..topology import SERVER_AXIS, WORKER_AXIS
 
 
@@ -565,6 +567,73 @@ def prefill_chunk_paged(cfg: TransformerConfig, params: Dict[str, Any],
         vc = jnp.take(v_pool[i], bt_row, axis=0).reshape(M * Bs, -1)
         h = h + _chunk_attention(
             q, kc[:T], vc[:T], cfg.n_heads, offset) @ layer["w_o"]
+        x = _rmsnorm(h, layer["ln2_g"])
+        h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
+    h = _rmsnorm(h, params["ln_f_g"])
+    last = jnp.take(h, length - 1, axis=0)
+    logits = jnp.einsum("d,vd->v", last, params["embed"],
+                        preferred_element_type=jnp.float32)
+    return k_pool, v_pool, logits
+
+
+def prefill_chunk_paged_sp(cfg: TransformerConfig, params: Dict[str, Any],
+                           k_pool: jax.Array, v_pool: jax.Array,
+                           block_tables: jax.Array, slot: jax.Array,
+                           tokens: jax.Array, offset: jax.Array,
+                           length: jax.Array, mesh, backend: str,
+                           t_logical: Optional[int] = None,
+                           tp_axis: str = "tp"
+                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sequence-parallel :func:`prefill_chunk_paged` over the decode mesh.
+
+    Identical contract and — row for row — identical math: the only
+    change is that the chunk's attention runs through
+    :func:`ops.ring_prefill_attention` (``backend="ring"``) or
+    :func:`ops.ulysses_prefill_attention` (``backend="ulysses"``), which
+    shard the ``C`` chunk rows over the decode mesh's ``tp_axis`` and
+    reassemble with collectives. Per-row chunk attention is independent
+    of how rows are grouped across devices and the serving entry points
+    reproduce ``_chunk_attention`` expression-for-expression, so outputs
+    are bit-identical to the single-lane path; what changes is that a
+    ``C = budget * tp`` chunk costs each device one budget's worth of
+    rows per iteration, so a long prompt prefills in ``tp``x fewer
+    iterations. Everything around the attention (embedding, K/V
+    projections, paged scatter/gather, MLP) is left to GSPMD exactly as
+    in the single-lane program. Requires ``C % tp == 0`` always and
+    ``t_logical % tp == 0`` for the ring backend (the ulysses backend
+    instead needs ``n_heads % tp == 0`` — the pool's native head shard).
+    """
+    if backend not in ("ring", "ulysses"):
+        raise ValueError(f"unknown seqpar backend {backend!r}")
+    C = tokens.shape[0]
+    Bs = k_pool.shape[2]
+    M = block_tables.shape[1]
+    T = M * Bs if t_logical is None else int(t_logical)
+    bt_row = jax.lax.dynamic_index_in_dim(block_tables, slot, 0,
+                                          keepdims=False)        # [M]
+    pos_ix = offset + jnp.arange(C)
+    valid = jnp.arange(C) < length
+    blk = jnp.where(
+        valid, jnp.take(bt_row, jnp.clip(pos_ix // Bs, 0, M - 1)), 0)
+    off = jnp.where(valid, pos_ix % Bs, 0)
+    h = (jnp.take(params["embed"], tokens, axis=0)
+         + jnp.take(params["pos"], pos_ix, axis=0))
+    for i in range(cfg.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["layers"])
+        x = _rmsnorm(h, layer["ln1_g"])
+        q, k, v = x @ layer["w_q"], x @ layer["w_k"], x @ layer["w_v"]
+        k_pool = k_pool.at[i, blk, off].set(k)
+        v_pool = v_pool.at[i, blk, off].set(v)
+        kc = jnp.take(k_pool[i], bt_row, axis=0).reshape(M * Bs, -1)
+        vc = jnp.take(v_pool[i], bt_row, axis=0).reshape(M * Bs, -1)
+        if backend == "ring":
+            attn = ring_prefill_attention(q, kc[:T], vc[:T], cfg.n_heads,
+                                          offset, mesh, axis=tp_axis)
+        else:
+            attn = ulysses_prefill_attention(q, kc[:T], vc[:T],
+                                             cfg.n_heads, offset, mesh,
+                                             axis=tp_axis)
+        h = h + attn @ layer["w_o"]
         x = _rmsnorm(h, layer["ln2_g"])
         h = h + jax.nn.gelu(x @ layer["w_ff1"]) @ layer["w_ff2"]
     h = _rmsnorm(h, params["ln_f_g"])
@@ -1217,7 +1286,8 @@ def make_sharded_decode_programs(cfg: TransformerConfig, mesh,
                                  t_logical: int, donate: bool = False,
                                  tp_axis: str = DECODE_TP_AXIS,
                                  kv_quant: str = "none",
-                                 param_quant: str = "none"
+                                 param_quant: str = "none",
+                                 prefill_sp: str = "none"
                                  ) -> Dict[str, Any]:
     """Pre-partitioned decode-mesh variants of the paged serving programs.
 
@@ -1247,7 +1317,17 @@ def make_sharded_decode_programs(cfg: TransformerConfig, mesh,
     :func:`dequantize_decode_params` folded in at compile time. Both
     default off; the default programs are exactly the pre-quantization
     ones.
+
+    ``prefill_sp="ring"|"ulysses"`` adds a ``"chunk_sp"`` program — the
+    sequence-parallel :func:`prefill_chunk_paged_sp` jitted with the
+    SAME shardings/donation as ``"chunk"``; the chunk size rides the
+    token-array shape (the engine passes ``budget * tp`` tokens, one
+    budget's worth of rows per device). It rides next to, not
+    instead of, the single-lane ``"chunk"``: the engine routes prompts
+    by ``prefill_sp_threshold``. Incompatible with ``kv_quant="int8"``.
     """
+    if prefill_sp != "none" and kv_quant == "int8":
+        raise ValueError("prefill_sp is incompatible with kv_quant=int8")
     if param_quant == "int8":
         ps = decode_param_quant_shardings(mesh, tp_axis)
         pf = lambda p: dequantize_decode_params(p, cfg.dtype)
@@ -1341,9 +1421,19 @@ def make_sharded_decode_programs(cfg: TransformerConfig, mesh,
         in_shardings=(ps, pool, pool, rep, rep, rep, rep, rep),
         out_shardings=(pool, pool, rep),
         donate_argnums=kv_donate)
-    return {"step": step, "chunk": chunk, "admit": admit, "cow": cow,
-            "verify": verify, "param_shardings": ps,
-            "pool_sharding": pool}
+    progs = {"step": step, "chunk": chunk, "admit": admit, "cow": cow,
+             "verify": verify, "param_shardings": ps,
+             "pool_sharding": pool}
+    if prefill_sp != "none":
+        progs["chunk_sp"] = jax.jit(
+            lambda params, kc, vc, bt, slot, toks, off, n:
+            prefill_chunk_paged_sp(cfg, pf(params), kc, vc, bt, slot,
+                                   toks, off, n, mesh, prefill_sp,
+                                   t_logical=T, tp_axis=tp_axis),
+            in_shardings=(ps, pool, pool, rep, rep, rep, rep, rep),
+            out_shardings=(pool, pool, rep),
+            donate_argnums=kv_donate)
+    return progs
 
 
 def cache_insert(k_cache: jax.Array, v_cache: jax.Array, slots: jax.Array,

@@ -5,8 +5,9 @@ from .flash_attention import (flash_attention, flash_attention_partial,
                               merge_partials)
 from .moe import (EXPERT_AXIS, init_moe_params, mlp_expert, moe_apply,
                   top1_gating)
-from .ring_attention import reference_attention, ring_attention
-from .ulysses import ulysses_attention
+from .ring_attention import (reference_attention, ring_attention,
+                             ring_prefill_attention)
+from .ulysses import ulysses_attention, ulysses_prefill_attention
 
 __all__ = [
     "embedding_lookup",
@@ -22,5 +23,7 @@ __all__ = [
     "top1_gating",
     "reference_attention",
     "ring_attention",
+    "ring_prefill_attention",
     "ulysses_attention",
+    "ulysses_prefill_attention",
 ]

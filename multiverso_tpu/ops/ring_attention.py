@@ -199,6 +199,92 @@ def ring_attention(
     return _ring_pallas(q, k, v)
 
 
+# -- serving-shaped entry points ----------------------------------------------
+#
+# The decode engine's chunked prefill attends 2-D operands: a chunk of
+# query rows ``q [C, D]`` against the slot's gathered paged view
+# ``kc/vc [T, D]`` with a traced global row offset (the prefix-causal
+# mask ``t <= offset + row``). The entry points below run that exact
+# computation sequence-parallel over a mesh axis — the serving face of
+# the [seq, heads, dim] training kernels above. They deliberately do
+# NOT reuse the flash-style running-max accumulation (`_block_attn`):
+# its reduction order differs from the engine's single-softmax
+# `_chunk_attention` math, and the seqpar serving contract is
+# bit-identical outputs against the single-lane path.
+
+
+def _prefix_chunk_attn(qh, kh, vh, rows, dh):
+    """The engine's exact chunk-attention math on pre-split heads.
+
+    ``qh [C, H, dh]``, ``kh/vh [T, H, dh]``, ``rows [C]`` global row
+    positions (the causal mask bound). Mirrors
+    ``models.transformer._chunk_attention`` expression-for-expression —
+    one full f32 softmax per row, single P@V over the full ``T`` — so a
+    per-head (or per-row-shard) slice of this computation is bitwise
+    the single-device computation's slice.
+    """
+    T = kh.shape[0]
+    scores = jnp.einsum("chd,thd->hct", qh, kh,
+                        preferred_element_type=jnp.float32) / np.sqrt(dh)
+    mask = (jnp.arange(T)[None, :] <= rows[:, None])[None, :, :]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("hct,thd->chd", probs.astype(vh.dtype), vh)
+
+
+def ring_prefill_attention(q, kc, vc, n_heads: int, offset, mesh,
+                           axis: str = SEQ_AXIS) -> jax.Array:
+    """Ring-sharded serving chunk attention, bit-exact vs the engine.
+
+    ``q [C, D]`` chunk rows (sharded ``P(axis, None)`` — each device
+    owns ``C/n`` consecutive rows), ``kc``/``vc`` ``[T, D]`` the slot's
+    gathered paged view (resharded to ``P(axis, None)`` sequence
+    shards), ``offset`` the chunk's traced global base position.
+    ``n - 1`` ``ppermute`` rotations reassemble the K/V shards in
+    GLOBAL order on every device, then each device runs the engine's
+    exact `_chunk_attention` math on its local query rows — same
+    softmax, same full-``T`` contraction, hence bit-identical rows.
+    Requires ``C % n == 0`` and ``T % n == 0`` (no padding: padding
+    would change the reduction length and break bit-exactness).
+    """
+    n = int(mesh.shape[axis])
+    C, D = int(q.shape[0]), int(q.shape[1])
+    T = int(kc.shape[0])
+    if C % n != 0:
+        raise ValueError(f"chunk rows {C} must divide over {n} ring shards")
+    if T % n != 0:
+        raise ValueError(f"kv length {T} must divide over {n} ring shards")
+    dh = D // n_heads
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis, None), P(axis, None), P(axis, None), P()),
+             out_specs=P(axis, None), check_vma=False)
+    def _ring(q_blk, k_blk, v_blk, off):
+        idx = jax.lax.axis_index(axis)
+        # collect every K/V shard via a static ring of rotations; after
+        # j steps we hold the shard that lives at ring position
+        # (idx - j) mod n
+        k_parts, v_parts = [k_blk], [v_blk]
+        k_cur, v_cur = k_blk, v_blk
+        for _ in range(n - 1):
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+            k_parts.append(k_cur)
+            v_parts.append(v_cur)
+        # global-order reassembly: shard s sits at part (idx - s) mod n
+        order = jnp.mod(idx - jnp.arange(n), n)
+        k_full = jnp.take(jnp.stack(k_parts), order, axis=0).reshape(T, D)
+        v_full = jnp.take(jnp.stack(v_parts), order, axis=0).reshape(T, D)
+        rows = off + idx * (C // n) + jnp.arange(C // n)
+        out = _prefix_chunk_attn(q_blk.reshape(C // n, n_heads, dh),
+                                 k_full.reshape(T, n_heads, dh),
+                                 v_full.reshape(T, n_heads, dh), rows, dh)
+        return out.reshape(C // n, D).astype(q_blk.dtype)
+
+    return _ring(q, kc, vc, offset)
+
+
 def reference_attention(q, k, v, causal: bool = False,
                         scale: Optional[float] = None) -> jax.Array:
     """Unsharded O(seq^2) attention — the correctness oracle for tests, and

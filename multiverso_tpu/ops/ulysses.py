@@ -26,13 +26,14 @@ from functools import partial
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from jax.sharding import PartitionSpec as P
 
 from .._compat import shard_map
 from ..topology import SEQ_AXIS
-from .ring_attention import reference_attention
+from .ring_attention import _prefix_chunk_attn, reference_attention
 
 
 def ulysses_attention(
@@ -99,3 +100,52 @@ def ulysses_attention(
                                   tiled=True).astype(q_blk.dtype)
 
     return _ulysses(q, k, v)
+
+
+def ulysses_prefill_attention(q, kc, vc, n_heads: int, offset, mesh,
+                              axis: str = SEQ_AXIS) -> jax.Array:
+    """All-to-all-resharded serving chunk attention, bit-exact vs the engine.
+
+    The serving face of :func:`ulysses_attention`: ``q [C, D]`` chunk
+    rows sharded ``P(axis, None)``, ``kc``/``vc`` ``[T, D]`` the slot's
+    gathered paged view HEAD-sharded ``P(None, axis)`` — the paged
+    pool's native layout, so the prefix K/V never reshards. One
+    ``all_to_all`` turns the row shard of q into a head shard (full
+    chunk rows, ``H/n`` whole heads per device — the contiguous
+    ``D/n`` slice matches the pool shard by construction), the local
+    computation is the engine's exact `_chunk_attention` math over the
+    full ``T`` for those heads, and the reverse ``all_to_all`` restores
+    row sharding. Per-head math is untouched by the resharding, hence
+    bit-identical rows. Requires ``C % n == 0`` and ``n_heads % n == 0``
+    (whole heads per device; ``offset`` is the traced global base row).
+    """
+    n = int(mesh.shape[axis])
+    C, D = int(q.shape[0]), int(q.shape[1])
+    T = int(kc.shape[0])
+    if C % n != 0:
+        raise ValueError(f"chunk rows {C} must divide over {n} shards")
+    if n_heads % n != 0:
+        raise ValueError(
+            f"ulysses needs heads ({n_heads}) divisible by mesh axis "
+            f"{axis}={n}; use ring_prefill_attention for fewer heads")
+    hl = n_heads // n
+    dh = D // n_heads
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis, None), P(None, axis), P(None, axis), P()),
+             out_specs=P(axis, None), check_vma=False)
+    def _ulysses_sp(q_blk, k_blk, v_blk, off):
+        # [C/n, D] -> [C, D/n]: full chunk rows for a whole-heads slice
+        # (tiled concat lands peer p's rows at p*C/n — global row order)
+        qf = jax.lax.all_to_all(q_blk, axis, split_axis=1, concat_axis=0,
+                                tiled=True)
+        rows = off + jnp.arange(C)
+        out = _prefix_chunk_attn(qf.reshape(C, hl, dh),
+                                 k_blk.reshape(T, hl, dh),
+                                 v_blk.reshape(T, hl, dh), rows, dh)
+        # [C, D/n] -> [C/n, D]
+        return jax.lax.all_to_all(out.reshape(C, D // n), axis,
+                                  split_axis=0, concat_axis=1,
+                                  tiled=True).astype(q_blk.dtype)
+
+    return _ulysses_sp(q, kc, vc, offset)

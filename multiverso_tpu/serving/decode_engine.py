@@ -201,6 +201,16 @@ class DecodeEngineConfig:
     # inert otherwise). False is the A/B baseline: same pool bytes,
     # every prompt prefills from token zero.
     prefix_cache: Optional[bool] = None
+    # sequence-parallel long-prompt prefill over the decode mesh (None =
+    # the matching -prefill_sp* flags): prompts at/above the threshold
+    # prefill in budget * tp token chunks with the chunk's rows sharded
+    # over the decode mesh's tp axis ("ring" ppermute rotations or
+    # "ulysses" all_to_all head resharding); shorter prompts keep the
+    # single-lane chunk program bit-for-bit. Paged + chunked only;
+    # incompatible with kv_quant=int8.
+    prefill_sp: Optional[bool] = None
+    prefill_sp_backend: Optional[str] = None
+    prefill_sp_threshold: Optional[int] = None
     # speculative decoding draft length (None = the -spec_k flag).
     # 0 = off (today's one-token path, bit-for-bit); > 0 drafts up to
     # spec_k tokens per live slot via n-gram prompt lookup and verifies
@@ -565,7 +575,7 @@ class _Request:
                  "n_hit", "full_hit", "saved", "pf_reg", "ttft_pending",
                  "drafter", "priority", "deadline", "preempts",
                  "resumed", "skips", "prompt0", "pf_only", "known",
-                 "xfer", "tenant", "usage")
+                 "xfer", "tenant", "usage", "sp")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  ctx: Optional[trace.SpanContext] = None,
@@ -590,6 +600,9 @@ class _Request:
         self.pf_off = 0
         self.pf_chunks = 0
         self.t_admit = 0.0
+        # sequence-parallel prefill routing (set at _begin_prefill on
+        # -prefill_sp engines: prompt length >= the threshold)
+        self.sp = False
         # prefix caching: the prompt's full-block hash chain (memoized
         # per seed), blocks matched at admission, whether the WHOLE
         # prompt was cached, prefill tokens skipped, how many prompt
@@ -659,6 +672,7 @@ class DecodeEngine:
                                           prefill, prefill_chunk,
                                           prefill_chunk_paged,
                                           prefill_chunk_paged_q,
+                                          prefill_chunk_paged_sp,
                                           verify_step_paged,
                                           verify_step_paged_q)
 
@@ -814,6 +828,45 @@ class DecodeEngine:
         self._prefix = (self._paged and self._budget > 0
                         and bool(ec._resolved("prefix_cache")))
         self._hash_seed = b""        # pinned-version scope for the chain
+        # sequence-parallel prefill: prompts at/above the threshold chunk
+        # at budget * tp tokens with the rows sharded over the decode
+        # mesh — a long prompt admits in tp x fewer iterations while each
+        # device still runs one budget's worth of rows per iteration
+        # (the ITL bound the budget exists for). Short prompts keep the
+        # single-lane chunk program bit-for-bit.
+        self._sp = bool(ec._resolved("prefill_sp"))
+        self._sp_backend = str(ec._resolved("prefill_sp_backend"))
+        self._sp_threshold = int(ec._resolved("prefill_sp_threshold"))
+        self._chunk_sp_fn = None
+        if self._sp:
+            if not (self._paged and self._budget > 0):
+                Log.fatal(f"DecodeEngine {name!r}: prefill_sp needs the "
+                          f"paged KV cache (kv_block_size > 0) AND "
+                          f"chunked prefill (prefill_token_budget > 0) "
+                          f"— the seqpar chunk scatters through block "
+                          f"tables at a traced offset")
+            if self._kv_quant:
+                Log.fatal(f"DecodeEngine {name!r}: prefill_sp is "
+                          f"incompatible with kv_quant=int8 — the "
+                          f"seqpar entry points reproduce the fp chunk "
+                          f"math exactly and have no quantized variant")
+            if self._sp_backend not in ("ring", "ulysses"):
+                Log.fatal(f"DecodeEngine {name!r}: prefill_sp_backend "
+                          f"must be 'ring' or 'ulysses', got "
+                          f"{self._sp_backend!r}")
+            if self._sp_threshold < 0:
+                Log.fatal(f"DecodeEngine {name!r}: negative "
+                          f"prefill_sp_threshold {self._sp_threshold}")
+            if self._sp_backend == "ring" and T % self._tp != 0:
+                # the ring rotates the slot's gathered [T, D] view in
+                # T/tp-row shards; ulysses keeps T whole (head shards)
+                Log.fatal(f"DecodeEngine {name!r}: ring prefill_sp "
+                          f"needs the logical cache length {T} "
+                          f"divisible by decode_tp {self._tp} — use "
+                          f"the ulysses backend or adjust "
+                          f"max_prompt/max_new")
+        # the seqpar chunk's global size: one budget of rows per DEVICE
+        self._sp_chunk = self._budget * self._tp if self._sp else 0
         # speculative decoding: up to spec_k prompt-lookup drafts per
         # live slot, verified by one fused fixed-K step per iteration.
         # Paged-only: the verify window's scatter/rollback contract is
@@ -870,11 +923,16 @@ class DecodeEngine:
             progs = make_sharded_decode_programs(
                 cfg, self._decode_mesh, T, donate=bool(donate),
                 kv_quant=self._kv_quant_mode,
-                param_quant=self._param_quant)
+                param_quant=self._param_quant,
+                prefill_sp=self._sp_backend if self._sp else "none")
             self._param_shardings = progs["param_shardings"]
             self._cache_sharding = progs["pool_sharding"]
             self._admit_fn = progs["admit"]
             self._chunk_fn = progs["chunk"]
+            # the seqpar chunk program rides the same builder (same
+            # matched in/out_shardings and donation as "chunk"), so the
+            # partitioner runs at compile time here too
+            self._chunk_sp_fn = progs.get("chunk_sp")
             self._step_fn = progs["step"]
             self._cow_fn = progs["cow"] if self._prefix else None
             # the verify step pins and partitions like the fused step
@@ -980,6 +1038,27 @@ class DecodeEngine:
                     prefill_chunk_paged(cfg, pf(params), kc, vc, bt, slot,
                                         toks, off, n, t_logical=T),
                     donate_argnums=donate)
+                if self._sp:
+                    # tp=1 seqpar rides a ONE-device decode mesh: the
+                    # collectives degenerate (n=1) but the shard_map
+                    # path is genuinely exercised, and the chunk size
+                    # equals the budget so the math coincides with the
+                    # single-lane program exactly
+                    from ..models.transformer import DECODE_TP_AXIS
+                    from ..topology import make_mesh
+
+                    sp_mesh = make_mesh(
+                        (1,), axis_names=(DECODE_TP_AXIS,),
+                        devices=jax.devices()[:1])
+                    sp_backend = self._sp_backend
+                    self._chunk_sp_fn = jax.jit(
+                        lambda params, kc, vc, bt, slot, toks, off, n:
+                        prefill_chunk_paged_sp(cfg, pf(params), kc, vc,
+                                               bt, slot, toks, off, n,
+                                               sp_mesh, sp_backend,
+                                               t_logical=T,
+                                               tp_axis=DECODE_TP_AXIS),
+                        donate_argnums=donate)
                 self._step_fn = jax.jit(
                     lambda params, kc, vc, bt, tok, pos, active:
                     decode_step_paged(cfg, pf(params), kc, vc, bt, tok,
@@ -1230,6 +1309,8 @@ class DecodeEngine:
                 self.recorder.meta["spec_k"] = self._spec
             if self._kv_quant:
                 self.recorder.meta["kv_quant"] = self._kv_quant_mode
+            if self._sp:
+                self.recorder.meta["prefill_sp"] = self._sp_backend
         # admit-span mesh annotation (trace_summary ships the column):
         # only sharded engines carry it, so replicated reports stay flat
         self._mesh_attrs = ({"decode_tp": self._tp} if self._tp > 1
@@ -1258,6 +1339,7 @@ class DecodeEngine:
         self._it_decode = 0
         self._it_spec_proposed = 0
         self._it_spec_accepted = 0
+        self._it_sp_chunks = 0
         self.completed = 0
         self.shed = 0
         self.tokens = 0
@@ -1290,6 +1372,9 @@ class DecodeEngine:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_steps = 0
+        # sequence-parallel prefill mirror (resets with the bench
+        # window): chunks dispatched through the seqpar program
+        self.seqpar_chunks = 0
         # overload mirrors (the PREEMPTIONS/DEADLINE_DROPS counters
         # stay monotonic; these reset with the bench window):
         # preemption EVENTS, distinct requests preempted at least
@@ -1760,6 +1845,7 @@ class DecodeEngine:
             self._it_completed.clear()
             self._it_prefill = self._it_decode = 0
             self._it_spec_proposed = self._it_spec_accepted = 0
+            self._it_sp_chunks = 0
             step_ms = 0.0
             worked = False
             try:
@@ -1779,6 +1865,32 @@ class DecodeEngine:
                     if arrivals:
                         self._begin_prefill(arrivals[0],
                                             self._free_q.popleft())
+                    # zero-cost admissions (a full prefix hit goes live
+                    # without a single prefill chunk) must not consume
+                    # the iteration's one admission slot: keep admitting
+                    # until a chunk is actually pending or nothing is
+                    # admissible, so a full-hit-heavy trace admits at
+                    # slot rate instead of one request per iteration
+                    # (the per-iteration chunk budget below is what
+                    # bounds ITL, and these admissions cost no chunk)
+                    while self._pf is None and self._free_q:
+                        with self._cv:
+                            if not self._q:
+                                break
+                            req, exp = self._q.pop_admissible(
+                                time.monotonic(),
+                                lambda r: self._blocks_cover(r, 0))
+                        if exp:
+                            self._drop_expired(exp)
+                        if req is None:
+                            break
+                        arrivals.append(req)
+                        self._begin_prefill(req, self._free_q.popleft())
+                        if req.slot == -1:
+                            # the reservation raced a pool claimant and
+                            # the request was requeued — retry next
+                            # iteration rather than spinning here
+                            break
                     if self._pf is not None:
                         # AT MOST one budget-sized chunk per iteration:
                         # the stall an admission can add to every live
@@ -1865,7 +1977,11 @@ class DecodeEngine:
             # the live tenant cardinality
             round(it_block_s, 6) if self.ledger is not None else -1.0,
             (self.ledger.tenant_count() if self.ledger is not None
-             else -1)))
+             else -1),
+            # seqpar tail (FIELDS append at the END; -1 = prefill_sp
+            # off): chunks this iteration dispatched through the
+            # sequence-parallel program
+            self._it_sp_chunks if self._sp else -1))
 
     def _seed_for(self, version: int) -> bytes:
         """Hash-chain seed for a pinned snapshot version. kv_quant tags
@@ -2131,6 +2247,10 @@ class DecodeEngine:
         # aligned); the matched prefix blocks are already in the table
         req.pf_off = req.n_hit * self._block_size if self._prefix else 0
         req.pf_reg = req.n_hit
+        # seqpar routing decides per REQUEST, once: prompts at/above the
+        # threshold take the budget * tp sequence-parallel chunks, the
+        # rest keep the single-lane program bit-for-bit
+        req.sp = self._sp and len(req.prompt) >= self._sp_threshold
         self._pf = req
 
     def _prefill_one_chunk(self) -> None:
@@ -2139,7 +2259,8 @@ class DecodeEngine:
         slot goes live (or resolves immediately on eos-at-first-token,
         never occupying the slot)."""
         req = self._pf
-        C = self._budget
+        sp = req.sp
+        C = self._sp_chunk if sp else self._budget
         off = req.pf_off
         n = min(C, len(req.prompt) - off)
         toks = np.zeros(C, np.int32)
@@ -2153,7 +2274,8 @@ class DecodeEngine:
                 self._k_scales, self._v_scales, self._block_tables,
                 np.int32(req.slot), toks, np.int32(off), np.int32(n))
         elif self._paged:
-            self._k_cache, self._v_cache, logits = self._chunk_fn(
+            chunk_fn = self._chunk_sp_fn if sp else self._chunk_fn
+            self._k_cache, self._v_cache, logits = chunk_fn(
                 self._pinned, self._k_cache, self._v_cache,
                 self._block_tables, np.int32(req.slot), toks,
                 np.int32(off), np.int32(n))
@@ -2171,6 +2293,9 @@ class DecodeEngine:
         jax.block_until_ready(self._k_cache)
         req.pf_off = off + n
         req.pf_chunks += 1
+        if sp:
+            self.seqpar_chunks += 1
+            self._it_sp_chunks += 1
         self.prefill_tokens += n
         self.prefill_tok_counter.inc(n)
         self._it_prefill += n
@@ -2196,10 +2321,15 @@ class DecodeEngine:
                 req.pf_reg += 1
         final = req.pf_off >= len(req.prompt)
         if tracing and req.ctx is not None:
+            # seqpar ENGINES annotate every chunk span (sp=0 marks a
+            # below-threshold prompt on the single-lane program); off-sp
+            # engines' spans stay flat — the metrics regression contract
+            sp_attrs = ({"sp": int(sp), "sp_backend": self._sp_backend}
+                        if self._sp else {})
             trace.record_span(
                 "decode.prefill_chunk", req.ctx, t0, time.monotonic(),
                 slot=req.slot, offset=off, chunk=req.pf_chunks - 1,
-                tokens=n, budget=C)
+                tokens=n, budget=C, **sp_attrs)
         if not final:
             return
         if req.pf_only:
@@ -3041,6 +3171,15 @@ class DecodeEngine:
             return 0
         return _jit_cache_size(self._verify_fn)
 
+    def seqpar_cache_size(self) -> int:
+        """Compiled-trace count of the sequence-parallel chunk program
+        (1 after warmup on a ``-prefill_sp`` engine — the budget * tp
+        token shape is the whole signature; 0 when off — the program
+        doesn't exist)."""
+        if self._chunk_sp_fn is None:
+            return 0
+        return _jit_cache_size(self._chunk_sp_fn)
+
     def transfer_cache_size(self) -> int:
         """Compiled-trace count of the KV transfer plane (2 after
         warmup on a prefix-cache engine — one fetch, one splice; the
@@ -3147,6 +3286,15 @@ class DecodeEngine:
                 self._chunk_fn(params, kc, vc, bt, np.int32(0),
                                np.ones(self._budget, np.int32),
                                np.int32(0), np.int32(1))
+                if self._chunk_sp_fn is not None:
+                    # the seqpar chunk program compiles here too (its
+                    # budget * tp token shape is the only static), so no
+                    # long prompt ever pays the trace — and the
+                    # partitioner runs now, not on the loop thread
+                    kc, vc = scratch()
+                    self._chunk_sp_fn(params, kc, vc, bt, np.int32(0),
+                                      np.ones(self._sp_chunk, np.int32),
+                                      np.int32(0), np.int32(1))
             else:
                 for pb in self._prompt_buckets:
                     for bb in self._batch_buckets:
@@ -3227,6 +3375,7 @@ class DecodeEngine:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_steps = 0
+        self.seqpar_chunks = 0
         self.preemptions = 0
         self.preempted = 0
         self.deadline_drops = 0
@@ -3338,6 +3487,19 @@ class DecodeEngine:
                 "xfer_dedup_blocks": self.xfer_dedup,
                 "xfer_dedup_hit_rate": (self.xfer_dedup / moved
                                         if moved else 0.0),
+            })
+        if self._sp:
+            # sequence-parallel prefill surface, present only on
+            # -prefill_sp engines (an off-sp engine's stats dict stays
+            # byte-for-byte today's — the metrics regression contract).
+            # seqpar_traces is the one-trace gate for the sp chunk
+            # program, exactly like step_traces/prefill_traces
+            pool.update({
+                "prefill_sp": self._sp_backend,
+                "prefill_sp_threshold": self._sp_threshold,
+                "prefill_sp_chunk": self._sp_chunk,
+                "seqpar_chunks": self.seqpar_chunks,
+                "seqpar_traces": self.seqpar_cache_size(),
             })
         if self._spec:
             # speculative-decoding surface, present only on spec

@@ -54,6 +54,9 @@ Record schema (:data:`FIELDS`, positional):
                         -1 when ``-cost_ledger`` is off)
 ``tenants_live``        live tenant cardinality in the cost ledger's
                         aggregate table (-1 when ``-cost_ledger`` is off)
+``sp_chunks``           prefill chunks dispatched through the sequence-
+                        parallel program THIS pass (-1 when
+                        ``-prefill_sp`` is off)
 ======================  =====================================================
 
 Timestamps are monotonic; the recorder captures a wall/mono anchor at
@@ -93,7 +96,7 @@ FIELDS = ("it", "ts", "busy_ms", "step_ms", "live", "reserved", "queue",
           "queue_age_ms", "prefill_toks", "decode_toks", "pool_free",
           "pool_live", "pool_shared", "version", "admitted", "completed",
           "spec_proposed", "spec_accepted", "kv_quant",
-          "quant_scale_blocks", "kv_block_s", "tenants_live")
+          "quant_scale_blocks", "kv_block_s", "tenants_live", "sp_chunks")
 
 
 def window_digest(records: List[Dict[str, Any]]) -> Dict[str, Any]:

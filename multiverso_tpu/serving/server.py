@@ -134,6 +134,9 @@ class InferenceServer:
                          kv_pool_blocks: Optional[int] = None,
                          decode_tp: Optional[int] = None,
                          prefix_cache: Optional[bool] = None,
+                         prefill_sp: Optional[bool] = None,
+                         prefill_sp_backend: Optional[str] = None,
+                         prefill_sp_threshold: Optional[int] = None,
                          spec_k: Optional[int] = None,
                          kv_quant: Optional[str] = None,
                          decode_param_quant: Optional[str] = None,
@@ -174,7 +177,18 @@ class InferenceServer:
         default on) turns on content-addressed block reuse over that
         pool: prompts sharing a prefix prefill it once and splice the
         cached blocks refcounted/copy-on-write (docs/SERVING.md
-        "Prefix caching"). ``spec_k`` (None = the ``-spec_k`` flag,
+        "Prefix caching"). ``prefill_sp`` (None = the ``-prefill_sp``
+        flag, default off; paged + chunked, sharded or single-device)
+        turns on sequence-parallel long-prompt prefill: prompts of at
+        least ``prefill_sp_threshold`` tokens prefill in
+        ``prefill_token_budget * decode_tp`` token chunks whose rows
+        shard over the decode mesh via ``prefill_sp_backend`` ("ring"
+        ppermute rotations or "ulysses" all_to_all head resharding) —
+        a long document admits in ``decode_tp`` x fewer iterations
+        while each device still runs one budget of rows per iteration,
+        and shorter prompts keep the single-lane chunk program
+        bit-for-bit (docs/SERVING.md "Long-context prefill").
+        ``spec_k`` (None = the ``-spec_k`` flag,
         default 0 = off) turns on speculative decoding: up to
         ``spec_k`` n-gram prompt-lookup drafts per live slot, verified
         by one fused fixed-K step per iteration — up to ``spec_k + 1``
@@ -222,6 +236,9 @@ class InferenceServer:
             prefill_token_budget=prefill_token_budget,
             kv_block_size=kv_block_size, kv_pool_blocks=kv_pool_blocks,
             decode_tp=decode_tp, prefix_cache=prefix_cache,
+            prefill_sp=prefill_sp,
+            prefill_sp_backend=prefill_sp_backend,
+            prefill_sp_threshold=prefill_sp_threshold,
             spec_k=spec_k, kv_quant=kv_quant,
             decode_param_quant=decode_param_quant,
             preempt=preempt, preempt_budget=preempt_budget,

@@ -217,6 +217,45 @@ class FpQuantEngine:
         return self._step(1.0) + self._chunk(2.0)
 
 
+def _build_fp_seqpar_programs(fn, specs):
+    """Sequence-parallel prefill-program builder: ONE shard_map'd chunk
+    pjit per engine config, built next to the fused step at
+    construction by the engine below (the prefill_sp one-trace
+    contract — the chunk is padded to the fixed budget x tp width, the
+    valid length rides as traced data)."""
+    step = jax.jit(fn, in_shardings=specs, out_shardings=specs)
+    chunk_sp = jax.jit(fn, in_shardings=specs, out_shardings=specs)
+    return step, chunk_sp
+
+
+class FpSeqparEngine:
+    """RT106: the seqpar prefill contract upheld — the sequence-
+    parallel chunk program is built once in __init__/warmup through a
+    module-level builder, and the iteration path only picks WHICH
+    prebuilt handle to dispatch (single-lane under the threshold,
+    seqpar above it) — the routing decision is host data, never a new
+    program."""
+
+    def __init__(self, fn, specs):
+        self._specs = specs
+        self._step, self._chunk_sp = _build_fp_seqpar_programs(fn, specs)
+
+    def warmup(self):
+        # warmup may rebuild the seqpar programs (e.g. after a budget
+        # or backend config change) — still a construction-time site
+        self._step, self._chunk_sp = _build_fp_seqpar_programs(
+            lambda x: x, self._specs)
+        return self._chunk_sp(0.0)
+
+    def _loop(self):
+        while True:
+            self._iterate(True)
+
+    def _iterate(self, long_prompt):
+        chunk = self._chunk_sp if long_prompt else self._step
+        return chunk(1.0)
+
+
 class FpLedgerEngine:
     """RT106/RT102: the cost-ledger contract upheld — per-iteration
     accounting is pure HOST state (float adds into a usage vector,

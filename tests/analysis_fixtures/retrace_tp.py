@@ -192,6 +192,36 @@ class Rt106QuantEngine:
         return step(1.0)
 
 
+def _build_seqpar_chunk(fn, mesh_specs):
+    """A sequence-parallel prefill-program builder: constructing the
+    shard_map'd chunk pjit against the decode mesh IS its job
+    (sanctioned at module level; hazardous only when the iteration path
+    calls it — see Rt106SeqparEngine)."""
+    return jax.jit(fn, in_shardings=mesh_specs, out_shardings=mesh_specs)
+
+
+class Rt106SeqparEngine:
+    """RT106 via the seqpar prefill plane: rebuilding the
+    sequence-parallel chunk program per iteration (e.g. keying the
+    build on the CURRENT prompt's chunk length instead of padding to
+    the fixed budget x tp chunk and passing the valid length as traced
+    data) recompiles — and repartitions — on every long-prompt chunk.
+    The chunk program must be built once per engine config next to the
+    fused step, the routing decision host-side data."""
+
+    def __init__(self, fn, specs):
+        self._fn = fn
+        self._specs = specs
+
+    def _loop(self):
+        while True:
+            self._iterate()
+
+    def _iterate(self):
+        chunk_sp = _build_seqpar_chunk(self._fn, self._specs)  # RT106 builder
+        return chunk_sp(1.0)
+
+
 def _build_cost_reducer(fn):
     """A cost-vector reduction program builder: jitting a fold IS its
     job at construction time (sanctioned at module level; hazardous

@@ -97,6 +97,18 @@ def test_metric_direction_rules():
     # a zero-baseline hard gate — any nonzero drift means tokens were
     # consumed without attribution; the per-tenant cost columns and the
     # ledger overhead ride as _info
+    # long-context serving (lm_long_context A/B): document TTFT and the
+    # short interactive requests' tail ITL both regress UP on the
+    # seqpar leg; the off leg's twins and the cross-leg ratios are
+    # noise-floor _info
+    assert metric_direction("ttft_long_p50") == -1
+    assert metric_direction("itl_short_p99") == -1
+    assert metric_direction("ttft_long_p50_info") == 0
+    assert metric_direction("itl_short_p99_info") == 0
+    assert metric_direction("ttft_long_speedup_info") == 0
+    assert metric_direction("itl_short_p99_ratio_info") == 0
+    assert metric_direction("seqpar_chunks_info") == 0
+    assert metric_direction("seqpar_traces") == 0   # informational count
     assert metric_direction("accounting_drift") == -1
     assert metric_direction("cost_acme_info") == 0
     assert metric_direction("ledger_overhead_frac_info") == 0

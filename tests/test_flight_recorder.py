@@ -21,11 +21,12 @@ def _rec(it, ts, busy=1.0, step=0.5, live=1, reserved=0, queue=0,
          queue_age=0.0, prefill=0, decode=1, pool_free=-1, pool_live=-1,
          pool_shared=-1, version=0, admitted=(), completed=(),
          spec_proposed=-1, spec_accepted=-1, kv_quant=-1,
-         quant_scale_blocks=-1, kv_block_s=-1.0, tenants_live=-1):
+         quant_scale_blocks=-1, kv_block_s=-1.0, tenants_live=-1,
+         sp_chunks=-1):
     return (it, ts, busy, step, live, reserved, queue, queue_age,
             prefill, decode, pool_free, pool_live, pool_shared, version,
             admitted, completed, spec_proposed, spec_accepted, kv_quant,
-            quant_scale_blocks, kv_block_s, tenants_live)
+            quant_scale_blocks, kv_block_s, tenants_live, sp_chunks)
 
 
 # -- ring ---------------------------------------------------------------------
@@ -196,6 +197,27 @@ def test_tenant_counter_track_and_pre_ledger_tuple_tolerance():
     assert legacy.summary()["iterations"] == 1
     assert not any(e["name"].endswith("/tenants")
                    for e in legacy.chrome_counter_events())
+
+
+def test_sp_chunks_column_and_pre_seqpar_tuple_tolerance():
+    """The seqpar column rides the END of FIELDS: ``-prefill_sp``
+    engines record the iteration's sequence-parallel chunk count,
+    sp-off engines carry -1, and a pre-seqpar 22-field tuple still
+    reads cleanly everywhere (the spec/quant/ledger append pattern,
+    continued)."""
+    fr = FlightRecorder(capacity=8, name="eng")
+    fr.record(_rec(1, time.monotonic(), sp_chunks=2))
+    assert fr.records()[0]["sp_chunks"] == 2
+    assert fr.summary()["iterations"] == 1
+
+    # a pre-seqpar 22-field tuple (this PR appended sp_chunks at the
+    # END) reads cleanly: records/summary/chrome skip the absent tail
+    legacy = FlightRecorder(capacity=8, name="old")
+    legacy.record(_rec(1, time.monotonic(), tenants_live=3)[:22])
+    recs = legacy.records()
+    assert "sp_chunks" not in recs[0] and recs[0]["tenants_live"] == 3
+    assert legacy.summary()["iterations"] == 1
+    legacy.chrome_counter_events()                 # no positional IndexError
 
 
 # -- engine integration -------------------------------------------------------

@@ -58,6 +58,7 @@ EXPECTED_TP = {
     ("RT106", "Rt106XferEngine._iterate"),       # kv-transfer fetch builder
     ("RT106", "Rt106QuantEngine._iterate"),      # quant-step builder
     ("RT106", "Rt106CostEngine._iterate"),       # cost-reducer builder
+    ("RT106", "Rt106SeqparEngine._iterate"),     # seqpar-chunk builder
 }
 
 

@@ -104,6 +104,13 @@ from typing import Dict, List, Tuple
 # regressing UP means the wire compression stopped paying. Its ratio
 # sibling wire_compressed_ratio archives as *_info (ratio would hit the
 # higher-better rule backwards: smaller is better there).
+# ttft_long_p50 / itl_short_p99 are the long-context serving pair
+# (lm_long_context A/B): the median time-to-first-token of the few
+# "document" prompts sequence-parallel prefill exists to speed up, and
+# the p99 inter-token latency of the short interactive requests
+# decoding while those documents prefill — both regress UP (the gate
+# holds the seqpar leg to both: faster documents AND an unstalled
+# interactive tail; the off leg's twins archive as *_info).
 # accounting_drift is the cost ledger's conservation residual
 # (|sum-over-tenants - engine counter| over the integer usage fields,
 # serving/accounting.py): the bench archives 0 and the zero-baseline
@@ -123,7 +130,7 @@ _LOWER_BETTER = ("_ms", "shed_rate", "kv_bytes_per_seq",
                  "updates_lost", "epoch_fence_rejections_unexpected",
                  "preempt_output_mismatches", "starved_requests",
                  "deadline_drops", "kv_bytes_moved", "publish_bytes",
-                 "accounting_drift")
+                 "accounting_drift", "ttft_long_p50", "itl_short_p99")
 
 
 def metric_direction(name: str) -> int:

@@ -1039,6 +1039,162 @@ def _sharded_decode_ab(server, quick: bool) -> dict:
     }
 
 
+def _long_context_ab(server, quick: bool) -> dict:
+    """Long-context A/B: sequence-parallel prefill on vs off at EQUAL
+    pool bytes on the SAME tp=2 decode mesh.
+
+    The trace is the long-context serving mix the feature exists for: a
+    few "document" prompts (hundreds of tokens, ``max_new=1`` so the
+    client-observed completion IS the TTFT) land in the middle of a
+    steady stream of short interactive requests with zipf generations.
+    Both legs run identical geometry — same model, same paged pool,
+    same ``decode_tp=2`` mesh, same per-iteration token budget — the
+    only difference is ``-prefill_sp``: the seqpar leg prefills
+    ``budget x tp`` prompt tokens per engine iteration (one budget of
+    rows per DEVICE, ring attention over the sequence axis), the off
+    leg walks the same prompts one budget at a time on a single lane.
+
+    Gated columns, both on the seqpar leg and lower-better:
+    ``ttft_long_p50`` (median document TTFT — the headline: chunks are
+    tp x fewer, so the document's first token lands in roughly half the
+    iterations) and ``itl_short_p99`` (the tail inter-token latency of
+    the short interactive requests decoding WHILE documents prefill —
+    the number that says the bigger chunk did not buy TTFT by stalling
+    everyone else; documents generate exactly one token so they
+    contribute no ITL samples). The off leg's twins and the ratios ride
+    as ``_info``. ``output_mismatches`` (seqpar vs single-lane token
+    streams), ``decode_step_retraces`` and the one-trace counters ride
+    the zero-baseline gates.
+
+    Needs >= 2 devices (``--devices N`` / the dryrun harness); the
+    default 1-device bench archives a skip marker like the
+    sharded-decode A/B.
+    """
+    import jax
+
+    from multiverso_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+    from multiverso_tpu.serving import OverloadedError
+
+    if jax.device_count() < 2:
+        return {"skipped": "needs >= 2 devices — run with --devices N "
+                           "or under the multichip dryrun harness"}
+    tp = 2
+    block_size, budget, threshold = 16, 32, 64
+    cap = 8
+    # T divisible by block_size AND by tp (the ring backend's layout
+    # constraint); documents span half to all of max_prompt
+    max_prompt = 248 if quick else 376
+    T = max_prompt + cap
+    lc_cfg = TransformerConfig(vocab_size=256, d_model=128, n_heads=4,
+                               n_layers=2, d_ff=256, max_seq=T)
+    lm = TransformerLM(lc_cfg)
+    pool_blocks = 6 * (T // block_size)
+
+    rng = np.random.default_rng(83)
+    n_long = 3 if quick else 4
+    n_short = 12 if quick else 24
+    short_gap = 0.03
+    trace = []
+    t = 0.0
+    for _ in range(n_short):
+        t += float(rng.exponential(short_gap))
+        plen = int(rng.integers(1, 13))
+        trace.append((t, rng.integers(1, 256, plen).astype(np.int32),
+                      int(min(cap, 4 + rng.zipf(1.6))), "short"))
+    span = t
+    for k in range(n_long):
+        dlen = int(rng.integers(max_prompt // 2, max_prompt + 1))
+        trace.append(((k + 1) * span / (n_long + 1),
+                      rng.integers(1, 256, dlen).astype(np.int32),
+                      1, "doc"))
+    trace.sort(key=lambda r: r[0])
+
+    def _play(model):
+        done_t: dict = {}
+        futs = []
+        t0 = time.monotonic()
+        for i, (at, prompt, n_new, tag) in enumerate(trace):
+            delay = at - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            while True:
+                try:
+                    f = server.submit(model,
+                                      {"prompt": prompt, "max_new": n_new})
+                    break
+                except OverloadedError as exc:
+                    if not getattr(exc, "retriable", True):
+                        raise
+                    time.sleep(0.001)
+            sub_t = time.monotonic()
+            # completion stamped by the engine's done callback, not by
+            # .result() below — waiting in submit order would charge
+            # earlier stragglers' wait to later documents
+            f.add_done_callback(
+                lambda _f, ix=i: done_t.__setitem__(ix, time.monotonic()))
+            futs.append((i, tag, sub_t, f))
+        outs, doc_lat = [], []
+        for i, tag, sub_t, f in futs:
+            outs.append(f.result(timeout=600)["result"])
+            if tag == "doc":
+                doc_lat.append((done_t[i] - sub_t) * 1e3)
+        return outs, doc_lat
+
+    rows, outs = {}, {}
+    for label, sp in (("seqpar", True), ("single_lane", False)):
+        engine = server.register_decoder(
+            f"lm_lc_{label}", lm, slots=6, max_prompt=max_prompt,
+            max_new=cap, max_queue=max(64, n_short + n_long),
+            prompt_buckets=(max_prompt,), kv_block_size=block_size,
+            kv_pool_blocks=pool_blocks, prefill_token_budget=budget,
+            decode_tp=tp, prefill_sp=sp, prefill_sp_backend="ring",
+            prefill_sp_threshold=threshold)
+        engine.warmup()
+        engine.reset_stats()
+        outs[label], doc_lat = _play(f"lm_lc_{label}")
+        s = engine.stats()
+        rows[label] = {
+            "ttft_long_p50": round(float(np.median(doc_lat)), 3),
+            "itl_short_p99": round(s["itl_p99_ms"], 3),
+            "decode_step_retraces": s["decode_step_retraces"],
+            "step_traces": s["step_traces"],
+            "prefill_traces": s["prefill_traces"],
+            "deadline_drops": s["deadline_drops"],
+        }
+        if sp:
+            rows[label]["seqpar_traces"] = s["seqpar_traces"]
+            rows[label]["seqpar_chunks_info"] = s["seqpar_chunks"]
+        else:
+            # off leg's latencies archive as _info: the seqpar leg owns
+            # the gate, the ratios below tell the story across rounds
+            rows[label] = {
+                (k if k not in ("ttft_long_p50", "itl_short_p99")
+                 else f"{k}_info"): v
+                for k, v in rows[label].items()}
+    mismatches = sum(
+        not np.array_equal(a, b)
+        for a, b in zip(outs["seqpar"], outs["single_lane"]))
+    sp_row, off = rows["seqpar"], rows["single_lane"]
+    return {
+        "requests": n_short + n_long,
+        "documents": n_long,
+        "doc_prompt_max": max_prompt,
+        "decode_tp": tp,
+        "prefill_token_budget": budget,
+        "sp_chunk_tokens": budget * tp,
+        "output_mismatches": mismatches,
+        "ttft_long_speedup_info": round(
+            off["ttft_long_p50_info"]
+            / max(sp_row["ttft_long_p50"], 1e-9), 3),
+        "itl_short_p99_ratio_info": round(
+            sp_row["itl_short_p99"]
+            / max(off["itl_short_p99_info"], 1e-9), 3),
+        "seqpar": sp_row,
+        "single_lane": off,
+    }
+
+
 def _observability_ab(server, lm_model, quick: bool):
     """Prices the always-on black box: the SAME engine serves the same
     mixed-length trace twice — tracing fully disabled, then tail-sampled
@@ -1930,6 +2086,10 @@ def run(duration_s: float = 2.0, clients: int = 32,
     # default 1-device bench archives a skip marker
     out["workloads"]["lm_sharded_decode"] = _sharded_decode_ab(
         server, quick)
+    # long-context A/B right after it: same >= 2 device requirement and
+    # the most latency-led gates in the file (document TTFT + witness
+    # ITL tails), so it runs while the box is still quiet
+    out["workloads"]["lm_long_context"] = _long_context_ab(server, quick)
     # observability A/B (tracing-off vs tail-sampled-on) before the
     # closed-loop phase saturates the box — it measures tok/s deltas
     # that must sit in the noise floor, not under 32 client threads

@@ -213,6 +213,19 @@ def request_report(spans, device_events=None):
         # served each row (replicated engines omit it — no column)
         if admits and "decode_tp" in admits[0]["args"]:
             row["decode_tp"] = admits[0]["args"]["decode_tp"]
+        # sequence-parallel engines (-prefill_sp) annotate every
+        # prefill_chunk span with the routing decision: the report's sp
+        # column then says which prompts ran the seqpar program (and
+        # with which backend) versus riding the single-lane path under
+        # the threshold ("off"); engines without the flag omit the
+        # column entirely
+        sp_chunks = [s for s in group
+                     if s["name"] == "decode.prefill_chunk"
+                     and "sp" in s["args"]]
+        if sp_chunks:
+            row["sp"] = (sp_chunks[0]["args"].get("sp_backend", "?")
+                         if any(c["args"].get("sp") for c in sp_chunks)
+                         else "off")
         # quantized-KV engines annotate the admit span with the pool
         # encoding: the report then says which requests were served off
         # int8 pools (fp engines omit it — no column), the first thing
@@ -267,6 +280,7 @@ def print_request_report(rows, top: int, sort: str,
     has_prefix = any("prefix_hit_blocks" in r for r in rows)
     has_tp = any("decode_tp" in r for r in rows)
     has_quant = any("kv_quant" in r for r in rows)
+    has_sp = any("sp" in r for r in rows)
     has_preempt = any("preempted" in r for r in rows)
     has_xfer = any("xfer_blocks" in r for r in rows)
     has_tenant = any("tenant" in r for r in rows)
@@ -294,6 +308,8 @@ def print_request_report(rows, top: int, sort: str,
         hdr += f" {'tp':>3}"
     if has_quant:
         hdr += f" {'quant':>6}"
+    if has_sp:
+        hdr += f" {'sp':>8}"
     if has_preempt:
         hdr += f" {'preempt':>8}"
     if has_xfer:
@@ -323,6 +339,8 @@ def print_request_report(rows, top: int, sort: str,
             line += f" {str(r.get('decode_tp', '-')):>3}"
         if has_quant:
             line += f" {str(r.get('kv_quant', '-')):>6}"
+        if has_sp:
+            line += f" {str(r.get('sp', '-')):>8}"
         if has_preempt:
             line += f" {str(r.get('preempted', '-')):>8}"
         if has_xfer:
